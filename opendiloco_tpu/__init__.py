@@ -1,0 +1,20 @@
+"""OpenDiLoCo-TPU: a TPU-native framework for globally distributed
+low-communication (DiLoCo) training.
+
+A from-scratch JAX/XLA/Pallas re-design of the capabilities of
+PrimeIntellect-ai/OpenDiloco (reference: /root/reference, surveyed in
+SURVEY.md). The inner per-worker training loop is a single jit-compiled
+function over a sharded pytree on a TPU mesh; the DiLoCo outer loop runs
+host-side over a pluggable DCN communication backend.
+
+Layout:
+    config     -- pydantic config tree + dotted-flag CLI parsing
+    models/    -- functional Llama (scan-over-layers), HF safetensors IO
+    ops/       -- attention kernels (XLA SDPA, Pallas flash, ring attention)
+    parallel/  -- device mesh + sharding strategies (DDP/ZeRO/hybrid)
+    diloco/    -- DiLoCo optimizer, averagers, progress tracker, backends
+    data/      -- streaming/fake datasets with resumable state
+    utils/     -- logging, metrics probes, misc
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,194 @@
+"""Configuration tree and CLI parsing.
+
+Mirrors the reference's pydantic-based config semantics (nested dotted flags
+like ``--diloco.local-steps 500`` and ``--no-x`` booleans; reference:
+open_diloco/train_fsdp.py:79-129, pydantic_config fork) with a thin,
+dependency-free argv parser.
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Any, Literal, Optional
+
+from pydantic import BaseModel, field_validator
+from pydantic import ConfigDict
+
+
+class CkptConfig(BaseModel):
+    """Checkpoint cadence/paths (reference: open_diloco/ckpt_utils.py:16-21)."""
+
+    model_config = ConfigDict(extra="forbid")
+
+    path: str = "outputs"
+    interval: Optional[int] = None
+    topk: Optional[int] = None
+    # resume: True -> auto-discover latest ckpt under `path`; str -> explicit
+    # checkpoint directory; None/False -> fresh start.
+    resume: Optional[str | bool] = None
+
+
+class DilocoConfig(BaseModel):
+    """Outer-loop (DiLoCo) configuration.
+
+    Equivalent of the reference's ``HvConfig`` (open_diloco/train_fsdp.py:79-101)
+    plus the DiLoCoOptimizer kwargs it forwards
+    (open_diloco/hivemind_diloco.py:326-406).
+    """
+
+    model_config = ConfigDict(extra="forbid")
+
+    outer_lr: float = 0.7
+    outer_momentum: float = 0.9
+    outer_nesterov: bool = True
+    local_steps: int = 500
+
+    # peer bootstrap / identity
+    initial_peers: list[str] = []
+    host: str = "0.0.0.0"
+    port: int = 0  # 0 -> ephemeral
+    world_rank: int = 0
+    galaxy_size: int = 1
+
+    # straggler / failure policy (reference: hivemind_diloco.py:285-300)
+    all_reduce_strategy: Literal["wait_for_all", "no_wait"] = "wait_for_all"
+    timeout_waiting_for_peers: float = 600.0
+    averaging_timeout: float = 300.0
+    matchmaking_time: float = 5.0
+    fail_rank_drop: bool = False  # crash if a peer drops (train_fsdp.py:93)
+
+    # wire compression for the outer all-reduce (utils.py:83-121)
+    compression: Literal[
+        "none", "fp16", "scaled-fp16", "uniform8bit", "quantile8bit", "blockwise8bit"
+    ] = "none"
+
+    # onboarding (train_fsdp.py:348-349)
+    skip_load_from_peers: bool = False
+
+    # communication backend: "loopback" (in-process, tests), "tcp" (DCN)
+    backend: Literal["loopback", "tcp"] = "tcp"
+
+    # optional periodic full state averaging (hivemind_diloco.py:634-638)
+    average_state_every: int = 0  # 0 = never
+
+    @field_validator("initial_peers", mode="before")
+    @classmethod
+    def _coerce_peers(cls, v: Any) -> Any:
+        # reference coerces scalar -> list (train_fsdp.py:95-101)
+        if isinstance(v, str):
+            return [v]
+        return v
+
+
+class Config(BaseModel):
+    """Top-level training config (reference: open_diloco/train_fsdp.py:104-129)."""
+
+    model_config = ConfigDict(extra="forbid")
+
+    # model
+    path_model: str = "configs/config_150m.json"
+    attn_implementation: Literal["xla", "pallas", "ring"] = "xla"
+    remat: bool = True
+
+    # data
+    dataset_name_or_paths: str = "allenai/c4"
+    dataset_streaming: bool = True
+    fake_data: bool = False
+    tokenizer_name: str = "mistralai/Mistral-7B-v0.1"
+    seq_length: int = 1024
+    num_workers: int = 1  # host dataloading threads
+
+    # optimization (train_fsdp.py:250-260)
+    lr: float = 4e-4
+    weight_decay: float = 0.1
+    adam_betas: tuple[float, float] = (0.9, 0.95)
+    warmup_steps: int = 1000
+    total_steps: int = 88_000
+    max_grad_norm: float = 1.0
+    per_device_train_batch_size: int = 32
+    total_batch_size: int = 512
+
+    # precision: bf16-mixed = bf16 compute / f32 master params (TPU default;
+    # the reference itself recommends bf16 over fp16, README.md:295)
+    precision: Literal["bf16-mixed", "fp32"] = "bf16-mixed"
+
+    # in-worker parallelism (utils.py:138-152 equivalents)
+    sharding_strategy: Literal[
+        "NO_SHARD", "SHARD_GRAD_OP", "FULL_SHARD", "HYBRID_SHARD", "HYBRID_SHARD_ZERO2"
+    ] = "NO_SHARD"
+    # mesh axis sizes; None -> infer from available devices
+    dp_size: Optional[int] = None
+    fsdp_size: Optional[int] = None
+    tp_size: int = 1
+    sp_size: int = 1  # sequence/context parallel (ring attention)
+
+    # observability
+    project: str = "opendiloco_tpu"
+    metric_logger_type: Literal["wandb", "dummy"] = "wandb"
+    log_activations_steps: Optional[int] = None
+
+    ckpt: CkptConfig = CkptConfig()
+    diloco: Optional[DilocoConfig] = None  # None -> plain data-parallel mode
+
+    @field_validator("adam_betas", mode="before")
+    @classmethod
+    def _coerce_betas(cls, v: Any) -> Any:
+        if isinstance(v, str):
+            return tuple(float(x) for x in v.split(","))
+        return v
+
+
+# ---------------------------------------------------------------------------
+# argv parsing: nested dotted flags + --no-x booleans
+# ---------------------------------------------------------------------------
+
+
+def _set_nested(tree: dict, dotted: str, value: Any) -> None:
+    keys = dotted.split(".")
+    node = tree
+    for k in keys[:-1]:
+        node = node.setdefault(k, {})
+        if not isinstance(node, dict):
+            raise ValueError(f"flag {dotted!r} conflicts with earlier scalar flag")
+    leaf = keys[-1]
+    if leaf in node and isinstance(node[leaf], list):
+        node[leaf].append(value)
+    elif leaf in node:
+        node[leaf] = [node[leaf], value]
+    else:
+        node[leaf] = value
+
+
+def parse_argv(argv: Optional[list[str]] = None) -> dict:
+    """Parse ``--a.b value`` / ``--no-a.b`` style flags into a nested dict.
+
+    Semantics follow the reference's pydantic_config ``parse_argv``
+    (train_fsdp.py:525): dashes in key names normalize to underscores,
+    ``--no-flag`` sets False, a bare ``--flag`` followed by another flag (or
+    end of argv) sets True, repeated flags accumulate into a list.
+    """
+    if argv is None:
+        argv = sys.argv[1:]
+    tree: dict = {}
+    i = 0
+    while i < len(argv):
+        tok = argv[i]
+        if not tok.startswith("--"):
+            raise ValueError(f"unexpected positional argument {tok!r}")
+        key = tok[2:]
+        value: Any
+        if "=" in key:
+            key, value = key.split("=", 1)
+            i += 1
+        elif key.startswith("no-") or key.startswith("no_"):
+            key, value = key[3:], False
+            i += 1
+        elif i + 1 >= len(argv) or argv[i + 1].startswith("--"):
+            value = True
+            i += 1
+        else:
+            value = argv[i + 1]
+            i += 2
+        key = ".".join(part.replace("-", "_") for part in key.split("."))
+        _set_nested(tree, key, value)
+    return tree

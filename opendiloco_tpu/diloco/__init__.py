@@ -1,0 +1,17 @@
+from opendiloco_tpu.diloco.backend import AllReduceError, OuterBackend, PeerProgress
+from opendiloco_tpu.diloco.compression import get_codec
+from opendiloco_tpu.diloco.loopback import LoopbackBackend, LoopbackWorld
+from opendiloco_tpu.diloco.optimizer import DiLoCoOptimizer, PeerDropError
+from opendiloco_tpu.diloco.outer_optimizer import OuterSGD
+
+__all__ = [
+    "AllReduceError",
+    "OuterBackend",
+    "PeerProgress",
+    "get_codec",
+    "LoopbackBackend",
+    "LoopbackWorld",
+    "DiLoCoOptimizer",
+    "PeerDropError",
+    "OuterSGD",
+]

@@ -1,0 +1,149 @@
+"""Wire codecs for the outer all-reduce.
+
+Same menu as the reference's compression flag (open_diloco/utils.py:83-121,
+mapping to hivemind compression classes): none / fp16 / scaled-fp16 /
+uniform8bit / quantile8bit / blockwise8bit. Pure numpy host-side codecs --
+the outer loop runs on host pytrees, never on TPU.
+
+Each codec turns one float32 ndarray into (payload bytes, meta dict) and
+back. Lossy codecs are used for the *pseudo-gradients* on the wire; the
+averaged result is decoded back to float32 before the outer optimizer step.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+_BLOCK = 4096
+
+
+class Codec:
+    name: str = "none"
+
+    def encode(self, arr: np.ndarray) -> tuple[bytes, dict]:
+        return arr.astype(np.float32).tobytes(), {}
+
+    def decode(self, payload: bytes, shape: tuple[int, ...], meta: dict) -> np.ndarray:
+        return np.frombuffer(payload, dtype=np.float32).reshape(shape).copy()
+
+
+class Float16Codec(Codec):
+    name = "fp16"
+
+    def encode(self, arr):
+        return arr.astype(np.float16).tobytes(), {}
+
+    def decode(self, payload, shape, meta):
+        return (
+            np.frombuffer(payload, dtype=np.float16).astype(np.float32).reshape(shape)
+        )
+
+
+class ScaledFloat16Codec(Codec):
+    """fp16 after normalizing by the tensor's abs-max (keeps outliers finite;
+    hivemind ScaledFloat16Compression equivalent)."""
+
+    name = "scaled-fp16"
+
+    def encode(self, arr):
+        scale = float(np.max(np.abs(arr))) if arr.size else 0.0
+        scale = scale if scale > 0 else 1.0
+        return (arr / scale).astype(np.float16).tobytes(), {"scale": scale}
+
+    def decode(self, payload, shape, meta):
+        out = np.frombuffer(payload, dtype=np.float16).astype(np.float32)
+        return (out * meta["scale"]).reshape(shape)
+
+
+class Uniform8BitCodec(Codec):
+    """Linear min/max quantization to uint8."""
+
+    name = "uniform8bit"
+
+    def encode(self, arr):
+        lo = float(arr.min()) if arr.size else 0.0
+        hi = float(arr.max()) if arr.size else 0.0
+        span = (hi - lo) or 1.0
+        q = np.clip(np.round((arr - lo) / span * 255.0), 0, 255).astype(np.uint8)
+        return q.tobytes(), {"lo": lo, "span": span}
+
+    def decode(self, payload, shape, meta):
+        q = np.frombuffer(payload, dtype=np.uint8).astype(np.float32)
+        return (q / 255.0 * meta["span"] + meta["lo"]).reshape(shape)
+
+
+class Quantile8BitCodec(Codec):
+    """256-bucket quantile codebook quantization (hivemind
+    Quantile8BitQuantization equivalent): robust to heavy-tailed grads."""
+
+    name = "quantile8bit"
+
+    def encode(self, arr):
+        flat = arr.reshape(-1).astype(np.float32)
+        if flat.size == 0:
+            return b"", {"codebook": np.zeros(256, np.float32).tobytes()}
+        # sample for speed on big tensors
+        sample = flat if flat.size <= 100_000 else np.random.default_rng(0).choice(
+            flat, 100_000, replace=False
+        )
+        edges = np.quantile(sample, np.linspace(0, 1, 257))
+        codebook = ((edges[:-1] + edges[1:]) * 0.5).astype(np.float32)
+        idx = np.clip(
+            np.searchsorted(edges[1:-1], flat, side="right"), 0, 255
+        ).astype(np.uint8)
+        return idx.tobytes(), {"codebook": codebook.tobytes()}
+
+    def decode(self, payload, shape, meta):
+        codebook = np.frombuffer(meta["codebook"], dtype=np.float32)
+        idx = np.frombuffer(payload, dtype=np.uint8)
+        return codebook[idx].reshape(shape)
+
+
+class Blockwise8BitCodec(Codec):
+    """Per-block absmax int8 (bitsandbytes/hivemind BlockwiseQuantization
+    style): one fp32 scale per 4096 values."""
+
+    name = "blockwise8bit"
+
+    def encode(self, arr):
+        flat = arr.reshape(-1).astype(np.float32)
+        pad = (-flat.size) % _BLOCK
+        padded = np.pad(flat, (0, pad))
+        blocks = padded.reshape(-1, _BLOCK)
+        scales = np.max(np.abs(blocks), axis=1, keepdims=True)
+        scales[scales == 0] = 1.0
+        q = np.clip(np.round(blocks / scales * 127.0), -127, 127).astype(np.int8)
+        return q.tobytes(), {"scales": scales.astype(np.float32).tobytes(), "pad": pad}
+
+    def decode(self, payload, shape, meta):
+        q = np.frombuffer(payload, dtype=np.int8).astype(np.float32).reshape(-1, _BLOCK)
+        scales = np.frombuffer(meta["scales"], dtype=np.float32).reshape(-1, 1)
+        flat = (q / 127.0 * scales).reshape(-1)
+        pad = meta["pad"]
+        if pad:
+            flat = flat[:-pad]
+        return flat.reshape(shape)
+
+
+_CODECS = {
+    c.name: c
+    for c in [
+        Codec(),
+        Float16Codec(),
+        ScaledFloat16Codec(),
+        Uniform8BitCodec(),
+        Quantile8BitCodec(),
+        Blockwise8BitCodec(),
+    ]
+}
+
+
+def get_codec(name: str) -> Codec:
+    if name not in _CODECS:
+        raise ValueError(f"unknown compression {name!r}; have {sorted(_CODECS)}")
+    return _CODECS[name]
+
+
+def compress_roundtrip(arr: np.ndarray, codec: Codec) -> np.ndarray:
+    payload, meta = codec.encode(arr)
+    return codec.decode(payload, arr.shape, meta)

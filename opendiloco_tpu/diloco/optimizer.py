@@ -1,0 +1,210 @@
+"""DiLoCoOptimizer: the algorithm orchestrator.
+
+TPU-native re-design of the reference's ``DiLoCoOptimizer``
+(open_diloco/hivemind_diloco.py:303-738) with the normative update rule of
+the pure-torch driver (open_diloco/train_diloco_torch.py:336-353):
+
+  every step:        inner AdamW step on device (jit, sharded)
+  every local_steps: pseudo_grad = master - device_params        [D2H]
+                     averaged    = backend.all_reduce(pseudo_grad)  [DCN]
+                     outer Nesterov SGD updates host master
+                     device_params <- master                     [H2D]
+
+The master copy lives in host RAM as float32 numpy (the equivalent of
+hivemind's CPU-offloaded outer optimizer, hivemind_diloco.py:399-400,
+158-167). The inner jit step never changes shape/sharding across the outer
+boundary, so the 500-step inner phases never recompile.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+from opendiloco_tpu.config import DilocoConfig
+from opendiloco_tpu.diloco.backend import OuterBackend, PeerProgress, wait_for_peers
+from opendiloco_tpu.diloco.outer_optimizer import OuterSGD
+from opendiloco_tpu.trainer import InnerTrainer
+from opendiloco_tpu.utils.logger import get_text_logger
+
+log = get_text_logger(__name__)
+
+
+class PeerDropError(RuntimeError):
+    """Raised when a DiLoCo worker disappears and fail_rank_drop is set
+    (reference: train_fsdp.py:452-457)."""
+
+
+class DiLoCoOptimizer:
+    """Owns inner trainer state transitions + the outer DiLoCo loop."""
+
+    def __init__(
+        self,
+        trainer: InnerTrainer,
+        backend: OuterBackend,
+        cfg: DilocoConfig,
+        state: dict,
+        batch_size: int,
+    ):
+        self.trainer = trainer
+        self.backend = backend
+        self.cfg = cfg
+        self.batch_size = batch_size
+        self.target_samples = batch_size * cfg.local_steps
+
+        # host master copy (float32). Flatten once; treedef is stable.
+        params_np = jax.device_get(state["params"])
+        flat, self.treedef = jax.tree.flatten(params_np)
+        self.master: list[np.ndarray] = [
+            np.array(x, dtype=np.float32) for x in flat
+        ]
+        self.outer_opt = OuterSGD(
+            lr=cfg.outer_lr, momentum=cfg.outer_momentum, nesterov=cfg.outer_nesterov
+        )
+
+        self.epoch = 0  # completed outer steps
+        self.local_step = 0  # inner steps within current epoch
+        self.samples_in_epoch = 0
+        self.max_num_peers = 1
+        self._epoch_t0 = time.monotonic()
+        self.last_outer_metrics: dict[str, Any] = {}
+
+        backend.serve_state(self._state_for_peers)
+
+    # ------------------------------------------------------------------
+    # onboarding (reference: load_state_from_peers, train_fsdp.py:348-349)
+    # ------------------------------------------------------------------
+
+    def _state_for_peers(self) -> dict[str, Any]:
+        return {
+            "master": [m.copy() for m in self.master],
+            "epoch": self.epoch,
+            "outer_opt": self.outer_opt.state_dict(),
+        }
+
+    def load_state_from_peers(self, state: dict) -> Optional[dict]:
+        """Adopt a peer's master params/epoch; returns updated device state."""
+        remote = self.backend.fetch_state()
+        if remote is None:
+            return None
+        self.master = [np.asarray(m, np.float32).copy() for m in remote["master"]]
+        self.epoch = int(remote["epoch"])
+        self.outer_opt.load_state_dict(remote["outer_opt"])
+        self.local_step = 0
+        self.samples_in_epoch = 0
+        return self._write_master_to_device(state)
+
+    # ------------------------------------------------------------------
+    # inner step
+    # ------------------------------------------------------------------
+
+    def step(self, state: dict, batch: dict) -> tuple[dict, dict]:
+        """One inner optimizer step; triggers the outer step at the epoch
+        boundary. Returns (state, metrics)."""
+        state, metrics = self.trainer.train_step(state, batch)
+        self.local_step += 1
+        self.samples_in_epoch += self.batch_size
+
+        elapsed = max(time.monotonic() - self._epoch_t0, 1e-6)
+        self.backend.report_progress(
+            PeerProgress(
+                peer_id=self.backend.peer_id,
+                epoch=self.epoch,
+                samples=self.samples_in_epoch,
+                samples_per_second=self.samples_in_epoch / elapsed,
+                timestamp=time.time(),
+            )
+        )
+
+        metrics = dict(metrics)
+        metrics["epoch"] = self.epoch
+        if self.local_step >= self.cfg.local_steps:
+            state, outer_metrics = self.outer_step(state)
+            metrics.update(outer_metrics)
+        return state, metrics
+
+    # ------------------------------------------------------------------
+    # outer step (reference: _update_global_epoch, hivemind_diloco.py:570-679)
+    # ------------------------------------------------------------------
+
+    def outer_step(self, state: dict) -> tuple[dict, dict]:
+        t0 = time.monotonic()
+        wait_for_peers(
+            self.backend,
+            target_samples=self.target_samples,
+            own_epoch=self.epoch,
+            strategy=self.cfg.all_reduce_strategy,
+            timeout_waiting_for_peers=self.cfg.timeout_waiting_for_peers,
+            log=log,
+        )
+        wait_s = time.monotonic() - t0
+
+        # pseudo-gradient = master - current device params  [D2H]
+        device_flat = [
+            np.asarray(x, dtype=np.float32)
+            for x in jax.tree.leaves(jax.device_get(state["params"]))
+        ]
+        pseudo_grad = [m - d for m, d in zip(self.master, device_flat)]
+
+        t1 = time.monotonic()
+        averaged, group_size = self.backend.all_reduce(
+            pseudo_grad, timeout=self.cfg.averaging_timeout
+        )
+        allreduce_s = time.monotonic() - t1
+        log.info(
+            "outer step %d: all-reduce over %d peers took %.3fs",
+            self.epoch,
+            group_size,
+            allreduce_s,
+        )
+
+        if group_size < self.max_num_peers:
+            msg = f"Lost a diloco worker: {group_size} < {self.max_num_peers}"
+            if self.cfg.fail_rank_drop:
+                raise PeerDropError(msg)
+            log.warning(msg)
+        self.max_num_peers = max(self.max_num_peers, group_size)
+
+        self.outer_opt.step(self.master, averaged)
+        state = self._write_master_to_device(state)  # [H2D]
+
+        self.epoch += 1
+        self.local_step = 0
+        self.samples_in_epoch = 0
+        self._epoch_t0 = time.monotonic()
+        outer_metrics = {
+            "outer_step_s": time.monotonic() - t0,
+            "outer_allreduce_s": allreduce_s,
+            "outer_wait_s": wait_s,
+            "num_peers": group_size,
+        }
+        self.last_outer_metrics = outer_metrics
+        return state, outer_metrics
+
+    def _write_master_to_device(self, state: dict) -> dict:
+        params = jax.tree.unflatten(self.treedef, self.master)
+        state["params"] = jax.device_put(
+            params, self.trainer.state_shardings["params"]
+        )
+        return state
+
+    # ------------------------------------------------------------------
+    # checkpoint integration (reference: hivemind_diloco.py:697-714)
+    # ------------------------------------------------------------------
+
+    def state_dict(self) -> dict:
+        return {
+            "master": [m.copy() for m in self.master],
+            "outer_opt": self.outer_opt.state_dict(),
+            "epoch": self.epoch,
+            "local_step": self.local_step,
+        }
+
+    def load_state_dict(self, sd: dict) -> None:
+        self.master = [np.asarray(m, np.float32).copy() for m in sd["master"]]
+        self.outer_opt.load_state_dict(sd["outer_opt"])
+        self.epoch = int(sd["epoch"])
+        self.local_step = int(sd["local_step"])

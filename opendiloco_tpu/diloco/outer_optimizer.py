@@ -1,0 +1,64 @@
+"""Host-side outer optimizer: Nesterov-momentum SGD on the master pytree.
+
+Numerically matches torch.optim.SGD(lr=0.7, momentum=0.9, nesterov=True) --
+the reference's outer optimizer (open_diloco/train_fsdp.py:253) -- since the
+DiLoCo convergence results depend on its exact update rule:
+
+    buf   = momentum * buf + grad
+    d     = grad + momentum * buf        (nesterov)  |  d = buf (plain)
+    param = param - lr * d
+
+Runs in numpy on host RAM: the master copy never touches the TPU (the
+equivalent of hivemind's offload_optimizer, hivemind_diloco.py:399-400).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+
+class OuterSGD:
+    def __init__(
+        self,
+        lr: float = 0.7,
+        momentum: float = 0.9,
+        nesterov: bool = True,
+    ):
+        self.lr = lr
+        self.momentum = momentum
+        self.nesterov = nesterov
+        self.bufs: Optional[list[np.ndarray]] = None
+
+    def step(self, params: list[np.ndarray], grads: list[np.ndarray]) -> None:
+        """In-place update of ``params`` given pseudo-gradients ``grads``."""
+        if self.momentum == 0.0:
+            for p, g in zip(params, grads):
+                p -= self.lr * g
+            return
+        if self.bufs is None:
+            self.bufs = [np.zeros_like(p) for p in params]
+        for p, g, buf in zip(params, grads, self.bufs):
+            np.multiply(buf, self.momentum, out=buf)
+            buf += g
+            if self.nesterov:
+                d = g + self.momentum * buf
+            else:
+                d = buf
+            p -= self.lr * d
+
+    def state_dict(self) -> dict:
+        return {
+            "lr": self.lr,
+            "momentum": self.momentum,
+            "nesterov": self.nesterov,
+            "bufs": None if self.bufs is None else [b.copy() for b in self.bufs],
+        }
+
+    def load_state_dict(self, state: dict) -> None:
+        self.lr = state["lr"]
+        self.momentum = state["momentum"]
+        self.nesterov = state["nesterov"]
+        bufs = state["bufs"]
+        self.bufs = None if bufs is None else [np.asarray(b).copy() for b in bufs]

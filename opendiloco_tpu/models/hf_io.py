@@ -1,0 +1,137 @@
+"""HF-interop: load/save Llama weights as HF-named safetensors.
+
+Parity target: the reference loads ``LlamaForCausalLM.from_pretrained`` from a
+local path or hub id (open_diloco/train_fsdp.py:171-174) and ships a committed
+2M-parameter test model (tests/models/llama-2m-fresh). We read/write the same
+``model.safetensors`` naming so checkpoints interchange with HF tooling.
+
+Layout differences handled here:
+- HF linear weights are [out_features, in_features]; ours are [in, out]
+  (we compute ``x @ W``) -> transpose on both directions.
+- Our per-layer weights are stacked on a leading layer axis for
+  ``lax.scan``; HF keys are per-layer -> stack/unstack.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from opendiloco_tpu.models.llama import LlamaConfig, shapes
+
+_PKG_CONFIG_DIR = os.path.join(os.path.dirname(__file__), "configs")
+
+# (our layer-tree key, HF module name, transpose?)
+_LAYER_KEYS = [
+    ("input_norm", "input_layernorm", False),
+    ("post_attn_norm", "post_attention_layernorm", False),
+    ("q_proj", "self_attn.q_proj", True),
+    ("k_proj", "self_attn.k_proj", True),
+    ("v_proj", "self_attn.v_proj", True),
+    ("o_proj", "self_attn.o_proj", True),
+    ("gate_proj", "mlp.gate_proj", True),
+    ("up_proj", "mlp.up_proj", True),
+    ("down_proj", "mlp.down_proj", True),
+]
+
+
+def resolve_model_path(path_model: str) -> str:
+    """Map a name like 'configs/config_150m.json', a packaged size name
+    ('150m'), or a directory path to a concrete config path/dir."""
+    if os.path.isdir(path_model) or os.path.isfile(path_model):
+        return path_model
+    short = path_model.removeprefix("configs/").removesuffix(".json")
+    short = short.removeprefix("config_")
+    candidate = os.path.join(_PKG_CONFIG_DIR, f"config_{short}.json")
+    if os.path.isfile(candidate):
+        return candidate
+    raise FileNotFoundError(f"cannot resolve model path {path_model!r}")
+
+
+def load_config(path_model: str) -> LlamaConfig:
+    path = resolve_model_path(path_model)
+    if os.path.isdir(path):
+        path = os.path.join(path, "config.json")
+    return LlamaConfig.from_json(path)
+
+
+def load_params(model_dir: str, cfg: Optional[LlamaConfig] = None) -> dict:
+    """Read an HF llama ``model.safetensors`` into our stacked pytree."""
+    from safetensors import safe_open
+
+    if cfg is None:
+        cfg = load_config(model_dir)
+    st_path = os.path.join(model_dir, "model.safetensors")
+    tensors: dict[str, np.ndarray] = {}
+    with safe_open(st_path, framework="numpy") as f:
+        for key in f.keys():
+            tensors[key] = f.get_tensor(key)
+
+    def get(name: str, transpose: bool) -> np.ndarray:
+        t = tensors[name].astype(np.float32)
+        return t.T if transpose else t
+
+    L = cfg.num_hidden_layers
+    layers = {}
+    for ours, hf, tr in _LAYER_KEYS:
+        layers[ours] = jnp.asarray(
+            np.stack(
+                [get(f"model.layers.{i}.{hf}.weight", tr) for i in range(L)], axis=0
+            )
+        )
+    params = {
+        "embed_tokens": jnp.asarray(get("model.embed_tokens.weight", False)),
+        "layers": layers,
+        "final_norm": jnp.asarray(get("model.norm.weight", False)),
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = jnp.asarray(get("lm_head.weight", True))
+    chex_shapes = shapes(cfg)
+    got = jax.tree.map(lambda x: x.shape, params)
+    want = jax.tree.map(lambda s: s.shape, chex_shapes)
+    if got != want:
+        raise ValueError(f"weight shapes mismatch config: {got} vs {want}")
+    return params
+
+
+def save_params(params: dict, cfg: LlamaConfig, model_dir: str) -> None:
+    """Write our pytree as an HF-named ``model.safetensors`` + config.json."""
+    from safetensors.numpy import save_file
+
+    os.makedirs(model_dir, exist_ok=True)
+    out: dict[str, np.ndarray] = {}
+    np_params = jax.tree.map(lambda x: np.asarray(x, dtype=np.float32), params)
+    out["model.embed_tokens.weight"] = np.ascontiguousarray(np_params["embed_tokens"])
+    out["model.norm.weight"] = np.ascontiguousarray(np_params["final_norm"])
+    if not cfg.tie_word_embeddings:
+        out["lm_head.weight"] = np.ascontiguousarray(np_params["lm_head"].T)
+    for ours, hf, tr in _LAYER_KEYS:
+        stacked = np_params["layers"][ours]
+        for i in range(cfg.num_hidden_layers):
+            t = stacked[i]
+            out[f"model.layers.{i}.{hf}.weight"] = np.ascontiguousarray(
+                t.T if tr else t
+            )
+    save_file(out, os.path.join(model_dir, "model.safetensors"))
+    with open(os.path.join(model_dir, "config.json"), "w") as f:
+        json.dump(cfg.to_dict(), f, indent=2)
+
+
+def get_model(path_model: str) -> tuple[LlamaConfig, Optional[dict]]:
+    """Reference-shaped entry (train_fsdp.py:171-174): resolve a model source.
+
+    Returns (config, params). params is None when the source is a bare size
+    config (caller should ``init_params``); a directory with safetensors loads
+    real weights.
+    """
+    path = resolve_model_path(path_model)
+    if os.path.isdir(path):
+        cfg = load_config(path)
+        return cfg, load_params(path, cfg)
+    cfg = LlamaConfig.from_json(path)
+    return cfg, None

@@ -1,0 +1,241 @@
+"""Functional Llama-for-causal-LM, TPU-first.
+
+Capability parity with the reference's use of HF ``LlamaForCausalLM``
+(open_diloco/train_fsdp.py:171-174) and the size configs under
+open_diloco/configs/*.json -- but designed for XLA, not translated:
+
+- Parameters are a plain pytree (nested dicts of jax.Arrays). Per-layer
+  weights are **stacked along a leading layer axis** and the decoder runs as a
+  single ``lax.scan`` over layers: one compiled block regardless of depth,
+  fast compiles, and clean per-layer rematerialization.
+- Compute dtype (bf16) is applied at the forward boundary; master params stay
+  float32 (the "bf16-mixed" of train_fsdp.py:228 without a GradScaler --
+  bf16 on TPU needs no loss scaling, as the reference README itself notes).
+- Attention dispatches through opendiloco_tpu.ops.attention (XLA / Pallas
+  flash / ring).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from opendiloco_tpu.ops.attention import xla_attention
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    """Model hyperparameters, JSON-compatible with HF llama configs
+    (open_diloco/configs/config_{2m,14m,60m,150m,1b}.json)."""
+
+    vocab_size: int = 32_000
+    hidden_size: int = 1024
+    intermediate_size: int = 2688
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 16
+    num_key_value_heads: Optional[int] = None
+    max_position_embeddings: int = 2048
+    rms_norm_eps: float = 1e-5
+    rope_theta: float = 10_000.0
+    tie_word_embeddings: bool = False
+    initializer_range: float = 0.02
+
+    @property
+    def kv_heads(self) -> int:
+        return self.num_key_value_heads or self.num_attention_heads
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+    @classmethod
+    def from_json(cls, path: str) -> "LlamaConfig":
+        with open(path) as f:
+            raw = json.load(f)
+        return cls.from_dict(raw)
+
+    @classmethod
+    def from_dict(cls, raw: dict[str, Any]) -> "LlamaConfig":
+        fields = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in raw.items() if k in fields})
+
+    def to_dict(self) -> dict[str, Any]:
+        d = dataclasses.asdict(self)
+        if d["num_key_value_heads"] is None:
+            d["num_key_value_heads"] = self.num_attention_heads
+        d.update(
+            architectures=["LlamaForCausalLM"],
+            model_type="llama",
+            hidden_act="silu",
+            use_cache=False,
+        )
+        return d
+
+    def num_params(self) -> int:
+        return sum(x.size for x in jax.tree.leaves(shapes(self)))
+
+
+def shapes(cfg: LlamaConfig) -> dict:
+    """ShapeDtypeStructs of the parameter pytree (all float32 masters)."""
+    D, F, V = cfg.hidden_size, cfg.intermediate_size, cfg.vocab_size
+    L, Nh, Nkv, Dh = (
+        cfg.num_hidden_layers,
+        cfg.num_attention_heads,
+        cfg.kv_heads,
+        cfg.head_dim,
+    )
+    f32 = jnp.float32
+
+    def s(*shape):
+        return jax.ShapeDtypeStruct(shape, f32)
+
+    tree = {
+        "embed_tokens": s(V, D),
+        "layers": {
+            "input_norm": s(L, D),
+            "post_attn_norm": s(L, D),
+            "q_proj": s(L, D, Nh * Dh),
+            "k_proj": s(L, D, Nkv * Dh),
+            "v_proj": s(L, D, Nkv * Dh),
+            "o_proj": s(L, Nh * Dh, D),
+            "gate_proj": s(L, D, F),
+            "up_proj": s(L, D, F),
+            "down_proj": s(L, F, D),
+        },
+        "final_norm": s(D),
+    }
+    if not cfg.tie_word_embeddings:
+        tree["lm_head"] = s(D, V)
+    return tree
+
+
+def init_params(rng: jax.Array, cfg: LlamaConfig) -> dict:
+    """Fresh init matching HF llama conventions: normal(0, initializer_range)
+    for projections/embeddings, ones for norms (init_weights.py parity)."""
+    shp = shapes(cfg)
+    leaves, treedef = jax.tree.flatten_with_path(shp)
+    keys = jax.random.split(rng, len(leaves))
+    out = []
+    for key, (path, leaf) in zip(keys, leaves):
+        name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
+        if "norm" in name:
+            out.append(jnp.ones(leaf.shape, leaf.dtype))
+        else:
+            out.append(
+                jax.random.normal(key, leaf.shape, leaf.dtype) * cfg.initializer_range
+            )
+    return jax.tree.unflatten(treedef, out)
+
+
+def _rms_norm(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
+    # variance in float32 for stability (HF llama semantics)
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    xf = xf * jax.lax.rsqrt(var + eps)
+    return (xf * weight.astype(jnp.float32)).astype(x.dtype)
+
+
+def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """Rotary embedding over [B, T, H, D] with HF half-rotation layout."""
+    d = x.shape[-1]
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    angles = positions[..., None].astype(jnp.float32) * inv_freq  # [B, T, D/2]
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    rot = jnp.concatenate((x1 * cos - x2 * sin, x2 * cos + x1 * sin), axis=-1)
+    return rot.astype(x.dtype)
+
+
+def _decoder_block(
+    cfg: LlamaConfig,
+    attn_fn,
+    h: jax.Array,
+    layer: dict,
+    positions: jax.Array,
+) -> jax.Array:
+    B, T, D = h.shape
+    Nh, Nkv, Dh = cfg.num_attention_heads, cfg.kv_heads, cfg.head_dim
+
+    x = _rms_norm(h, layer["input_norm"], cfg.rms_norm_eps)
+    q = (x @ layer["q_proj"]).reshape(B, T, Nh, Dh)
+    k = (x @ layer["k_proj"]).reshape(B, T, Nkv, Dh)
+    v = (x @ layer["v_proj"]).reshape(B, T, Nkv, Dh)
+    q = _rope(q, positions, cfg.rope_theta)
+    k = _rope(k, positions, cfg.rope_theta)
+    attn = attn_fn(q, k, v)
+    h = h + attn.reshape(B, T, Nh * Dh) @ layer["o_proj"]
+
+    x = _rms_norm(h, layer["post_attn_norm"], cfg.rms_norm_eps)
+    gated = jax.nn.silu(x @ layer["gate_proj"]) * (x @ layer["up_proj"])
+    return h + gated @ layer["down_proj"]
+
+
+def forward(
+    params: dict,
+    input_ids: jax.Array,
+    cfg: LlamaConfig,
+    *,
+    compute_dtype: jnp.dtype = jnp.bfloat16,
+    attn_impl: str = "xla",
+    remat: bool = True,
+    positions: Optional[jax.Array] = None,
+) -> jax.Array:
+    """input_ids [B, T] int32 -> logits [B, T, V] float32."""
+    B, T = input_ids.shape
+    if positions is None:
+        positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (B, T))
+
+    cparams = jax.tree.map(lambda x: x.astype(compute_dtype), params)
+
+    if attn_impl == "xla":
+        attn_fn = lambda q, k, v: xla_attention(q, k, v, causal=True)
+    elif attn_impl == "pallas":
+        from opendiloco_tpu.ops.flash_attention import flash_attention
+
+        attn_fn = lambda q, k, v: flash_attention(q, k, v, causal=True)
+    elif attn_impl == "ring":
+        from opendiloco_tpu.ops.ring_attention import ring_attention_auto
+
+        attn_fn = lambda q, k, v: ring_attention_auto(q, k, v)
+    else:
+        raise ValueError(f"unknown attn_impl {attn_impl!r}")
+
+    h = jnp.take(cparams["embed_tokens"], input_ids, axis=0)
+
+    block = lambda h, layer: (
+        _decoder_block(cfg, attn_fn, h, layer, positions),
+        None,
+    )
+    if remat:
+        block = jax.checkpoint(block)
+    h, _ = jax.lax.scan(block, h, cparams["layers"])
+
+    h = _rms_norm(h, cparams["final_norm"], cfg.rms_norm_eps)
+    head = (
+        cparams["embed_tokens"].T
+        if cfg.tie_word_embeddings
+        else cparams["lm_head"]
+    )
+    logits = (h @ head).astype(jnp.float32)
+    return logits
+
+
+def causal_lm_loss(
+    logits: jax.Array, labels: jax.Array, ignore_index: int = -100
+) -> jax.Array:
+    """Shifted next-token cross-entropy, mean over non-ignored targets
+    (HF CausalLM loss semantics used by the reference drivers)."""
+    shift_logits = logits[:, :-1]
+    shift_labels = labels[:, 1:]
+    mask = shift_labels != ignore_index
+    safe_labels = jnp.where(mask, shift_labels, 0)
+    logp = jax.nn.log_softmax(shift_logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, safe_labels[..., None], axis=-1)[..., 0]
+    total = jnp.sum(nll * mask)
+    count = jnp.maximum(jnp.sum(mask), 1)
+    return total / count

@@ -1,0 +1,87 @@
+"""Attention kernels for TPU.
+
+The reference selects between torch SDPA and FlashAttention-2 CUDA kernels via
+``attn_implementation`` (open_diloco/train_fsdp.py:107,173; README.md:41-47).
+Here the equivalent menu is:
+
+- ``xla``: plain jnp attention; XLA fuses it well on TPU and keeps the
+  matmuls on the MXU. Softmax accumulates in float32.
+- ``pallas``: a Pallas flash-attention kernel (ops/flash_attention.py) that
+  tiles over the sequence and never materializes the [T, T] score matrix.
+- ``ring``: ring attention over a sequence-parallel mesh axis
+  (ops/ring_attention.py) for long-context training; each device holds a
+  sequence shard and K/V blocks rotate around the ring via ppermute.
+
+All entry points share one signature over [batch, seq, heads, head_dim]
+arrays with grouped-query support (num_q_heads % num_kv_heads == 0).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def _repeat_kv(k: jax.Array, num_q_heads: int) -> jax.Array:
+    """Broadcast KV heads up to the query head count (GQA)."""
+    b, t, nkv, d = k.shape
+    if nkv == num_q_heads:
+        return k
+    assert num_q_heads % nkv == 0, (num_q_heads, nkv)
+    rep = num_q_heads // nkv
+    return jnp.broadcast_to(k[:, :, :, None, :], (b, t, nkv, rep, d)).reshape(
+        b, t, num_q_heads, d
+    )
+
+
+def xla_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+) -> jax.Array:
+    """Reference jnp attention: [B, T, H, D] -> [B, T, H, D].
+
+    Scores/softmax in float32 regardless of input dtype; output in q.dtype.
+    """
+    b, tq, h, d = q.shape
+    k = _repeat_kv(k, h)
+    v = _repeat_kv(v, h)
+    tk = k.shape[1]
+    scale = d**-0.5
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
+    scores = scores * scale
+    if causal:
+        q_pos = jax.lax.broadcasted_iota(jnp.int32, (tq, tk), 0)
+        k_pos = jax.lax.broadcasted_iota(jnp.int32, (tq, tk), 1)
+        # when tq < tk (e.g. decode), align the query block to the suffix
+        mask = q_pos + (tk - tq) >= k_pos
+        scores = jnp.where(mask[None, None], scores, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+@functools.partial(jax.jit, static_argnames=("impl", "causal"))
+def attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    impl: str = "xla",
+    causal: bool = True,
+) -> jax.Array:
+    if impl == "xla":
+        return xla_attention(q, k, v, causal=causal)
+    if impl == "pallas":
+        from opendiloco_tpu.ops.flash_attention import flash_attention
+
+        return flash_attention(q, k, v, causal=causal)
+    if impl == "ring":
+        raise ValueError(
+            "ring attention needs a mesh context; call "
+            "opendiloco_tpu.ops.ring_attention.ring_attention inside shard_map"
+        )
+    raise ValueError(f"unknown attention impl {impl!r}")

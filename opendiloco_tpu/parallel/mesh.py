@@ -1,0 +1,131 @@
+"""Device mesh + sharding strategies, TPU-native.
+
+The reference wraps the model in torch FSDP with a strategy enum
+(open_diloco/utils.py:138-152) over a 2-D ("global", "local") device mesh
+(train_fsdp.py:230-245). On TPU none of that wrapper machinery exists:
+parallelism is a **mesh + PartitionSpecs** and XLA inserts the collectives.
+
+Strategy mapping (same user-facing names as the reference):
+
+- NO_SHARD            -> pure data parallel: params replicated, grads psum.
+- FULL_SHARD (ZeRO-3) -> params + optimizer state sharded over the "fsdp"
+                         axis; XLA all-gathers weights per-layer.
+- SHARD_GRAD_OP(ZeRO-2)-> params replicated, optimizer state sharded.
+- HYBRID_SHARD        -> 2-D (dp, fsdp) mesh: ZeRO-3 inside the fsdp axis
+                         (ICI), replication across dp (DCN).
+- HYBRID_SHARD_ZERO2  -> 2-D mesh, ZeRO-2 inside the fsdp axis.
+
+Additional first-class axes the reference lacks: "tp" (tensor parallel over
+heads/ffn) and "sp" (sequence/context parallel for ring attention).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+SHARDING_STRATEGIES = (
+    "NO_SHARD",
+    "SHARD_GRAD_OP",
+    "FULL_SHARD",
+    "HYBRID_SHARD",
+    "HYBRID_SHARD_ZERO2",
+)
+
+# strategies where parameters themselves live sharded on the fsdp axis
+_PARAM_SHARDED = {"FULL_SHARD", "HYBRID_SHARD"}
+# strategies where optimizer state is sharded on the fsdp axis
+_OPTSTATE_SHARDED = {"FULL_SHARD", "HYBRID_SHARD", "SHARD_GRAD_OP", "HYBRID_SHARD_ZERO2"}
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshPlan:
+    mesh: Mesh
+    strategy: str
+    batch_axes: tuple[str, ...]  # axes the batch dim is sharded over
+    fsdp_axis: Optional[str]  # axis params/opt-state shard over (or None)
+    tp_axis: Optional[str]
+    sp_axis: Optional[str]
+
+    @property
+    def data_parallel_size(self) -> int:
+        return int(np.prod([self.mesh.shape[a] for a in self.batch_axes]))
+
+    def batch_spec(self, rank: int = 2, accum: bool = False) -> P:
+        """Sharding spec for a [B, T, ...] batch ([A, B, T, ...] if accum:
+        the leading grad-accumulation axis is scanned, never sharded)."""
+        seq = self.sp_axis if self.sp_axis else None
+        spec = (self.batch_axes, seq) + (None,) * (rank - 2 - (1 if accum else 0))
+        return P(None, *spec) if accum else P(*spec)
+
+    def sharding(self, spec: P) -> NamedSharding:
+        return NamedSharding(self.mesh, spec)
+
+
+def build_mesh(
+    strategy: str = "NO_SHARD",
+    *,
+    devices: Optional[list] = None,
+    dp_size: Optional[int] = None,
+    fsdp_size: Optional[int] = None,
+    tp_size: int = 1,
+    sp_size: int = 1,
+) -> MeshPlan:
+    """Build the (dp, fsdp, sp, tp) mesh for a sharding strategy.
+
+    With hybrid strategies the dp axis is the slow/outer (DCN) dimension and
+    fsdp the fast/inner (ICI) dimension, matching the reference's
+    ("global", "local") mesh order (train_fsdp.py:230-237).
+    """
+    if strategy not in SHARDING_STRATEGIES:
+        raise ValueError(f"unknown sharding strategy {strategy!r}")
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    if n % (tp_size * sp_size) != 0:
+        raise ValueError(f"{n} devices not divisible by tp*sp={tp_size * sp_size}")
+    n_data = n // (tp_size * sp_size)
+
+    hybrid = strategy in ("HYBRID_SHARD", "HYBRID_SHARD_ZERO2")
+    if hybrid:
+        if fsdp_size is None:
+            fsdp_size = dp_size and n_data // dp_size
+        if fsdp_size is None:
+            # default: shard within a host (ICI), replicate across hosts
+            fsdp_size = max(1, min(n_data, jax.local_device_count()))
+        dp_size = n_data // fsdp_size
+    elif strategy == "NO_SHARD":
+        dp_size, fsdp_size = n_data, 1
+    else:  # FULL_SHARD / SHARD_GRAD_OP: single flat axis
+        dp_size, fsdp_size = 1, n_data
+
+    if dp_size * fsdp_size * tp_size * sp_size != n:
+        raise ValueError(
+            f"mesh {dp_size}x{fsdp_size}x{sp_size}x{tp_size} != {n} devices"
+        )
+
+    dev_array = np.asarray(devices).reshape(dp_size, fsdp_size, sp_size, tp_size)
+    mesh = Mesh(dev_array, ("dp", "fsdp", "sp", "tp"))
+
+    # ZeRO-2/3 are still data-parallel: the batch splits over dp AND fsdp.
+    batch_axes = ("dp", "fsdp")
+    return MeshPlan(
+        mesh=mesh,
+        strategy=strategy,
+        batch_axes=batch_axes,
+        fsdp_axis="fsdp" if strategy in _PARAM_SHARDED | _OPTSTATE_SHARDED else None,
+        tp_axis="tp" if tp_size > 1 else None,
+        sp_axis="sp" if sp_size > 1 else None,
+    )
+
+
+def params_sharded(strategy: str) -> bool:
+    return strategy in _PARAM_SHARDED
+
+
+def optstate_sharded(strategy: str) -> bool:
+    return strategy in _OPTSTATE_SHARDED

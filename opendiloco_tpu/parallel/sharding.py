@@ -1,0 +1,120 @@
+"""PartitionSpec rules for the Llama parameter pytree.
+
+Replaces torch-FSDP's parameter flattening/wrapping (reference:
+train_fsdp.py:239-245) with explicit NamedShardings: each leaf gets a spec
+over the (dp, fsdp, sp, tp) mesh and XLA emits the all-gather /
+reduce-scatter pattern that FSDP hand-implements.
+
+Rules:
+- tp shards the "model-parallel" dim: attention heads for q/k/v/o, ffn dim
+  for gate/up/down, vocab for embed/lm_head (Megatron-style layout).
+- fsdp shards the *other* (usually largest remaining) dim, only when
+  divisible by the axis size; small vectors (norms) stay replicated.
+- the leading stacked-layer axis is never sharded (it is scanned over).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+from opendiloco_tpu.models.llama import LlamaConfig, shapes
+from opendiloco_tpu.parallel.mesh import MeshPlan, params_sharded, optstate_sharded
+
+# per-leaf: (tp dim index, preferred fsdp dim index) -- indices into the
+# UNSTACKED shape (layer leaves get +1 when the leading L axis is present).
+_LAYOUT: dict[str, tuple[Optional[int], int]] = {
+    "embed_tokens": (0, 1),  # [V, D]: tp on vocab, fsdp on D
+    "lm_head": (1, 0),  # [D, V]
+    "final_norm": (None, -1),
+    "input_norm": (None, -1),
+    "post_attn_norm": (None, -1),
+    "q_proj": (1, 0),  # [D, Nh*Dh]
+    "k_proj": (1, 0),
+    "v_proj": (1, 0),
+    "o_proj": (0, 1),  # [Nh*Dh, D]
+    "gate_proj": (1, 0),  # [D, F]
+    "up_proj": (1, 0),
+    "down_proj": (0, 1),  # [F, D]
+}
+
+
+def _leaf_spec(
+    name: str,
+    shape: tuple[int, ...],
+    stacked: bool,
+    *,
+    shard_params: bool,
+    plan: MeshPlan,
+) -> P:
+    tp_dim, fsdp_dim = _LAYOUT[name]
+    ndim = len(shape)
+    axes: list[Optional[str]] = [None] * ndim
+    offset = 1 if stacked else 0
+
+    if plan.tp_axis and tp_dim is not None:
+        d = tp_dim + offset
+        if shape[d] % plan.mesh.shape[plan.tp_axis] == 0:
+            axes[d] = plan.tp_axis
+
+    if shard_params and plan.fsdp_axis and fsdp_dim >= 0:
+        fsdp_n = plan.mesh.shape[plan.fsdp_axis]
+        d = fsdp_dim + offset
+        if axes[d] is None and shape[d] % fsdp_n == 0:
+            axes[d] = plan.fsdp_axis
+        else:
+            # preferred dim taken by tp or not divisible: try any other
+            # non-layer dim, largest first
+            cands = sorted(
+                (i for i in range(offset, ndim) if axes[i] is None),
+                key=lambda i: -shape[i],
+            )
+            for i in cands:
+                if shape[i] % fsdp_n == 0:
+                    axes[i] = plan.fsdp_axis
+                    break
+    return P(*axes)
+
+
+def param_specs(cfg: LlamaConfig, plan: MeshPlan, *, for_params: bool = True) -> dict:
+    """Pytree of PartitionSpecs matching ``llama.shapes(cfg)``.
+
+    for_params=True gives the resident sharding of the parameters themselves;
+    for_params=False gives the sharding used for optimizer-state leaves
+    (ZeRO-2 shards opt state even when params are replicated).
+    """
+    shard = params_sharded(plan.strategy) if for_params else optstate_sharded(plan.strategy)
+    shp = shapes(cfg)
+
+    def one(path, leaf):
+        name = path[-1].key
+        stacked = any(getattr(p, "key", None) == "layers" for p in path[:-1])
+        if len(leaf.shape) <= (1 + (1 if stacked else 0)):
+            return P()  # norm vectors: replicate
+        return _leaf_spec(
+            name, leaf.shape, stacked, shard_params=shard, plan=plan
+        )
+
+    return jax.tree_util.tree_map_with_path(one, shp)
+
+
+def optstate_specs(opt_state_shapes, params, p_specs: dict, plan: MeshPlan) -> object:
+    """Shard optimizer-state leaves like their matching parameter.
+
+    Leaves are matched to params by array shape (Adam's mu/nu mirror the
+    param tree); scalars and unmatched leaves replicate. ZeRO-2 parity:
+    utils.py:141-142 (SHARD_GRAD_OP).
+    """
+    by_shape: dict[tuple, P] = {}
+    for (path, leaf), (_, spec) in zip(
+        jax.tree_util.tree_flatten_with_path(params)[0],
+        jax.tree_util.tree_flatten_with_path(p_specs)[0],
+    ):
+        by_shape.setdefault(tuple(leaf.shape), spec)
+
+    def one(leaf):
+        return by_shape.get(tuple(leaf.shape), P())
+
+    return jax.tree.map(one, opt_state_shapes)

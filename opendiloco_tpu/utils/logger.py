@@ -1,0 +1,78 @@
+"""Metric loggers behind a small protocol.
+
+Reference parity: open_diloco/utils.py:170-204 -- a ``Logger`` protocol with a
+wandb backend and a pickle-based ``DummyLogger`` used as a metrics spy by the
+integration tests (tests/test_training/test_train.py:59-83).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import pickle
+from typing import Any, Protocol
+
+
+class Logger(Protocol):
+    def log(self, metrics: dict[str, Any]) -> None: ...
+
+    def finish(self) -> None: ...
+
+
+class WandbLogger:
+    def __init__(self, project: str, config: dict[str, Any], resume: bool):
+        import wandb
+
+        wandb.init(
+            project=project, config=config, resume="auto" if resume else None
+        )
+        self._wandb = wandb
+
+    def log(self, metrics: dict[str, Any]) -> None:
+        self._wandb.log(metrics)
+
+    def finish(self) -> None:
+        self._wandb.finish()
+
+
+class DummyLogger:
+    """Accumulates metric dicts and pickles them to ``project`` on finish()."""
+
+    def __init__(self, project: str, config: dict[str, Any], *_args, **_kwargs):
+        self.project = project
+        self.config = config
+        open(project, "wb").close()  # fail fast on unwritable path
+        self.data: list[dict[str, Any]] = []
+
+    def log(self, metrics: dict[str, Any]) -> None:
+        self.data.append(metrics)
+
+    def finish(self) -> None:
+        with open(self.project, "wb") as f:
+            pickle.dump(self.data, f)
+
+
+def get_logger(
+    logger_type: str, project: str, config: dict[str, Any], resume: bool = False
+) -> Logger:
+    if logger_type == "wandb":
+        return WandbLogger(project=project, config=config, resume=resume)
+    elif logger_type == "dummy":
+        return DummyLogger(project=project, config=config)
+    raise ValueError(f"unknown metric_logger_type {logger_type!r}")
+
+
+_LOG_FORMAT = "%(asctime)s [%(levelname)s] [%(name)s] %(message)s"
+
+
+def get_text_logger(name: str = "opendiloco_tpu") -> logging.Logger:
+    """Rank-prefixed text logger (reference: train_fsdp.py:75-76)."""
+    logger = logging.getLogger(name)
+    if not logger.handlers:
+        handler = logging.StreamHandler()
+        rank = os.environ.get("DILOCO_WORLD_RANK", "0")
+        handler.setFormatter(logging.Formatter(f"[rank {rank}] {_LOG_FORMAT}"))
+        logger.addHandler(handler)
+        logger.setLevel(os.environ.get("OPENDILOCO_TPU_LOG_LEVEL", "INFO"))
+        logger.propagate = False
+    return logger

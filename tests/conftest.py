@@ -1,0 +1,44 @@
+"""Test env: force a virtual 8-device CPU platform before jax initializes.
+
+Multi-chip hardware is not available in CI; sharding correctness is tested on
+a CPU mesh (mirrors the reference's loopback-swarm strategy,
+tests/test_diloco_hivemind.py:42-50 -- multi-node simulated locally).
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+# the axon site hook latches jax_platforms at interpreter startup, before
+# this conftest runs -- force it back via the config API
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)
+
+
+@pytest.fixture(scope="session")
+def tiny_cfg():
+    from opendiloco_tpu.models.llama import LlamaConfig
+
+    return LlamaConfig(
+        vocab_size=256,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        max_position_embeddings=128,
+    )

@@ -1,0 +1,105 @@
+"""Model unit tests: shapes, causality, HF interop, torch parity oracle."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from opendiloco_tpu.models import hf_io
+from opendiloco_tpu.models.llama import (
+    LlamaConfig,
+    causal_lm_loss,
+    forward,
+    init_params,
+    shapes,
+)
+
+
+def test_config_registry_loads():
+    for name in ["2m", "14m", "60m", "150m", "1b"]:
+        cfg = hf_io.load_config(name)
+        assert cfg.hidden_size > 0
+    cfg = hf_io.load_config("configs/config_150m.json")
+    assert cfg.hidden_size == 1024 and cfg.num_hidden_layers == 12
+    cfg1b = hf_io.load_config("1b")
+    assert cfg1b.kv_heads == 4 and cfg1b.num_attention_heads == 32
+
+
+def test_init_params_shapes(tiny_cfg):
+    params = init_params(jax.random.key(0), tiny_cfg)
+    want = jax.tree.map(lambda s: s.shape, shapes(tiny_cfg))
+    got = jax.tree.map(lambda x: x.shape, params)
+    assert got == want
+    # norms init to ones
+    assert np.allclose(params["final_norm"], 1.0)
+
+
+def test_forward_shape_and_dtype(tiny_cfg):
+    params = init_params(jax.random.key(0), tiny_cfg)
+    ids = jnp.arange(2 * 16, dtype=jnp.int32).reshape(2, 16) % tiny_cfg.vocab_size
+    logits = forward(params, ids, tiny_cfg)
+    assert logits.shape == (2, 16, tiny_cfg.vocab_size)
+    assert logits.dtype == jnp.float32
+    assert bool(jnp.all(jnp.isfinite(logits)))
+
+
+def test_causality(tiny_cfg):
+    """Changing a suffix token must not change prefix logits."""
+    params = init_params(jax.random.key(1), tiny_cfg)
+    ids = jax.random.randint(jax.random.key(2), (1, 32), 0, tiny_cfg.vocab_size)
+    logits_a = forward(params, ids, tiny_cfg, compute_dtype=jnp.float32)
+    ids_b = ids.at[0, 20].set((ids[0, 20] + 7) % tiny_cfg.vocab_size)
+    logits_b = forward(params, ids_b, tiny_cfg, compute_dtype=jnp.float32)
+    np.testing.assert_allclose(
+        np.asarray(logits_a[0, :20]), np.asarray(logits_b[0, :20]), atol=1e-5
+    )
+    assert not np.allclose(np.asarray(logits_a[0, 20:]), np.asarray(logits_b[0, 20:]))
+
+
+def test_loss_masking():
+    logits = jnp.zeros((1, 4, 8))
+    labels = jnp.array([[1, 2, -100, 3]])
+    loss = causal_lm_loss(logits, labels)
+    # uniform logits -> loss == log(8) regardless of masking correctness;
+    # use a biased logit at the masked position to detect leakage
+    biased = logits.at[0, 1, :].set(jnp.arange(8.0) * 100)
+    loss_biased = causal_lm_loss(biased, labels)  # position 1 predicts label[2]=-100
+    np.testing.assert_allclose(float(loss), float(loss_biased), atol=1e-5)
+
+
+def test_hf_roundtrip(tmp_path, tiny_cfg):
+    params = init_params(jax.random.key(3), tiny_cfg)
+    hf_io.save_params(params, tiny_cfg, str(tmp_path / "m"))
+    cfg2 = hf_io.load_config(str(tmp_path / "m"))
+    assert cfg2.hidden_size == tiny_cfg.hidden_size
+    params2 = hf_io.load_params(str(tmp_path / "m"))
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(np.asarray(a), np.asarray(b)),
+        params,
+        params2,
+    )
+
+
+@pytest.mark.slow
+def test_torch_parity(tmp_path, tiny_cfg):
+    """Oracle: our forward matches HF transformers LlamaForCausalLM on the
+    same safetensors weights (float32, tiny model)."""
+    torch = pytest.importorskip("torch")
+    from transformers import AutoModelForCausalLM
+
+    params = init_params(jax.random.key(4), tiny_cfg)
+    model_dir = str(tmp_path / "parity")
+    hf_io.save_params(params, tiny_cfg, model_dir)
+
+    hf_model = AutoModelForCausalLM.from_pretrained(model_dir)
+    hf_model.eval()
+
+    ids = np.random.default_rng(0).integers(0, tiny_cfg.vocab_size, (2, 24))
+    with torch.no_grad():
+        ref = hf_model(torch.tensor(ids)).logits.numpy()
+    ours = np.asarray(
+        forward(params, jnp.asarray(ids, jnp.int32), tiny_cfg, compute_dtype=jnp.float32)
+    )
+    # f32 trig/accumulation-order noise amplifies through the residual
+    # stream; verified elementwise at ~1e-5 per-layer (see git history)
+    np.testing.assert_allclose(ours, ref, atol=5e-3, rtol=5e-2)
