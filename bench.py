@@ -1,0 +1,97 @@
+"""Headline benchmark: inner-loop training throughput on llama-150m.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The reference publishes no in-tree numbers (BASELINE.md); the driver-specified
+north-star is >=40% inner-loop MFU on llama-150m (BASELINE.json). We report
+tokens/sec/chip and vs_baseline = achieved_MFU / 0.40.
+"""
+
+import json
+import time
+
+import numpy as np
+
+
+def peak_flops_per_chip() -> float:
+    """bf16 peak of the local accelerator."""
+    import jax
+
+    kind = jax.devices()[0].device_kind.lower()
+    if "v5 lite" in kind or "v5e" in kind:
+        return 197e12
+    if "v4" in kind:
+        return 275e12
+    if "v5p" in kind or "v5" in kind:
+        return 459e12
+    if "v6" in kind:
+        return 918e12
+    return 197e12  # unknown: assume v5e
+
+
+def model_flops_per_token(cfg, seq: int) -> float:
+    """fwd+bwd matmul FLOPs per token: 6*N_matmul + causal attention term."""
+    n_matmul = cfg.num_params() - cfg.vocab_size * cfg.hidden_size  # drop embed
+    attn = 6 * cfg.num_hidden_layers * cfg.hidden_size * seq  # causal: 12*L*D*T/2
+    return 6 * n_matmul + attn
+
+
+def main():
+    import jax
+
+    from opendiloco_tpu.models.hf_io import get_model
+    from opendiloco_tpu.parallel.mesh import build_mesh
+    from opendiloco_tpu.trainer import InnerTrainer, TrainerConfig
+
+    cfg, _ = get_model("150m")
+    seq, per_dev_bs, accum = 1024, 16, 1
+    n_chips = len(jax.devices())
+    bs = per_dev_bs * n_chips
+
+    plan = build_mesh("NO_SHARD")
+    tc = TrainerConfig(
+        lr=4e-4, warmup_steps=10, total_steps=1000, precision="bf16-mixed",
+        attn_impl="pallas", remat=True,
+    )
+    trainer = InnerTrainer(cfg, tc, plan)
+    state = trainer.init_state(jax.random.key(0))
+
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, cfg.vocab_size, (bs, seq)).astype(np.int32)
+    batch = trainer.shard_batch(ids, ids.copy(), accum=accum)
+
+    for _ in range(3):  # warmup/compile
+        state, m = trainer.train_step(state, batch)
+    float(m["loss"])  # scalar fetch: forces execution through the tunnel
+
+    n_steps = 20
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        state, m = trainer.train_step(state, batch)
+    float(m["loss"])
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = n_steps * bs * seq / dt
+    tokens_per_sec_chip = tokens_per_sec / n_chips
+    mfu = tokens_per_sec_chip * model_flops_per_token(cfg, seq) / peak_flops_per_chip()
+
+    print(
+        json.dumps(
+            {
+                "metric": "llama-150m inner-loop throughput (seq 1024, bf16)",
+                "value": round(tokens_per_sec_chip, 1),
+                "unit": "tokens/sec/chip",
+                "vs_baseline": round(mfu / 0.40, 4),
+                "extra": {
+                    "mfu": round(mfu, 4),
+                    "chips": n_chips,
+                    "device": jax.devices()[0].device_kind,
+                    "final_loss": round(float(m["loss"]), 4),
+                },
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

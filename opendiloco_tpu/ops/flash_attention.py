@@ -1,0 +1,298 @@
+"""Pallas TPU flash attention (forward + backward), causal, GQA-aware.
+
+TPU-native replacement for the reference's optional FlashAttention-2 CUDA
+kernels (README.md:41-47, train_fsdp.py:107). FlashAttention-2-style online
+softmax: never materializes the [T, T] score matrix; scores and softmax
+statistics accumulate in float32 on the MXU/VPU while q/k/v stream through
+VMEM tiles.
+
+Layout: kernels run per (batch, q-head, q-block) grid point with the full
+K/V for that head resident in VMEM (fine up to ~8k seq; longer sequences use
+ring attention over the sp mesh axis, ops/ring_attention.py). GQA is handled
+in the BlockSpec index maps (q-head h reads kv-head h // rep) -- KV is never
+materialized at q-head width in the forward pass.
+
+Backward follows the standard FA2 recompute scheme: delta = rowsum(dO * O),
+one kernel for dq (loop over k blocks), one for dk/dv (loop over q blocks,
+accumulating over the rep q-heads of each kv head).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+_NEG_INF = float(-1e30)
+
+
+def _pick_block(t: int, preferred: int = 512) -> int:
+    for b in (preferred, 256, 128):
+        if t % b == 0:
+            return b
+    return 0  # caller falls back to XLA attention
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int, scale: float, causal: bool):
+    # q_ref: [block_q, d]; k_ref/v_ref: [t, d]; lse_ref: [1, block_q]
+    block_q, d = q_ref.shape
+    t = k_ref.shape[0]
+    qi = pl.program_id(2)
+    q = q_ref[:].astype(jnp.float32) * scale
+
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+
+    def body(ki, carry):
+        m_prev, l_prev, acc = carry
+        k_blk = k_ref[pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [block_q, block_k]
+        if causal:
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m_prev - m_new)
+        l_new = l_prev * corr + jnp.sum(p, axis=1, keepdims=True)
+        acc = acc * corr + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        return m_new, l_new, acc
+
+    num_k = t // block_k if not causal else (qi * block_q + block_q) // block_k
+    m0 = jnp.full((block_q, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, num_k, body, (m0, l0, acc0))
+
+    l_safe = jnp.where(l == 0, 1.0, l)
+    o_ref[:] = (acc / l_safe).astype(o_ref.dtype)
+    lse_ref[:] = (m + jnp.log(l_safe)).reshape(1, block_q)
+
+
+def _fwd(q, k, v, *, block_q: int, block_k: int, causal: bool):
+    """q: [B, Hq, T, D]; k/v: [B, Hkv, T, D] -> (out [B, Hq, T, D], lse [B, Hq, 1, T])."""
+    b, hq, t, d = q.shape
+    hkv = k.shape[1]
+    rep = hq // hkv
+    scale = d**-0.5
+
+    grid = (b, hq, t // block_q)
+    out, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, block_k=block_k, scale=scale, causal=causal),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((None, None, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((None, None, t, d), lambda bi, hi, qi: (bi, hi // rep, 0, 0)),
+            pl.BlockSpec((None, None, t, d), lambda bi, hi, qi: (bi, hi // rep, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, None, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((None, None, 1, block_q), lambda bi, hi, qi: (bi, hi, 0, qi)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct((b, hq, 1, t), jnp.float32),
+        ],
+    )(q, k, v)
+    return out, lse
+
+
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *, block_k, scale, causal):
+    # q/do/dq: [block_q, d]; k/v: [t, d]; lse/delta: [1, block_q]
+    block_q, d = q_ref.shape
+    t = k_ref.shape[0]
+    qi = pl.program_id(2)
+    q = q_ref[:].astype(jnp.float32)
+    do = do_ref[:].astype(jnp.float32)
+    lse = lse_ref[:].reshape(block_q, 1)
+    delta = delta_ref[:].reshape(block_q, 1)
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+
+    def body(ki, dq):
+        k_blk = k_ref[pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+        s = scale * jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        if causal:
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        p = jnp.exp(s - lse)
+        dp = jax.lax.dot_general(
+            do, v_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta)
+        return dq + scale * jax.lax.dot_general(
+            ds, k_blk, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    num_k = t // block_k if not causal else (qi * block_q + block_q) // block_k
+    dq = jax.lax.fori_loop(0, num_k, body, jnp.zeros((block_q, d), jnp.float32))
+    dq_ref[:] = dq.astype(dq_ref.dtype)
+
+
+def _dkv_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, *, block_q, scale, causal, rep
+):
+    # grid point: (batch, kv-head, k-block). q/do: [rep, t, d];
+    # k/v/dk/dv: [block_k, d]; lse/delta: [rep, t]
+    block_k, d = k_ref.shape
+    t = q_ref.shape[1]
+    ki = pl.program_id(2)
+    k_blk = k_ref[:].astype(jnp.float32)
+    v_blk = v_ref[:].astype(jnp.float32)
+    k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+
+    def head_body(r, carry):
+        def body(qj, carry2):
+            dk, dv = carry2
+            q_blk = q_ref[r, pl.ds(qj * block_q, block_q), :].astype(jnp.float32)
+            do_blk = do_ref[r, pl.ds(qj * block_q, block_q), :].astype(jnp.float32)
+            lse_blk = lse_ref[r, pl.ds(qj * block_q, block_q)].reshape(block_q, 1)
+            delta_blk = delta_ref[r, pl.ds(qj * block_q, block_q)].reshape(block_q, 1)
+            s = scale * jax.lax.dot_general(
+                q_blk, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+            )
+            if causal:
+                q_pos = qj * block_q + jax.lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 0
+                )
+                s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+            p = jnp.exp(s - lse_blk)
+            dv = dv + jax.lax.dot_general(
+                p, do_blk, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            )
+            dp = jax.lax.dot_general(
+                do_blk, v_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+            )
+            ds = p * (dp - delta_blk)
+            dk = dk + scale * jax.lax.dot_general(
+                ds, q_blk, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            )
+            return dk, dv
+
+        # causal: only q blocks at or after this k block contribute
+        q_start = (ki * block_k) // block_q if causal else 0
+        return jax.lax.fori_loop(q_start, t // block_q, body, carry)
+
+    dk0 = jnp.zeros((block_k, d), jnp.float32)
+    dv0 = jnp.zeros((block_k, d), jnp.float32)
+    dk, dv = jax.lax.fori_loop(0, rep, head_body, (dk0, dv0))
+    dk_ref[:] = dk.astype(dk_ref.dtype)
+    dv_ref[:] = dv.astype(dv_ref.dtype)
+
+
+def _bwd(block_q, block_k, causal, res, dout):
+    q, k, v, out, lse = res
+    b, hq, t, d = q.shape
+    hkv = k.shape[1]
+    rep = hq // hkv
+    scale = d**-0.5
+
+    delta = jnp.sum(
+        dout.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
+    ).reshape(b, hq, 1, t)
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, block_k=block_k, scale=scale, causal=causal),
+        grid=(b, hq, t // block_q),
+        in_specs=[
+            pl.BlockSpec((None, None, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((None, None, t, d), lambda bi, hi, qi: (bi, hi // rep, 0, 0)),
+            pl.BlockSpec((None, None, t, d), lambda bi, hi, qi: (bi, hi // rep, 0, 0)),
+            pl.BlockSpec((None, None, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((None, None, 1, block_q), lambda bi, hi, qi: (bi, hi, 0, qi)),
+            pl.BlockSpec((None, None, 1, block_q), lambda bi, hi, qi: (bi, hi, 0, qi)),
+        ],
+        out_specs=pl.BlockSpec((None, None, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+    )(q, k, v, dout, lse, delta)
+
+    # dk/dv: group q by kv head: [b, hkv, rep, t, d]
+    q_g = q.reshape(b, hkv, rep, t, d)
+    do_g = dout.reshape(b, hkv, rep, t, d)
+    lse_g = lse.reshape(b, hkv, rep, t)
+    delta_g = delta.reshape(b, hkv, rep, t)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _dkv_kernel, block_q=block_q, scale=scale, causal=causal, rep=rep
+        ),
+        grid=(b, hkv, t // block_k),
+        in_specs=[
+            pl.BlockSpec((None, None, rep, t, d), lambda bi, hi, ki: (bi, hi, 0, 0, 0)),
+            pl.BlockSpec((None, None, block_k, d), lambda bi, hi, ki: (bi, hi, ki, 0)),
+            pl.BlockSpec((None, None, block_k, d), lambda bi, hi, ki: (bi, hi, ki, 0)),
+            pl.BlockSpec((None, None, rep, t, d), lambda bi, hi, ki: (bi, hi, 0, 0, 0)),
+            pl.BlockSpec((None, None, rep, t), lambda bi, hi, ki: (bi, hi, 0, 0)),
+            pl.BlockSpec((None, None, rep, t), lambda bi, hi, ki: (bi, hi, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, None, block_k, d), lambda bi, hi, ki: (bi, hi, ki, 0)),
+            pl.BlockSpec((None, None, block_k, d), lambda bi, hi, ki: (bi, hi, ki, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(k.shape, k.dtype),
+            jax.ShapeDtypeStruct(v.shape, v.dtype),
+        ],
+    )(q_g, k, v, do_g, lse_g, delta_g)
+
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# public entry
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash(q, k, v, block_q, block_k, causal):
+    out, _ = _fwd(q, k, v, block_q=block_q, block_k=block_k, causal=causal)
+    return out
+
+
+def _flash_fwd(q, k, v, block_q, block_k, causal):
+    out, lse = _fwd(q, k, v, block_q=block_q, block_k=block_k, causal=causal)
+    return out, (q, k, v, out, lse)
+
+
+_flash.defvjp(_flash_fwd, _bwd)
+
+
+def flash_attention(
+    q: jax.Array, k: jax.Array, v: jax.Array, *, causal: bool = True
+) -> jax.Array:
+    """[B, T, H, D] attention via the Pallas kernel; falls back to XLA for
+    shapes the kernel doesn't tile (T not a multiple of 128)."""
+    b, t, hq, d = q.shape
+    block_q = _pick_block(t)
+    block_k = _pick_block(t, 256)
+    if block_q == 0 or block_k == 0 or d % 8 != 0:
+        from opendiloco_tpu.ops.attention import xla_attention
+
+        return xla_attention(q, k, v, causal=causal)
+    # kernel layout is [B, H, T, D]
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    out = _flash(qt, kt, vt, block_q, block_k, causal)
+    return out.transpose(0, 2, 1, 3)

@@ -1,0 +1,136 @@
+"""Ring attention: causal attention over a sequence-parallel mesh axis.
+
+Long-context capability the reference lacks entirely (SURVEY.md §5.7: no
+SP/CP anywhere; seq_length is a scalar config, train_fsdp.py:111). On TPU it
+is first-class: the sequence dim shards over the "sp" mesh axis, each device
+holds one contiguous chunk of q/k/v, and K/V chunks rotate around the ring
+via ``jax.lax.ppermute`` while flash-style online-softmax statistics
+(m, l, acc) accumulate in float32. Peak memory per device is O(T/sp * T/sp)
+per rotation step, never the full [T, T].
+
+Causality falls out of global position masks: a K/V chunk from a later ring
+position contributes nothing (its probabilities underflow to exp(-inf)=0),
+chunks from earlier positions contribute fully, and the diagonal chunk is
+triangle-masked.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = float(-1e30)
+
+# mesh registry: the trainer configures this so model code can stay
+# mesh-agnostic (set by InnerTrainer when attn_impl == "ring")
+_RING_MESH = None
+_RING_AXIS = "sp"
+
+
+def configure_ring(mesh, axis: str = "sp") -> None:
+    global _RING_MESH, _RING_AXIS
+    _RING_MESH = mesh
+    _RING_AXIS = axis
+
+
+def _block_attn(q, k, v, q_pos, k_pos, m, l, acc, *, causal):
+    """One online-softmax accumulation step.
+
+    q: [B, Tq, H, D]; k/v: [B, Tk, H, D]; positions are global indices.
+    m/l: [B, H, Tq, 1]; acc: [B, H, Tq, D] (all float32).
+    """
+    d = q.shape[-1]
+    s = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * (d**-0.5)
+    if causal:
+        mask = q_pos[:, None] >= k_pos[None, :]
+        s = jnp.where(mask[None, None], s, _NEG_INF)
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+    p = jnp.exp(s - m_new)
+    corr = jnp.exp(m - m_new)
+    l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+    acc_new = acc * corr + jnp.einsum(
+        "bhqk,bkhd->bhqd", p, v.astype(jnp.float32), preferred_element_type=jnp.float32
+    )
+    return m_new, l_new, acc_new
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str = "sp",
+    causal: bool = True,
+) -> jax.Array:
+    """Must run inside shard_map with the sequence dim sharded on axis_name.
+
+    q/k/v: local chunks [B, T_local, H, D] -> out [B, T_local, H, D].
+    """
+    b, tl, hq, d = q.shape
+    hkv = k.shape[2]
+    if hkv != hq:
+        rep = hq // hkv
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+
+    idx = jax.lax.axis_index(axis_name)
+    n = jax.lax.axis_size(axis_name)
+    qf = q.astype(jnp.float32)
+    q_pos = idx * tl + jnp.arange(tl, dtype=jnp.int32)
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(carry, i):
+        k_cur, v_cur, m, l, acc = carry
+        src = (idx - i) % n  # whose chunk we hold at this rotation
+        k_pos = src * tl + jnp.arange(tl, dtype=jnp.int32)
+        m, l, acc = _block_attn(
+            qf, k_cur.astype(jnp.float32), v_cur, q_pos, k_pos, m, l, acc,
+            causal=causal,
+        )
+        # rotate for the next step (skipped result on the last iteration)
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        return (k_nxt, v_nxt, m, l, acc), None
+
+    m0 = jnp.full((b, hq, tl, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, hq, tl, 1), jnp.float32)
+    acc0 = jnp.zeros((b, hq, tl, d), jnp.float32)
+    # stats become device-varying after the first accumulation step; the scan
+    # carry must have that type from the start
+    m0, l0, acc0 = jax.lax.pcast((m0, l0, acc0), axis_name, to="varying")
+    (k, v, m, l, acc), _ = jax.lax.scan(
+        step, (k, v, m0, l0, acc0), jnp.arange(n), length=n
+    )
+
+    l_safe = jnp.where(l == 0, 1.0, l)
+    out = (acc / l_safe).astype(q.dtype)  # [B, H, Tl, D]
+    return out.transpose(0, 2, 1, 3)
+
+
+def ring_attention_auto(q: jax.Array, k: jax.Array, v: jax.Array) -> jax.Array:
+    """Wrap ring_attention in a shard_map over the configured mesh's sp axis.
+
+    Callable from inside the (jit-compiled) model forward: batch/head dims
+    stay auto-sharded, only the sequence axis is manual.
+    """
+    if _RING_MESH is None:
+        raise RuntimeError(
+            "ring attention needs configure_ring(mesh) (the trainer does this "
+            "when attn_impl='ring')"
+        )
+    P = jax.sharding.PartitionSpec
+    spec = P(None, _RING_AXIS, None, None)
+    fn = jax.shard_map(
+        functools.partial(ring_attention, axis_name=_RING_AXIS, causal=True),
+        mesh=_RING_MESH,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        axis_names={_RING_AXIS},
+    )
+    return fn(q, k, v)

@@ -83,6 +83,11 @@ class InnerTrainer:
         self.optimizer = make_inner_optimizer(tc)
         self.schedule = make_schedule(tc)
 
+        if tc.attn_impl == "ring":
+            from opendiloco_tpu.ops.ring_attention import configure_ring
+
+            configure_ring(plan.mesh, plan.sp_axis or "sp")
+
         self.p_specs = param_specs(model_cfg, plan, for_params=True)
         params_shapes = jax.eval_shape(
             functools.partial(init_params, cfg=model_cfg), jax.random.key(0)

@@ -92,3 +92,23 @@ def test_lr_schedule(tiny_cfg):
     # monotone decay after warmup
     vals = [float(sched(s)) for s in range(10, 100, 10)]
     assert all(a >= b for a, b in zip(vals, vals[1:]))
+
+
+def test_train_step_with_ring_attention(tiny_cfg):
+    """Full train step with sequence parallelism (sp=4) matches NO_SHARD xla."""
+    ref, _, _ = run_steps(tiny_cfg, "NO_SHARD")
+    tc = TrainerConfig(
+        lr=1e-3, warmup_steps=2, total_steps=100, precision="fp32", remat=False,
+        attn_impl="ring",
+    )
+    plan = build_mesh("NO_SHARD", sp_size=4)
+    trainer = InnerTrainer(tiny_cfg, tc, plan)
+    state = trainer.init_state(jax.random.key(0))
+    rng = np.random.default_rng(0)
+    losses = []
+    for _ in range(4):
+        ids, labels = make_batch(rng, tiny_cfg.vocab_size)
+        batch = trainer.shard_batch(ids, labels, accum=2)
+        state, metrics = trainer.train_step(state, batch)
+        losses.append(float(metrics["loss"]))
+    np.testing.assert_allclose(np.array(losses), ref, rtol=2e-4, atol=2e-4)
