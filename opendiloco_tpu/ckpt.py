@@ -1,0 +1,254 @@
+"""Checkpoint/resume: sharded device state via Orbax + host-side global state.
+
+Parity with the reference's ckpt_utils.py:
+- layout ``{path}/model_step_{N}/diloco_rank_{R}/`` (ckpt_utils.py:196-197)
+- sharded model+inner-optimizer state (torch-DCP equivalent -> Orbax)
+- per-worker dataloader state (``__{rank}_0.pt`` -> ``dataloader.json``)
+- ``global_state_dict.pt`` (outer optimizer, scheduler position, loss) ->
+  ``global_state.npz`` (numpy, no pickle)
+- latest-checkpoint discovery by step suffix (get_resume_info,
+  ckpt_utils.py:23-45), top-k retention GC (:170-179), and a path
+  writability probe (:182-193)
+
+GCS: Orbax writes gs:// natively; the small host-side files go through
+fsspec when the path is remote.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import shutil
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+from opendiloco_tpu.utils.logger import get_text_logger
+
+log = get_text_logger(__name__)
+
+_STEP_RE = re.compile(r"model_step_(\d+)$")
+
+
+def _is_remote(path: str) -> bool:
+    return "://" in path
+
+
+def _fs_open(path: str, mode: str):
+    if _is_remote(path):
+        import fsspec
+
+        return fsspec.open(path, mode).open()
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    return open(path, mode)
+
+
+def _listdir(path: str) -> list[str]:
+    if _is_remote(path):
+        import fsspec
+
+        fs, _, (p,) = fsspec.get_fs_token_paths(path)
+        try:
+            return [x.rstrip("/").split("/")[-1] for x in fs.ls(p)]
+        except FileNotFoundError:
+            return []
+    try:
+        return os.listdir(path)
+    except FileNotFoundError:
+        return []
+
+
+def ckpt_dir(path: str, step: int, diloco_rank: Optional[int] = None) -> str:
+    d = f"{path.rstrip('/')}/model_step_{step}"
+    if diloco_rank is not None:
+        d = f"{d}/diloco_rank_{diloco_rank}"
+    return d
+
+
+def check_checkpoint_path_access(path: str, rank: int = 0) -> None:
+    """Fail fast on unwritable checkpoint destinations (ckpt_utils.py:182-193)."""
+    probe = f"{path.rstrip('/')}/.write_probe_{rank}"
+    with _fs_open(probe, "w") as f:
+        f.write("ok")
+    if _is_remote(probe):
+        import fsspec
+
+        fs, _, (p,) = fsspec.get_fs_token_paths(probe)
+        fs.rm(p)
+    else:
+        os.remove(probe)
+
+
+# ---------------------------------------------------------------------------
+# save / load
+# ---------------------------------------------------------------------------
+
+
+def save_checkpoint(
+    path: str,
+    step: int,
+    state: dict,
+    *,
+    diloco_rank: Optional[int] = None,
+    diloco_state: Optional[dict] = None,
+    dataloader_state: Optional[dict] = None,
+    extra: Optional[dict[str, Any]] = None,
+) -> str:
+    """Write one worker's checkpoint; returns the checkpoint directory."""
+    import orbax.checkpoint as ocp
+
+    d = ckpt_dir(path, step, diloco_rank)
+    # device state (params + inner opt + step), sharded-aware
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(
+            os.path.abspath(f"{d}/device_state") if not _is_remote(d) else f"{d}/device_state",
+            state,
+            force=True,
+        )
+
+    if diloco_state is not None:
+        meta, blob = _pack_tree(diloco_state)
+        with _fs_open(f"{d}/diloco_state.bin", "wb") as f:
+            f.write(blob)
+        with _fs_open(f"{d}/diloco_state.json", "w") as f:
+            json.dump(meta, f)
+    if dataloader_state is not None:
+        with _fs_open(f"{d}/dataloader.json", "w") as f:
+            json.dump(_jsonify(dataloader_state), f)
+    if extra:
+        with _fs_open(f"{d}/global_state.json", "w") as f:
+            json.dump(_jsonify(extra), f)
+    log.info("saved checkpoint step %d -> %s", step, d)
+    return d
+
+
+def load_checkpoint(
+    d: str,
+    abstract_state: dict,
+) -> tuple[dict, Optional[dict], Optional[dict], dict]:
+    """Restore (device_state, diloco_state, dataloader_state, extra) from a
+    checkpoint dir. ``abstract_state`` supplies shapes/shardings (from
+    InnerTrainer) so arrays restore onto the right mesh."""
+    import orbax.checkpoint as ocp
+
+    with ocp.StandardCheckpointer() as ckptr:
+        target = jax.tree.map(
+            lambda x: ocp.utils.to_shape_dtype_struct(x)
+            if hasattr(x, "sharding")
+            else x,
+            abstract_state,
+        )
+        state = ckptr.restore(
+            os.path.abspath(f"{d}/device_state") if not _is_remote(d) else f"{d}/device_state",
+            target,
+        )
+
+    diloco_state = None
+    if _exists(f"{d}/diloco_state.json"):
+        with _fs_open(f"{d}/diloco_state.json", "r") as f:
+            meta = json.load(f)
+        with _fs_open(f"{d}/diloco_state.bin", "rb") as f:
+            blob = f.read()
+        diloco_state = _unpack_tree(meta, blob)
+
+    dataloader_state = None
+    if _exists(f"{d}/dataloader.json"):
+        with _fs_open(f"{d}/dataloader.json", "r") as f:
+            dataloader_state = json.load(f)
+
+    extra = {}
+    if _exists(f"{d}/global_state.json"):
+        with _fs_open(f"{d}/global_state.json", "r") as f:
+            extra = json.load(f)
+    return state, diloco_state, dataloader_state, extra
+
+
+def _exists(path: str) -> bool:
+    if _is_remote(path):
+        import fsspec
+
+        fs, _, (p,) = fsspec.get_fs_token_paths(path)
+        return fs.exists(p)
+    return os.path.exists(path)
+
+
+# ---------------------------------------------------------------------------
+# discovery / retention
+# ---------------------------------------------------------------------------
+
+
+def get_resume_info(
+    resume: Optional[str | bool], ckpt_path: str, diloco_rank: Optional[int] = None
+) -> tuple[bool, Optional[str], int]:
+    """(should_resume, ckpt_dir, step) -- ckpt_utils.py:23-45 semantics:
+    resume=True discovers the latest step under ckpt_path; a string is an
+    explicit checkpoint directory."""
+    if not resume:
+        return False, None, 0
+    if isinstance(resume, str) and resume not in ("True", "true"):
+        m = _STEP_RE.search(resume.rstrip("/").replace(f"/diloco_rank_{diloco_rank}", ""))
+        step = int(m.group(1)) if m else 0
+        d = resume if diloco_rank is None else f"{resume.rstrip('/')}/diloco_rank_{diloco_rank}"
+        return True, d, step
+    steps = sorted(
+        int(m.group(1))
+        for m in (_STEP_RE.match(x) for x in _listdir(ckpt_path))
+        if m
+    )
+    if not steps:
+        return False, None, 0
+    return True, ckpt_dir(ckpt_path, steps[-1], diloco_rank), steps[-1]
+
+
+def delete_old_checkpoints(ckpt_path: str, topk: Optional[int]) -> None:
+    """Keep only the most recent ``topk`` checkpoints (ckpt_utils.py:170-179)."""
+    if not topk:
+        return
+    steps = sorted(
+        int(m.group(1))
+        for m in (_STEP_RE.match(x) for x in _listdir(ckpt_path))
+        if m
+    )
+    for step in steps[:-topk]:
+        d = ckpt_dir(ckpt_path, step)
+        log.info("deleting old checkpoint %s", d)
+        if _is_remote(d):
+            import fsspec
+
+            fs, _, (p,) = fsspec.get_fs_token_paths(d)
+            fs.rm(p, recursive=True)
+        else:
+            shutil.rmtree(d, ignore_errors=True)
+
+
+# ---------------------------------------------------------------------------
+# numpy tree packing (for diloco master/outer-opt state; no pickle)
+# ---------------------------------------------------------------------------
+
+
+def _pack_tree(tree: dict) -> tuple[dict, bytes]:
+    from opendiloco_tpu.diloco.tcp import serialize_state
+
+    return serialize_state(tree)
+
+
+def _unpack_tree(meta: dict, blob: bytes) -> dict:
+    from opendiloco_tpu.diloco.tcp import deserialize_state
+
+    return deserialize_state(meta, blob)
+
+
+def _jsonify(obj: Any) -> Any:
+    if isinstance(obj, dict):
+        return {k: _jsonify(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_jsonify(v) for v in obj]
+    if isinstance(obj, (np.integer,)):
+        return int(obj)
+    if isinstance(obj, (np.floating,)):
+        return float(obj)
+    if isinstance(obj, np.ndarray):
+        return {"__ndarray__": obj.tolist(), "dtype": str(obj.dtype)}
+    return obj

@@ -27,6 +27,12 @@ class CkptConfig(BaseModel):
     # checkpoint directory; None/False -> fresh start.
     resume: Optional[str | bool] = None
 
+    @field_validator("interval", "topk", mode="before")
+    @classmethod
+    def _no_flag_means_none(cls, v: Any) -> Any:
+        # `--no-ckpt.interval` parses to False; treat as "disabled"
+        return None if v is False else v
+
 
 class DilocoConfig(BaseModel):
     """Outer-loop (DiLoCo) configuration.
@@ -74,9 +80,10 @@ class DilocoConfig(BaseModel):
     @field_validator("initial_peers", mode="before")
     @classmethod
     def _coerce_peers(cls, v: Any) -> Any:
-        # reference coerces scalar -> list (train_fsdp.py:95-101)
+        # reference coerces scalar -> list (train_fsdp.py:95-101);
+        # comma-separated strings list multiple bootstrap peers
         if isinstance(v, str):
-            return [v]
+            return [x.strip() for x in v.split(",") if x.strip()]
         return v
 
 
@@ -151,12 +158,7 @@ def _set_nested(tree: dict, dotted: str, value: Any) -> None:
         if not isinstance(node, dict):
             raise ValueError(f"flag {dotted!r} conflicts with earlier scalar flag")
     leaf = keys[-1]
-    if leaf in node and isinstance(node[leaf], list):
-        node[leaf].append(value)
-    elif leaf in node:
-        node[leaf] = [node[leaf], value]
-    else:
-        node[leaf] = value
+    node[leaf] = value  # repeated flags: last one wins
 
 
 def parse_argv(argv: Optional[list[str]] = None) -> dict:
@@ -165,7 +167,9 @@ def parse_argv(argv: Optional[list[str]] = None) -> dict:
     Semantics follow the reference's pydantic_config ``parse_argv``
     (train_fsdp.py:525): dashes in key names normalize to underscores,
     ``--no-flag`` sets False, a bare ``--flag`` followed by another flag (or
-    end of argv) sets True, repeated flags accumulate into a list.
+    end of argv) sets True, repeated flags keep the last value (so test
+    harnesses can append overrides), and list-valued fields take
+    comma-separated strings.
     """
     if argv is None:
         argv = sys.argv[1:]

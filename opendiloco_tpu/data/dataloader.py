@@ -1,0 +1,272 @@
+"""Host-side data pipeline: streaming C4 / fake data, resumable, galaxy-sharded.
+
+Parity targets:
+- streaming ``allenai/c4`` with the Mistral-7B tokenizer, pad="</s>"
+  (reference: train_fsdp.py:136-149,218-219)
+- two-level galaxy x host sharding via split-by-node (train_fsdp.py:151-159)
+- ``FakeTokenizedDataset`` for tests/benchmarks (utils.py:155-167)
+- resumable iteration state (torchdata StatefulDataLoader equivalent,
+  ckpt_utils.py:83-87) -- HF IterableDataset state_dict when available,
+  deterministic skip-ahead otherwise
+- labels = input_ids with pad masked to -100 (DataCollatorForLanguageModeling
+  mlm=False semantics)
+
+TPU-specific design: batches are plain numpy on host; a background prefetch
+thread keeps a small queue full so the jit step never waits on tokenization.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Iterator, Optional
+
+import numpy as np
+
+IGNORE_INDEX = -100
+
+
+class _ProducerError:
+    """Sentinel carrying a prefetch-thread failure to the consumer."""
+
+    def __init__(self, error: BaseException):
+        self.error = error
+
+
+class FakeTokenizedDataset:
+    """Deterministic infinite stream of random token sequences
+    (reference: utils.py:155-167)."""
+
+    def __init__(self, seq_length: int, vocab_size: int, seed: int = 0):
+        assert vocab_size > 3, "vocab_size must be greater than 3"
+        self.seq_length = seq_length
+        self.vocab_size = vocab_size
+        self.seed = seed
+        self.samples_seen = 0
+
+    def __iter__(self) -> Iterator[dict[str, np.ndarray]]:
+        while True:
+            rng = np.random.default_rng((self.seed, self.samples_seen))
+            ids = rng.integers(3, self.vocab_size, self.seq_length).astype(np.int32)
+            self.samples_seen += 1
+            yield {"input_ids": ids, "labels": ids.copy()}
+
+    def state_dict(self) -> dict:
+        return {"samples_seen": self.samples_seen, "seed": self.seed}
+
+    def load_state_dict(self, sd: dict) -> None:
+        self.samples_seen = sd["samples_seen"]
+        self.seed = sd["seed"]
+
+
+class HFStreamingDataset:
+    """Streaming HF dataset -> fixed-length tokenized samples."""
+
+    def __init__(
+        self,
+        dataset_name_or_paths: str,
+        tokenizer_name: str,
+        seq_length: int,
+        *,
+        streaming: bool = True,
+        split: str = "train",
+        world_rank: int = 0,
+        galaxy_size: int = 1,
+        process_index: int = 0,
+        process_count: int = 1,
+        seed: int = 42,
+    ):
+        self.args = dict(
+            dataset_name_or_paths=dataset_name_or_paths,
+            tokenizer_name=tokenizer_name,
+            seq_length=seq_length,
+            streaming=streaming,
+            split=split,
+            world_rank=world_rank,
+            galaxy_size=galaxy_size,
+            process_index=process_index,
+            process_count=process_count,
+            seed=seed,
+        )
+        self.seq_length = seq_length
+        self.samples_seen = 0
+        self._resume_state: Optional[dict] = None
+        self._build()
+
+    def _build(self) -> None:
+        from datasets import load_dataset
+        from datasets.distributed import split_dataset_by_node
+        from transformers import AutoTokenizer
+
+        a = self.args
+        self.tokenizer = AutoTokenizer.from_pretrained(a["tokenizer_name"])
+        if self.tokenizer.pad_token is None:
+            self.tokenizer.pad_token = "</s>"  # train_fsdp.py:219
+
+        paths = a["dataset_name_or_paths"].split(",")
+        # per-galaxy-worker data source when multiple paths given
+        path = paths[a["world_rank"] % len(paths)] if len(paths) > 1 else paths[0]
+        # "name:config" selects an HF builder config; allenai/c4 needs one,
+        # so default it (train_fsdp.py loads c4 "en")
+        name, _, config_name = path.partition(":")
+        if not config_name and name == "allenai/c4":
+            config_name = "en"
+        ds = load_dataset(
+            name, config_name or None, split=a["split"], streaming=a["streaming"]
+        )
+        # two-level shard: galaxy worker x local host (train_fsdp.py:151-159)
+        if len(paths) == 1 and a["galaxy_size"] > 1:
+            ds = split_dataset_by_node(
+                ds, world_size=a["galaxy_size"], rank=a["world_rank"]
+            )
+        if a["process_count"] > 1:
+            ds = split_dataset_by_node(
+                ds, world_size=a["process_count"], rank=a["process_index"]
+            )
+        self.dataset = ds.shuffle(seed=a["seed"])
+
+    def __iter__(self) -> Iterator[dict[str, np.ndarray]]:
+        if self._resume_state is not None and hasattr(self.dataset, "load_state_dict"):
+            self.dataset.load_state_dict(self._resume_state)
+            self._resume_state = None
+        skip = 0
+        if self._resume_state is None and self.samples_seen and not hasattr(
+            self.dataset, "load_state_dict"
+        ):
+            skip = self.samples_seen  # deterministic skip-ahead fallback
+        seen_this_pass = 0
+        for sample in self.dataset:
+            if seen_this_pass < skip:
+                seen_this_pass += 1
+                continue
+            tok = self.tokenizer(
+                sample["text"],
+                max_length=self.seq_length,
+                truncation=True,
+                padding="max_length",
+                return_tensors="np",
+            )
+            ids = tok["input_ids"][0].astype(np.int32)
+            mask = tok["attention_mask"][0].astype(bool)
+            labels = np.where(mask, ids, IGNORE_INDEX).astype(np.int32)
+            self.samples_seen += 1
+            seen_this_pass += 1
+            yield {"input_ids": ids, "labels": labels}
+
+    def state_dict(self) -> dict:
+        sd: dict[str, Any] = {"samples_seen": self.samples_seen}
+        if hasattr(self.dataset, "state_dict"):
+            try:
+                sd["hf_state"] = self.dataset.state_dict()
+            except Exception:
+                pass
+        return sd
+
+    def load_state_dict(self, sd: dict) -> None:
+        self.samples_seen = sd.get("samples_seen", 0)
+        if "hf_state" in sd:
+            self._resume_state = sd["hf_state"]
+
+
+class DataLoader:
+    """Batches samples and prefetches on a background thread.
+
+    Stateful like torchdata's StatefulDataLoader: state_dict()/load_state_dict()
+    round-trips mid-stream so resume is sample-exact (the reference persists
+    this per rank, ckpt_utils.py:83-87).
+    """
+
+    def __init__(self, dataset, batch_size: int, prefetch: int = 4):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.prefetch = prefetch
+        self._queue: Optional[queue.Queue] = None
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    def _producer(self) -> None:
+        it = iter(self.dataset)
+        fresh = True
+        while not self._stop.is_set():
+            batch = []
+            while len(batch) < self.batch_size:
+                try:
+                    batch.append(next(it))
+                    fresh = False
+                except StopIteration:
+                    if fresh:
+                        # a brand-new iterator yielding nothing would loop
+                        # forever: surface the error to the consumer instead
+                        self._queue.put(_ProducerError(
+                            RuntimeError("dataset yielded no samples")
+                        ))
+                        return
+                    it = iter(self.dataset)  # wrap around: next epoch
+                    fresh = True
+            out = {
+                k: np.stack([b[k] for b in batch]) for k in batch[0].keys()
+            }
+            # snapshot dataset state as of *after* this batch: state_dict()
+            # is exact for the last batch the consumer actually received,
+            # regardless of how far the prefetch queue has run ahead
+            snap = self.dataset.state_dict()
+            while not self._stop.is_set():
+                try:
+                    self._queue.put((out, snap), timeout=0.5)
+                    break
+                except queue.Full:
+                    continue
+
+    def __iter__(self) -> Iterator[dict[str, np.ndarray]]:
+        self._stop.clear()
+        self._queue = queue.Queue(maxsize=self.prefetch)
+        self._thread = threading.Thread(target=self._producer, daemon=True)
+        self._thread.start()
+        while True:
+            item = self._queue.get()
+            if isinstance(item, _ProducerError):
+                raise item.error
+            out, snap = item
+            self._delivered_state = snap
+            yield out
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def state_dict(self) -> dict:
+        state = getattr(self, "_delivered_state", None)
+        return {"dataset": state if state is not None else self.dataset.state_dict()}
+
+    def load_state_dict(self, sd: dict) -> None:
+        self.dataset.load_state_dict(sd["dataset"])
+
+
+def get_dataloader(
+    *,
+    fake_data: bool,
+    dataset_name_or_paths: str,
+    tokenizer_name: str,
+    seq_length: int,
+    batch_size: int,
+    vocab_size: int,
+    world_rank: int = 0,
+    galaxy_size: int = 1,
+    seed: int = 42,
+) -> DataLoader:
+    """Reference-shaped factory (train_fsdp.py:132-168)."""
+    if fake_data:
+        ds = FakeTokenizedDataset(seq_length, vocab_size, seed=seed + world_rank)
+    else:
+        import jax
+
+        ds = HFStreamingDataset(
+            dataset_name_or_paths,
+            tokenizer_name,
+            seq_length,
+            world_rank=world_rank,
+            galaxy_size=galaxy_size,
+            process_index=jax.process_index(),
+            process_count=jax.process_count(),
+            seed=seed,
+        )
+    return DataLoader(ds, batch_size)

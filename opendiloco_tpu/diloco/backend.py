@@ -104,9 +104,12 @@ def wait_for_peers(
     if strategy == "no_wait":
         return
     deadline = time.monotonic() + timeout_waiting_for_peers
+    first = True
     while time.monotonic() < deadline:
         others = [p for p in backend.peer_progress() if p.peer_id != backend.peer_id]
         if not others:
+            if log is not None:
+                log.debug("wait_for_peers: no other peers known; proceeding")
             return
         behind = [
             p
@@ -116,6 +119,13 @@ def wait_for_peers(
         ]
         if not behind:
             return
+        if first and log is not None:
+            log.debug(
+                "wait_for_peers: %d peers behind: %s",
+                len(behind),
+                [(p.peer_id, p.epoch, p.samples) for p in behind],
+            )
+            first = False
         # everyone close enough (< poll horizon) also counts as ready
         etas = [p.eta_to_epoch_end(target_samples) for p in behind]
         if max(etas) <= poll:

@@ -1,0 +1,257 @@
+"""Rendezvous daemon: peer registry, progress gossip, group matchmaking.
+
+The DCN replacement for hivemind's DHT bootstrap peer (`hivemind-dht` CLI
+with a fixed identity key, reference: README.md:80-95, run_training.sh:44-53,
+open_diloco/fixed_key.pem). Workers bootstrap off ``--initial-peers
+host:port`` exactly like the reference's multiaddr UX, report progress
+(replacing DiloCoProgressTracker's DHT gossip, hivemind_diloco.py:174-282),
+and form per-epoch all-reduce groups (replacing DecentralizedAverager
+matchmaking with ``matchmaking_time`` semantics, hivemind_diloco.py:342,403).
+
+Run standalone:  python -m opendiloco_tpu.diloco.rendezvous --port 9000
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Optional
+
+from opendiloco_tpu.diloco.wire import read_frame, send_frame
+from opendiloco_tpu.utils.logger import get_text_logger
+
+log = get_text_logger(__name__)
+
+PEER_TTL = 60.0  # seconds without contact before a peer is considered dead
+
+
+@dataclass
+class PeerInfo:
+    peer_id: str
+    host: str
+    port: int
+    last_seen: float = field(default_factory=time.monotonic)
+    progress: Optional[dict] = None
+    serves_state: bool = False
+
+    def to_json(self) -> dict:
+        return {
+            "peer_id": self.peer_id,
+            "host": self.host,
+            "port": self.port,
+            "progress": self.progress,
+            "serves_state": self.serves_state,
+        }
+
+
+class _GroupRound:
+    """Matchmaking window for one (epoch) all-reduce round."""
+
+    def __init__(self, key: str, window: float):
+        self.key = key
+        self.window = window
+        self.joiners: dict[str, PeerInfo] = {}
+        self.event = asyncio.Event()
+        self.opened = time.monotonic()
+        self.closed = False
+        self.group: list[dict] = []
+
+
+class RendezvousServer:
+    def __init__(self, host: str = "0.0.0.0", port: int = 0, identity: Optional[str] = None):
+        self.host = host
+        self.port = port
+        self.identity = identity or uuid.uuid4().hex[:16]
+        self.peers: dict[str, PeerInfo] = {}
+        self.rounds: dict[str, _GroupRound] = {}
+        self._server: Optional[asyncio.base_events.Server] = None
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._thread: Optional[threading.Thread] = None
+        self._started = threading.Event()
+
+    # -- lifecycle -------------------------------------------------------
+
+    def start_in_thread(self) -> "RendezvousServer":
+        """Run the server on a background thread (in-process daemon)."""
+        self._thread = threading.Thread(target=self._thread_main, daemon=True)
+        self._thread.start()
+        if not self._started.wait(10):
+            raise RuntimeError("rendezvous server failed to start")
+        return self
+
+    def _thread_main(self) -> None:
+        asyncio.run(self._serve_forever())
+
+    async def _serve_forever(self) -> None:
+        self._loop = asyncio.get_running_loop()
+        self._server = await asyncio.start_server(self._handle, self.host, self.port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        log.info("rendezvous %s listening on %s:%d", self.identity, self.host, self.port)
+        self._started.set()
+        async with self._server:
+            try:
+                await self._server.serve_forever()
+            except asyncio.CancelledError:
+                pass
+
+    def stop(self) -> None:
+        if self._loop and self._server:
+            self._loop.call_soon_threadsafe(self._server.close)
+        if self._thread:
+            self._thread.join(timeout=5)
+
+    @property
+    def address(self) -> str:
+        return f"{self.host if self.host != '0.0.0.0' else '127.0.0.1'}:{self.port}"
+
+    # -- request handling ------------------------------------------------
+
+    def _live_peers(self) -> dict[str, PeerInfo]:
+        now = time.monotonic()
+        dead = [pid for pid, p in self.peers.items() if now - p.last_seen > PEER_TTL]
+        for pid in dead:
+            log.warning("expiring dead peer %s", pid)
+            del self.peers[pid]
+        return self.peers
+
+    async def _handle(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        try:
+            msg, meta, _ = await read_frame(reader, timeout=120.0)
+        except Exception:
+            writer.close()
+            return
+        try:
+            if msg == "register":
+                info = PeerInfo(meta["peer_id"], meta["host"], meta["port"])
+                self.peers[info.peer_id] = info
+                log.info("peer %s joined from %s:%d", info.peer_id, info.host, info.port)
+                await send_frame(
+                    writer,
+                    "ok",
+                    {
+                        "identity": self.identity,
+                        "peers": [p.to_json() for p in self._live_peers().values()],
+                    },
+                )
+            elif msg == "unregister":
+                self.peers.pop(meta["peer_id"], None)
+                await send_frame(writer, "ok", {})
+            elif msg == "progress":
+                pid = meta["peer_id"]
+                if pid not in self.peers and "host" in meta:
+                    # TTL-expired peers re-register transparently (a slow
+                    # first jit compile must not blacklist a worker)
+                    self.peers[pid] = PeerInfo(pid, meta["host"], meta["port"])
+                    log.info("peer %s re-registered via progress", pid)
+                if pid in self.peers:
+                    self.peers[pid].last_seen = time.monotonic()
+                    self.peers[pid].progress = meta["progress"]
+                    self.peers[pid].serves_state = meta.get("serves_state", False)
+                await send_frame(
+                    writer,
+                    "ok",
+                    {"peers": [p.to_json() for p in self._live_peers().values()]},
+                )
+            elif msg == "join_group":
+                await self._join_group(writer, meta)
+            elif msg == "who_has_state":
+                candidates = [
+                    p.to_json()
+                    for p in self._live_peers().values()
+                    if p.serves_state and p.peer_id != meta.get("exclude")
+                ]
+                best = max(
+                    candidates,
+                    key=lambda p: (p["progress"] or {}).get("epoch", -1),
+                    default=None,
+                )
+                await send_frame(writer, "ok", {"peer": best})
+            else:
+                await send_frame(writer, "error", {"error": f"unknown message {msg!r}"})
+        except Exception as e:  # keep the daemon alive on handler errors
+            log.exception("rendezvous handler error")
+            try:
+                await send_frame(writer, "error", {"error": str(e)})
+            except Exception:
+                pass
+        finally:
+            writer.close()
+            try:
+                await writer.wait_closed()
+            except Exception:
+                pass
+
+    async def _join_group(self, writer: asyncio.StreamWriter, meta: dict) -> None:
+        """Collect joiners for ``matchmaking_time``; reply with the group.
+
+        The window closes early once every live registered peer has joined
+        (the common case), so rounds don't pay the full window when the
+        swarm is healthy.
+        """
+        key = str(meta["round"])
+        window = float(meta.get("matchmaking_time", 5.0))
+        pid = meta["peer_id"]
+        if pid in self.peers:
+            self.peers[pid].last_seen = time.monotonic()
+
+        rnd = self.rounds.get(key)
+        if rnd is None or rnd.closed:
+            rnd = _GroupRound(key, window)
+            self.rounds[key] = rnd
+            asyncio.create_task(self._close_round_later(rnd))
+        if pid in self.peers:
+            rnd.joiners[pid] = self.peers[pid]
+        if set(rnd.joiners) >= set(self._live_peers()):
+            self._close_round(rnd)
+
+        await rnd.event.wait()
+        await send_frame(writer, "ok", {"group": rnd.group})
+
+    async def _close_round_later(self, rnd: _GroupRound) -> None:
+        await asyncio.sleep(rnd.window)
+        if not rnd.closed:
+            self._close_round(rnd)
+
+    def _close_round(self, rnd: _GroupRound) -> None:
+        rnd.closed = True
+        rnd.group = sorted(
+            (p.to_json() for p in rnd.joiners.values()), key=lambda p: p["peer_id"]
+        )
+        self.rounds.pop(rnd.key, None)
+        rnd.event.set()
+
+
+def main(argv: Optional[list[str]] = None) -> None:
+    import argparse
+
+    ap = argparse.ArgumentParser(description="opendiloco_tpu rendezvous daemon")
+    ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument("--port", type=int, default=9000)
+    ap.add_argument(
+        "--identity-file",
+        default=None,
+        help="persist/reuse a stable daemon identity (fixed_key.pem parity)",
+    )
+    args = ap.parse_args(argv)
+
+    identity = None
+    if args.identity_file:
+        import os
+
+        if os.path.exists(args.identity_file):
+            identity = open(args.identity_file).read().strip()
+        else:
+            identity = uuid.uuid4().hex[:16]
+            with open(args.identity_file, "w") as f:
+                f.write(identity)
+
+    server = RendezvousServer(args.host, args.port, identity)
+    print(f"rendezvous daemon: initial_peers = {args.host}:{args.port}", flush=True)
+    asyncio.run(server._serve_forever())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,498 @@
+"""TCP (DCN) outer backend: the production hivemind equivalent.
+
+Implements OuterBackend over plain TCP between TPU-VM hosts:
+
+- bootstrap/registration + progress gossip via the rendezvous daemon
+  (diloco/rendezvous.py), bootstrap UX = ``--initial-peers host:port``
+  (reference multiaddr UX, README.md:80-95)
+- per-epoch group formation with ``matchmaking_time`` (reference:
+  hivemind_diloco.py:342,403)
+- butterfly all-reduce of the flat pseudo-gradient buffer (hivemind
+  DecentralizedAverager scheme: peer j owns part j; everyone pushes part j
+  to j, j averages and returns it) so lossy wire compression is applied
+  exactly twice regardless of group size
+- timeout/retry semantics (``averaging_timeout``; failed rounds re-form the
+  group without the dead peer, reference elasticity §5.3)
+- late-joiner state download (``load_state_from_peers``,
+  train_fsdp.py:348-349) served peer-to-peer
+
+The asyncio event loop runs on a background thread; OuterBackend methods are
+synchronous bridges (the training loop is synchronous host code).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import threading
+import time
+import uuid
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+from opendiloco_tpu.diloco.backend import AllReduceError, OuterBackend, PeerProgress
+from opendiloco_tpu.diloco.compression import Codec, get_codec
+from opendiloco_tpu.diloco.wire import read_frame, request, send_frame
+from opendiloco_tpu.utils.logger import get_text_logger
+
+log = get_text_logger(__name__)
+
+
+# -- state (de)serialization: raw numpy bytes + JSON meta, no pickle ---------
+
+
+def serialize_state(state: dict[str, Any]) -> tuple[dict, bytes]:
+    arrays: list[np.ndarray] = []
+    meta = _encode_obj(state, arrays)
+    blobs, offsets = [], []
+    off = 0
+    for a in arrays:
+        b = np.ascontiguousarray(a).tobytes()
+        offsets.append((off, len(b), str(a.dtype), list(a.shape)))
+        off += len(b)
+        blobs.append(b)
+    return {"tree": meta, "arrays": offsets}, b"".join(blobs)
+
+
+def deserialize_state(meta: dict, payload: bytes) -> dict[str, Any]:
+    arrays = [
+        np.frombuffer(payload[o : o + n], dtype=dt).reshape(shape).copy()
+        for o, n, dt, shape in meta["arrays"]
+    ]
+    return _decode_obj(meta["tree"], arrays)
+
+
+def _encode_obj(obj: Any, arrays: list[np.ndarray]) -> Any:
+    if isinstance(obj, np.ndarray):
+        arrays.append(obj)
+        return {"__arr__": len(arrays) - 1}
+    if isinstance(obj, dict):
+        return {k: _encode_obj(v, arrays) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_encode_obj(v, arrays) for v in obj]
+    return obj
+
+
+def _decode_obj(obj: Any, arrays: list[np.ndarray]) -> Any:
+    if isinstance(obj, dict):
+        if "__arr__" in obj:
+            return arrays[obj["__arr__"]]
+        return {k: _decode_obj(v, arrays) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [_decode_obj(v, arrays) for v in obj]
+    return obj
+
+
+class TcpBackend(OuterBackend):
+    def __init__(
+        self,
+        initial_peers: list[str],
+        *,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        peer_id: Optional[str] = None,
+        compression: str = "none",
+        matchmaking_time: float = 5.0,
+        rpc_timeout: float = 30.0,
+    ):
+        if not initial_peers:
+            raise ValueError("TcpBackend needs at least one rendezvous address")
+        self.rendezvous_addr = initial_peers[0].rsplit(":", 1)
+        self.rendezvous = (self.rendezvous_addr[0], int(self.rendezvous_addr[1]))
+        self.host = host
+        self.port = port
+        self._peer_id = peer_id or f"peer-{uuid.uuid4().hex[:12]}"
+        self.codec: Codec = get_codec(compression)
+        self.matchmaking_time = matchmaking_time
+        self.rpc_timeout = rpc_timeout
+
+        self._state_provider: Optional[Callable[[], dict]] = None
+        self._progress_cache: list[PeerProgress] = []
+        self._own_progress: Optional[PeerProgress] = None
+        # mailbox: (round, kind, sender_or_part) -> (meta, payload)
+        self._mailbox: dict[tuple, tuple[dict, bytes]] = {}
+        self._mailbox_cv: Optional[asyncio.Condition] = None
+
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._server: Optional[asyncio.base_events.Server] = None
+        self._started = threading.Event()
+        self._startup_error: Optional[BaseException] = None
+        self._thread = threading.Thread(target=self._thread_main, daemon=True)
+        self._thread.start()
+        if not self._started.wait(15) or self._startup_error:
+            raise RuntimeError(f"TcpBackend failed to start: {self._startup_error}")
+
+    # -- event loop thread -------------------------------------------------
+
+    def _thread_main(self) -> None:
+        try:
+            asyncio.run(self._main())
+        except Exception as e:  # pragma: no cover
+            self._startup_error = e
+            self._started.set()
+
+    async def _main(self) -> None:
+        self._loop = asyncio.get_running_loop()
+        self._mailbox_cv = asyncio.Condition()
+        try:
+            self._server = await asyncio.start_server(
+                self._handle_peer, self.host, self.port
+            )
+            self.port = self._server.sockets[0].getsockname()[1]
+            _, meta, _ = await request(
+                *self.rendezvous,
+                "register",
+                {"peer_id": self._peer_id, "host": self.host, "port": self.port},
+                timeout=self.rpc_timeout,
+            )
+            log.info(
+                "%s registered with rendezvous %s (%d peers known)",
+                self._peer_id,
+                self.rendezvous,
+                len(meta.get("peers", [])),
+            )
+        except Exception as e:
+            self._startup_error = e
+            self._started.set()
+            return
+        self._started.set()
+        async with self._server:
+            try:
+                await self._server.serve_forever()
+            except asyncio.CancelledError:
+                pass
+
+    def _run(self, coro, timeout: Optional[float] = None):
+        fut = asyncio.run_coroutine_threadsafe(coro, self._loop)
+        return fut.result(timeout)
+
+    # -- peer server ---------------------------------------------------------
+
+    async def _handle_peer(self, reader, writer) -> None:
+        try:
+            msg, meta, payload = await read_frame(reader, timeout=300.0)
+            if msg in ("push", "result"):
+                key = (
+                    meta["round"],
+                    msg,
+                    meta["part"] if msg == "result" else meta["from"],
+                )
+                async with self._mailbox_cv:
+                    self._mailbox[key] = (meta, payload)
+                    self._gc_mailbox()
+                    self._mailbox_cv.notify_all()
+                await send_frame(writer, "ok", {})
+            elif msg == "fetch_state":
+                if self._state_provider is None:
+                    await send_frame(writer, "error", {"error": "no state"})
+                else:
+                    smeta, sblob = serialize_state(self._state_provider())
+                    await send_frame(writer, "state", smeta, sblob)
+            else:
+                await send_frame(writer, "error", {"error": f"unknown {msg!r}"})
+        except Exception:
+            log.exception("peer handler error")
+        finally:
+            writer.close()
+            try:
+                await writer.wait_closed()
+            except Exception:
+                pass
+
+    def _gc_mailbox(self, max_age: float = 600.0) -> None:
+        """Drop payloads from abandoned rounds (failed retries leave
+        orphaned entries; without GC they pin compressed gradient parts in
+        host RAM for the whole run)."""
+        now = time.monotonic()
+        self._mailbox_times = getattr(self, "_mailbox_times", {})
+        for k in list(self._mailbox):
+            self._mailbox_times.setdefault(k, now)
+        dead = [k for k, t in self._mailbox_times.items() if now - t > max_age]
+        for k in dead:
+            self._mailbox.pop(k, None)
+            self._mailbox_times.pop(k, None)
+        self._mailbox_times = {
+            k: t for k, t in self._mailbox_times.items() if k in self._mailbox
+        }
+
+    async def _wait_mailbox(self, key: tuple, deadline: float) -> tuple[dict, bytes]:
+        async with self._mailbox_cv:
+            while key not in self._mailbox:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise asyncio.TimeoutError(f"waiting for {key}")
+                try:
+                    await asyncio.wait_for(
+                        self._mailbox_cv.wait(), min(remaining, 1.0)
+                    )
+                except asyncio.TimeoutError:
+                    continue
+            return self._mailbox.pop(key)
+
+    # -- OuterBackend API ------------------------------------------------
+
+    @property
+    def peer_id(self) -> str:
+        return self._peer_id
+
+    def num_peers(self) -> int:
+        return max(1, len(self._progress_cache))
+
+    def report_progress(self, progress: PeerProgress) -> None:
+        self._own_progress = progress
+        self._push_progress()
+
+    def _push_progress(self) -> None:
+        progress = self._own_progress
+        if progress is None:
+            return
+        try:
+            _, meta, _ = self._run(
+                request(
+                    *self.rendezvous,
+                    "progress",
+                    {
+                        "peer_id": self._peer_id,
+                        "host": self.host,
+                        "port": self.port,
+                        "progress": {
+                            "epoch": progress.epoch,
+                            "samples": progress.samples,
+                            "samples_per_second": progress.samples_per_second,
+                            "timestamp": progress.timestamp,
+                        },
+                        "serves_state": self._state_provider is not None,
+                    },
+                    timeout=self.rpc_timeout,
+                ),
+                timeout=self.rpc_timeout + 5,
+            )
+        except Exception as e:
+            log.warning("progress report failed: %s", e)
+            return
+        cache = []
+        for p in meta.get("peers", []):
+            prog = p.get("progress") or {}
+            cache.append(
+                PeerProgress(
+                    peer_id=p["peer_id"],
+                    epoch=prog.get("epoch", 0),
+                    samples=prog.get("samples", 0),
+                    samples_per_second=prog.get("samples_per_second", 0.0),
+                    timestamp=prog.get("timestamp", 0.0),
+                )
+            )
+        self._progress_cache = cache
+        self._progress_cache_time = time.monotonic()
+
+    def peer_progress(self) -> list[PeerProgress]:
+        # refresh from the rendezvous when stale so WAIT_FOR_ALL polling
+        # (backend.py wait_for_peers) observes peers catching up
+        if time.monotonic() - getattr(self, "_progress_cache_time", 0.0) > 0.5:
+            self._push_progress()
+        out = [p for p in self._progress_cache if p.peer_id != self._peer_id]
+        if self._own_progress is not None:
+            out.append(self._own_progress)
+        return out
+
+    def all_reduce(self, arrays, *, timeout=None, tag: str = "grads"):
+        """Rounds are keyed by (tag, own epoch) so all in-sync peers agree on
+        the key without coordination; retries after a failed round re-join
+        the same key (the rendezvous opens a fresh matchmaking window) and
+        the group fingerprint keeps stale traffic out of the new round."""
+        timeout = timeout or 300.0
+        deadline = time.monotonic() + timeout
+        ep = self._own_progress.epoch if self._own_progress else 0
+        round_key = f"{tag}-epoch-{ep}"
+        last_err: Optional[Exception] = None
+        for attempt in range(3):
+            if time.monotonic() >= deadline:
+                break
+            try:
+                return self._run(
+                    self._all_reduce_round(arrays, round_key, deadline),
+                    timeout=max(1.0, deadline - time.monotonic()) + 10,
+                )
+            except (asyncio.TimeoutError, AllReduceError, OSError) as e:
+                last_err = e
+                log.warning(
+                    "all-reduce attempt %d failed (%s); re-forming group",
+                    attempt,
+                    e,
+                )
+        raise AllReduceError(f"all-reduce failed: {last_err}")
+
+    async def _all_reduce_round(self, arrays: list[np.ndarray], join_key: str, deadline: float):
+        # 1. matchmake
+        _, meta, _ = await request(
+            *self.rendezvous,
+            "join_group",
+            {
+                "peer_id": self._peer_id,
+                "round": join_key,
+                "matchmaking_time": self.matchmaking_time,
+            },
+            timeout=max(self.matchmaking_time * 4, self.rpc_timeout),
+        )
+        group = meta["group"]
+        n = len(group)
+        if n <= 1:
+            return [a.copy() for a in arrays], 1
+        my_idx = next(
+            (i for i, p in enumerate(group) if p["peer_id"] == self._peer_id), None
+        )
+        if my_idx is None:
+            # stale registry excluded us (e.g. TTL expiry); re-announce and retry
+            self._push_progress()
+            raise AllReduceError(f"matchmade group {group} does not contain self")
+        # fingerprint the membership: retried rounds (same join_key) must not
+        # consume stale mailbox traffic from a differently-shaped group
+        fp = hashlib.sha1(
+            ",".join(p["peer_id"] for p in group).encode()
+        ).hexdigest()[:8]
+        round_key = f"{join_key}:{fp}"
+
+        # 2. flatten + split into n parts (by element count)
+        flat = np.concatenate([a.reshape(-1).astype(np.float32) for a in arrays])
+        bounds = np.linspace(0, flat.size, n + 1).astype(np.int64)
+        parts = [flat[bounds[j] : bounds[j + 1]] for j in range(n)]
+
+        # 3. push part j to its owner
+        async def push(j):
+            payload, cmeta = self.codec.encode(parts[j])
+            await request(
+                group[j]["host"],
+                group[j]["port"],
+                "push",
+                {
+                    "round": round_key,
+                    "from": self._peer_id,
+                    "meta": cmeta,
+                    "shape": [int(parts[j].size)],
+                },
+                payload,
+                timeout=max(5.0, deadline - time.monotonic()),
+            )
+
+        pushes = [push(j) for j in range(n) if j != my_idx]
+
+        # 4. collect everyone's contribution for my part
+        async def collect():
+            acc = parts[my_idx].astype(np.float64)
+            for p in group:
+                if p["peer_id"] == self._peer_id:
+                    continue
+                pmeta, payload = await self._wait_mailbox(
+                    (round_key, "push", p["peer_id"]), deadline
+                )
+                acc += self.codec.decode(
+                    payload, (int(pmeta["shape"][0]),), pmeta["meta"]
+                )
+            return (acc / n).astype(np.float32)
+
+        results = await asyncio.gather(collect(), *pushes)
+        my_avg = results[0]
+
+        # 5. fan the averaged part back out; gather the other parts
+        async def send_result(j):
+            payload, cmeta = self.codec.encode(my_avg)
+            await request(
+                group[j]["host"],
+                group[j]["port"],
+                "result",
+                {
+                    "round": round_key,
+                    "part": my_idx,
+                    "from": self._peer_id,
+                    "meta": cmeta,
+                    "shape": [int(my_avg.size)],
+                },
+                payload,
+                timeout=max(5.0, deadline - time.monotonic()),
+            )
+
+        async def recv_results():
+            out: dict[int, np.ndarray] = {my_idx: my_avg}
+            for j in range(n):
+                if j == my_idx:
+                    continue
+                rmeta, payload = await self._wait_mailbox(
+                    (round_key, "result", j), deadline
+                )
+                out[j] = self.codec.decode(
+                    payload, (int(rmeta["shape"][0]),), rmeta["meta"]
+                )
+            return out
+
+        results = await asyncio.gather(
+            recv_results(), *[send_result(j) for j in range(n) if j != my_idx]
+        )
+        parts_avg = results[0]
+
+        # 6. reassemble
+        flat_avg = np.concatenate([parts_avg[j] for j in range(n)])
+        out, off = [], 0
+        for a in arrays:
+            out.append(flat_avg[off : off + a.size].reshape(a.shape))
+            off += a.size
+        return out, n
+
+    def _peer_id_epoch_key(self) -> str:
+        ep = self._own_progress.epoch if self._own_progress else 0
+        return f"epoch-{ep}"
+
+    # -- state serving / fetching -----------------------------------------
+
+    def serve_state(self, get_state) -> None:
+        self._state_provider = get_state
+
+    def fetch_state(self) -> Optional[dict]:
+        try:
+            _, meta, _ = self._run(
+                request(
+                    *self.rendezvous,
+                    "who_has_state",
+                    {"exclude": self._peer_id},
+                    timeout=self.rpc_timeout,
+                ),
+                timeout=self.rpc_timeout + 5,
+            )
+            peer = meta.get("peer")
+            if not peer:
+                return None
+            msg, smeta, blob = self._run(
+                request(
+                    peer["host"],
+                    peer["port"],
+                    "fetch_state",
+                    {},
+                    timeout=self.rpc_timeout * 4,
+                ),
+                timeout=self.rpc_timeout * 4 + 5,
+            )
+            if msg != "state":
+                return None
+            return deserialize_state(smeta, blob)
+        except Exception as e:
+            log.warning("fetch_state failed: %s", e)
+            return None
+
+    def barrier(self, *, timeout: Optional[float] = None) -> None:
+        self.all_reduce([np.zeros(1, np.float32)], timeout=timeout or 60.0, tag="barrier")
+
+    def close(self) -> None:
+        try:
+            self._run(
+                request(
+                    *self.rendezvous,
+                    "unregister",
+                    {"peer_id": self._peer_id},
+                    timeout=5.0,
+                ),
+                timeout=10.0,
+            )
+        except Exception:
+            pass
+        if self._loop and self._server:
+            self._loop.call_soon_threadsafe(self._server.close)

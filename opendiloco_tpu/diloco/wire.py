@@ -1,0 +1,111 @@
+"""Framed-message wire protocol for the DCN control/data plane.
+
+The reference's equivalent layer is hivemind's protobuf RPC through the Go
+libp2p daemon (SURVEY.md §2.3: p2pd + *_pb2 schemas). Here the control plane
+is a minimal length-prefixed frame: an 8-byte big-endian header length, a
+JSON header, then a raw binary payload (tensor bytes travel untouched --
+JSON never sees them).
+
+Frame layout:  [4B magic "ODTP"][4B header_len][header JSON][payload bytes]
+The header carries {"type": ..., "meta": {...}, "payload_len": N}; meta
+values must be JSON-serializable (bytes fields are hex-encoded by codecs
+that need them).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import struct
+from typing import Any, Optional
+
+MAGIC = b"ODTP"
+_HDR = struct.Struct(">4sI")
+MAX_HEADER = 16 * 1024 * 1024
+
+
+class WireError(RuntimeError):
+    pass
+
+
+def encode_frame(msg_type: str, meta: dict[str, Any], payload: bytes = b"") -> bytes:
+    header = json.dumps(
+        {"type": msg_type, "meta": meta, "payload_len": len(payload)}
+    ).encode()
+    return _HDR.pack(MAGIC, len(header)) + header + payload
+
+
+async def read_frame(
+    reader: asyncio.StreamReader, *, timeout: Optional[float] = None
+) -> tuple[str, dict[str, Any], bytes]:
+    async def _read() -> tuple[str, dict[str, Any], bytes]:
+        hdr = await reader.readexactly(_HDR.size)
+        magic, hlen = _HDR.unpack(hdr)
+        if magic != MAGIC or hlen > MAX_HEADER:
+            raise WireError(f"bad frame header: magic={magic!r} hlen={hlen}")
+        header = json.loads(await reader.readexactly(hlen))
+        payload = b""
+        n = header.get("payload_len", 0)
+        if n:
+            payload = await reader.readexactly(n)
+        return header["type"], header.get("meta", {}), payload
+
+    if timeout is None:
+        return await _read()
+    return await asyncio.wait_for(_read(), timeout)
+
+
+async def send_frame(
+    writer: asyncio.StreamWriter, msg_type: str, meta: dict[str, Any], payload: bytes = b""
+) -> None:
+    writer.write(encode_frame(msg_type, meta, payload))
+    await writer.drain()
+
+
+async def request(
+    host: str,
+    port: int,
+    msg_type: str,
+    meta: dict[str, Any],
+    payload: bytes = b"",
+    *,
+    timeout: float = 30.0,
+) -> tuple[str, dict[str, Any], bytes]:
+    """One-shot RPC: connect, send one frame, read one frame, close."""
+    reader, writer = await asyncio.wait_for(
+        asyncio.open_connection(host, port), timeout
+    )
+    try:
+        await send_frame(writer, msg_type, meta, payload)
+        return await read_frame(reader, timeout=timeout)
+    finally:
+        writer.close()
+        try:
+            await writer.wait_closed()
+        except Exception:
+            pass
+
+
+# -- multi-tensor payload packing -------------------------------------------
+
+
+def pack_arrays(payloads: list[bytes], metas: list[dict]) -> tuple[bytes, list[dict]]:
+    """Concatenate per-tensor payloads; meta gains offset/length fields."""
+    out_meta = []
+    offset = 0
+    for p, m in zip(payloads, metas):
+        m = dict(m)
+        m["_off"] = offset
+        m["_len"] = len(p)
+        offset += len(p)
+        out_meta.append(m)
+    return b"".join(payloads), out_meta
+
+
+def unpack_arrays(blob: bytes, metas: list[dict]) -> list[tuple[bytes, dict]]:
+    out = []
+    for m in metas:
+        m = dict(m)
+        off, ln = m.pop("_off"), m.pop("_len")
+        out.append((blob[off : off + ln], m))
+    return out

@@ -157,7 +157,10 @@ def _decoder_block(
     h: jax.Array,
     layer: dict,
     positions: jax.Array,
-) -> jax.Array:
+) -> tuple[jax.Array, jax.Array]:
+    """Returns (hidden, attn-output L2 norm). The norm is the activation
+    probe the reference attaches via forward hooks on ``self_attn``
+    (utils.py:43-67, train_fsdp.py:65)."""
     B, T, D = h.shape
     Nh, Nkv, Dh = cfg.num_attention_heads, cfg.kv_heads, cfg.head_dim
 
@@ -168,11 +171,13 @@ def _decoder_block(
     q = _rope(q, positions, cfg.rope_theta)
     k = _rope(k, positions, cfg.rope_theta)
     attn = attn_fn(q, k, v)
-    h = h + attn.reshape(B, T, Nh * Dh) @ layer["o_proj"]
+    attn_out = attn.reshape(B, T, Nh * Dh) @ layer["o_proj"]
+    attn_norm = jnp.sqrt(jnp.sum(attn_out.astype(jnp.float32) ** 2))
+    h = h + attn_out
 
     x = _rms_norm(h, layer["post_attn_norm"], cfg.rms_norm_eps)
     gated = jax.nn.silu(x @ layer["gate_proj"]) * (x @ layer["up_proj"])
-    return h + gated @ layer["down_proj"]
+    return h + gated @ layer["down_proj"], attn_norm
 
 
 def forward(
@@ -184,8 +189,15 @@ def forward(
     attn_impl: str = "xla",
     remat: bool = True,
     positions: Optional[jax.Array] = None,
-) -> jax.Array:
-    """input_ids [B, T] int32 -> logits [B, T, V] float32."""
+    return_aux: bool = False,
+    ring_mesh=None,
+    ring_axis: str = "sp",
+):
+    """input_ids [B, T] int32 -> logits [B, T, V] float32.
+
+    return_aux=True additionally returns activation-probe metrics
+    {"attn_out_norm": [L], "lm_head_norm": scalar} (the reference's
+    self_attn/lm_head hook probes, utils.py:43-67)."""
     B, T = input_ids.shape
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (B, T))
@@ -201,19 +213,18 @@ def forward(
     elif attn_impl == "ring":
         from opendiloco_tpu.ops.ring_attention import ring_attention_auto
 
-        attn_fn = lambda q, k, v: ring_attention_auto(q, k, v)
+        attn_fn = lambda q, k, v: ring_attention_auto(
+            q, k, v, mesh=ring_mesh, axis=ring_axis
+        )
     else:
         raise ValueError(f"unknown attn_impl {attn_impl!r}")
 
     h = jnp.take(cparams["embed_tokens"], input_ids, axis=0)
 
-    block = lambda h, layer: (
-        _decoder_block(cfg, attn_fn, h, layer, positions),
-        None,
-    )
+    block = lambda h, layer: _decoder_block(cfg, attn_fn, h, layer, positions)
     if remat:
         block = jax.checkpoint(block)
-    h, _ = jax.lax.scan(block, h, cparams["layers"])
+    h, attn_norms = jax.lax.scan(block, h, cparams["layers"])
 
     h = _rms_norm(h, cparams["final_norm"], cfg.rms_norm_eps)
     head = (
@@ -222,6 +233,12 @@ def forward(
         else cparams["lm_head"]
     )
     logits = (h @ head).astype(jnp.float32)
+    if return_aux:
+        aux = {
+            "attn_out_norm": attn_norms,
+            "lm_head_norm": jnp.sqrt(jnp.sum(logits**2)),
+        }
+        return logits, aux
     return logits
 
 

@@ -113,24 +113,29 @@ def ring_attention(
     return out.transpose(0, 2, 1, 3)
 
 
-def ring_attention_auto(q: jax.Array, k: jax.Array, v: jax.Array) -> jax.Array:
-    """Wrap ring_attention in a shard_map over the configured mesh's sp axis.
+def ring_attention_auto(
+    q: jax.Array, k: jax.Array, v: jax.Array, *, mesh=None, axis: Optional[str] = None
+) -> jax.Array:
+    """Wrap ring_attention in a shard_map over the mesh's sp axis.
 
     Callable from inside the (jit-compiled) model forward: batch/head dims
-    stay auto-sharded, only the sequence axis is manual.
+    stay auto-sharded, only the sequence axis is manual. Pass the mesh
+    explicitly (the trainer threads its plan.mesh through forward); the
+    module registry is only a fallback for direct/experimental callers.
     """
-    if _RING_MESH is None:
+    mesh = mesh if mesh is not None else _RING_MESH
+    axis = axis or _RING_AXIS
+    if mesh is None:
         raise RuntimeError(
-            "ring attention needs configure_ring(mesh) (the trainer does this "
-            "when attn_impl='ring')"
+            "ring attention needs a mesh: pass mesh= or call configure_ring(mesh)"
         )
     P = jax.sharding.PartitionSpec
-    spec = P(None, _RING_AXIS, None, None)
+    spec = P(None, axis, None, None)
     fn = jax.shard_map(
-        functools.partial(ring_attention, axis_name=_RING_AXIS, causal=True),
-        mesh=_RING_MESH,
+        functools.partial(ring_attention, axis_name=axis, causal=True),
+        mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
-        axis_names={_RING_AXIS},
+        axis_names={axis},
     )
     return fn(q, k, v)

@@ -1,0 +1,241 @@
+"""Main training driver: the TPU-native ``train_fsdp.py``.
+
+End-to-end Llama pretraining with optional DiLoCo outer loop:
+
+    python -m opendiloco_tpu.train --path-model 150m --fake-data \\
+        --per-device-train-batch-size 32 --total-batch-size 512 \\
+        --diloco.local-steps 500 --diloco.initial-peers HOST:PORT \\
+        --diloco.world-rank 0 --diloco.galaxy-size 8 \\
+        --ckpt.path outputs --ckpt.interval 500 --metric-logger-type wandb
+
+Reference call-stack parity (train_fsdp.py:177-516): config -> mesh ->
+model -> dataloader -> trainer -> (DiLoCo optimizer | plain inner loop) ->
+train loop with metrics, activation probes, peer-drop handling, checkpoint
+cadence + resume. What disappears on TPU: torchrun process-per-GPU (one
+controller process drives the local mesh), FSDP wrapping (shardings),
+GradScaler (bf16), and the post-outer-step NCCL broadcast (the outer update
+is written to the sharded pytree directly).
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import time
+from typing import Optional
+
+import jax
+import numpy as np
+
+from opendiloco_tpu import ckpt as ckpt_lib
+from opendiloco_tpu.config import Config, DilocoConfig, parse_argv
+from opendiloco_tpu.data.dataloader import get_dataloader
+from opendiloco_tpu.diloco.backend import OuterBackend
+from opendiloco_tpu.diloco.optimizer import DiLoCoOptimizer, PeerDropError
+from opendiloco_tpu.models import hf_io
+from opendiloco_tpu.models.llama import init_params
+from opendiloco_tpu.parallel.mesh import build_mesh
+from opendiloco_tpu.trainer import InnerTrainer, TrainerConfig
+from opendiloco_tpu.utils.logger import get_logger, get_text_logger
+
+log = get_text_logger(__name__)
+
+
+def make_backend(cfg: DilocoConfig) -> OuterBackend:
+    if cfg.backend == "tcp":
+        from opendiloco_tpu.diloco.tcp import TcpBackend
+
+        return TcpBackend(
+            cfg.initial_peers,
+            host=cfg.host if cfg.host != "0.0.0.0" else "127.0.0.1",
+            port=cfg.port,
+            peer_id=f"worker-{cfg.world_rank}",
+            compression=cfg.compression,
+            matchmaking_time=cfg.matchmaking_time,
+        )
+    raise ValueError(
+        f"backend {cfg.backend!r} has no factory (loopback backends are "
+        "constructed from a shared LoopbackWorld in-process)"
+    )
+
+
+def train(config: Config, backend: Optional[OuterBackend] = None) -> dict:
+    """Returns a summary dict (final step/loss) for programmatic callers."""
+    world_rank = config.diloco.world_rank if config.diloco else 0
+    os.environ.setdefault("DILOCO_WORLD_RANK", str(world_rank))
+
+    model_cfg, params = hf_io.get_model(config.path_model)
+    plan = build_mesh(
+        config.sharding_strategy,
+        dp_size=config.dp_size,
+        fsdp_size=config.fsdp_size,
+        tp_size=config.tp_size,
+        sp_size=config.sp_size,
+    )
+    tc = TrainerConfig(
+        lr=config.lr,
+        weight_decay=config.weight_decay,
+        adam_betas=tuple(config.adam_betas),
+        warmup_steps=config.warmup_steps,
+        total_steps=config.total_steps,
+        max_grad_norm=config.max_grad_norm,
+        precision=config.precision,
+        attn_impl=config.attn_implementation,
+        remat=config.remat,
+    )
+    trainer = InnerTrainer(model_cfg, tc, plan)
+
+    if config.ckpt.interval:
+        ckpt_lib.check_checkpoint_path_access(config.ckpt.path, world_rank)
+
+    # batch/accumulation accounting (train_fsdp.py:189-190)
+    dp = plan.data_parallel_size
+    global_micro = config.per_device_train_batch_size * dp
+    accum = max(1, config.total_batch_size // global_micro)
+    if config.total_batch_size % global_micro:
+        raise ValueError(
+            f"total_batch_size {config.total_batch_size} not divisible by "
+            f"per_device_train_batch_size*dp = {global_micro}"
+        )
+
+    loader = get_dataloader(
+        fake_data=config.fake_data,
+        dataset_name_or_paths=config.dataset_name_or_paths,
+        tokenizer_name=config.tokenizer_name,
+        seq_length=config.seq_length,
+        batch_size=config.total_batch_size,
+        vocab_size=model_cfg.vocab_size,
+        world_rank=world_rank,
+        galaxy_size=config.diloco.galaxy_size if config.diloco else 1,
+    )
+
+    state = trainer.init_state(jax.random.key(42), params)
+
+    diloco_opt: Optional[DiLoCoOptimizer] = None
+    owns_backend = False
+    if config.diloco is not None:
+        if backend is None:
+            backend = make_backend(config.diloco)
+            owns_backend = True
+        diloco_opt = DiLoCoOptimizer(
+            trainer, backend, config.diloco, state, batch_size=config.total_batch_size
+        )
+
+    # resume (ckpt_utils.py:23-45 + train_fsdp.py:313-344)
+    start_step = 0
+    resume, resume_dir, resume_step = ckpt_lib.get_resume_info(
+        config.ckpt.resume,
+        config.ckpt.path,
+        diloco_rank=world_rank if config.diloco else None,
+    )
+    if resume:
+        log.info("resuming from %s (step %d)", resume_dir, resume_step)
+        state, diloco_state, loader_state, extra = ckpt_lib.load_checkpoint(
+            resume_dir, state
+        )
+        if diloco_opt is not None and diloco_state is not None:
+            diloco_opt.load_state_dict(diloco_state)
+        if loader_state is not None:
+            loader.load_state_dict(loader_state)
+        start_step = resume_step
+    elif diloco_opt is not None and not config.diloco.skip_load_from_peers:
+        updated = diloco_opt.load_state_from_peers(state)
+        if updated is not None:
+            state = updated
+            log.info("loaded state from peers (epoch %d)", diloco_opt.epoch)
+
+    metric_logger = get_logger(
+        config.metric_logger_type,
+        config.project,
+        config.model_dump(),
+        resume=bool(resume),
+    )
+
+    tokens_per_step = config.total_batch_size * config.seq_length
+    summary = {"step": start_step, "loss": float("nan")}
+    data_iter = iter(loader)
+    try:
+        for step in range(start_step, config.total_steps):
+            t0 = time.perf_counter()
+            host_batch = next(data_iter)
+            batch = trainer.shard_batch(
+                host_batch["input_ids"], host_batch["labels"], accum
+            )
+            if diloco_opt is not None:
+                state, metrics = diloco_opt.step(state, batch)
+            else:
+                state, metrics = trainer.train_step(state, batch)
+
+            real_step = step + 1
+            loss = float(metrics["loss"])
+            dt = time.perf_counter() - t0
+            row = {
+                "Loss": loss,
+                "Perplexity": math.exp(min(loss, 30.0)),
+                "step": real_step,
+                "lr": trainer.current_lr(real_step),
+                "effective_step": real_step * (config.diloco.galaxy_size if config.diloco else 1),
+                "total_samples": real_step * config.total_batch_size,
+                "time_taken": dt,
+                "tokens_per_second": tokens_per_step / dt,
+                "grad_norm": float(metrics["grad_norm"]),
+            }
+            if diloco_opt is not None:
+                row["num_peers"] = diloco_opt.max_num_peers
+                row["outer_epoch"] = diloco_opt.epoch
+                for k in ("outer_step_s", "outer_allreduce_s", "outer_wait_s"):
+                    if k in metrics:
+                        row[k] = metrics[k]
+            if (
+                config.log_activations_steps
+                and real_step % config.log_activations_steps == 0
+            ):
+                row.update(
+                    trainer.probe_norms(state["params"], host_batch["input_ids"])
+                )
+            metric_logger.log(row)
+            if real_step % 10 == 0 or real_step == 1:
+                log.info(
+                    "step %d loss %.4f lr %.2e %.0f tok/s",
+                    real_step,
+                    loss,
+                    row["lr"],
+                    row["tokens_per_second"],
+                )
+            summary = {"step": real_step, "loss": loss}
+
+            if config.ckpt.interval and real_step % config.ckpt.interval == 0:
+                ckpt_lib.save_checkpoint(
+                    config.ckpt.path,
+                    real_step,
+                    state,
+                    diloco_rank=world_rank if config.diloco else None,
+                    diloco_state=diloco_opt.state_dict() if diloco_opt else None,
+                    dataloader_state=loader.state_dict(),
+                    extra={"loss": loss, "step": real_step},
+                )
+                ckpt_lib.delete_old_checkpoints(config.ckpt.path, config.ckpt.topk)
+    except PeerDropError:
+        log.error("a DiLoCo worker dropped and fail_rank_drop is set; exiting")
+        raise
+    finally:
+        loader.stop()
+        metric_logger.finish()
+        if owns_backend and backend is not None:
+            backend.close()
+    return summary
+
+
+def main() -> None:
+    # the axon site hook pins jax_platforms before argv parsing; honor an
+    # explicit override (used by CPU-mesh tests and local dry runs)
+    platform = os.environ.get("OPENDILOCO_TPU_PLATFORM")
+    if platform:
+        jax.config.update("jax_platforms", platform)
+    config = Config(**parse_argv())
+    log.info("starting training: %s", config.model_dump())
+    train(config)
+
+
+if __name__ == "__main__":
+    main()

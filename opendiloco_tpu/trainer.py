@@ -83,11 +83,6 @@ class InnerTrainer:
         self.optimizer = make_inner_optimizer(tc)
         self.schedule = make_schedule(tc)
 
-        if tc.attn_impl == "ring":
-            from opendiloco_tpu.ops.ring_attention import configure_ring
-
-            configure_ring(plan.mesh, plan.sp_axis or "sp")
-
         self.p_specs = param_specs(model_cfg, plan, for_params=True)
         params_shapes = jax.eval_shape(
             functools.partial(init_params, cfg=model_cfg), jax.random.key(0)
@@ -124,6 +119,13 @@ class InnerTrainer:
                 plan.sharding(plan.batch_spec(2)),
             ),
         )
+        self._probe_step = jax.jit(
+            self._probe_step_impl,
+            in_shardings=(
+                self.state_shardings["params"],
+                plan.sharding(plan.batch_spec(2)),
+            ),
+        )
 
     # -- state ------------------------------------------------------------
 
@@ -153,6 +155,8 @@ class InnerTrainer:
             compute_dtype=self.tc.compute_dtype,
             attn_impl=self.tc.attn_impl,
             remat=self.tc.remat,
+            ring_mesh=self.plan.mesh,
+            ring_axis=self.plan.sp_axis or "sp",
         )
         return causal_lm_loss(logits, labels)
 
@@ -190,6 +194,22 @@ class InnerTrainer:
     def _eval_step_impl(self, params: dict, batch: dict):
         return self._loss_fn(params, batch["input_ids"], batch["labels"])
 
+    def _probe_step_impl(self, params: dict, batch: dict):
+        """Activation-norm probes (reference register_metrics_hooks,
+        utils.py:43-67): runs a forward with taps, no grads."""
+        _, aux = forward(
+            params,
+            batch["input_ids"],
+            self.model_cfg,
+            compute_dtype=self.tc.compute_dtype,
+            attn_impl=self.tc.attn_impl,
+            remat=False,
+            return_aux=True,
+            ring_mesh=self.plan.mesh,
+            ring_axis=self.plan.sp_axis or "sp",
+        )
+        return aux
+
     # -- host API ---------------------------------------------------------
 
     def shard_batch(self, input_ids: np.ndarray, labels: np.ndarray, accum: int) -> dict:
@@ -213,6 +233,20 @@ class InnerTrainer:
             "labels": jax.device_put(labels, sharding),
         }
         return float(self._eval_step(params, batch))
+
+    def probe_norms(self, params: dict, input_ids: np.ndarray) -> dict:
+        sharding = self.plan.sharding(self.plan.batch_spec(2))
+        batch = {
+            "input_ids": jax.device_put(input_ids, sharding),
+            "labels": jax.device_put(np.zeros_like(input_ids), sharding),
+        }
+        aux = jax.device_get(self._probe_step(params, batch))
+        out = {
+            f"activation_norm/layers.{i}.self_attn": float(v)
+            for i, v in enumerate(aux["attn_out_norm"])
+        }
+        out["activation_norm/lm_head"] = float(aux["lm_head_norm"])
+        return out
 
     def current_lr(self, step: int) -> float:
         return float(self.schedule(step))

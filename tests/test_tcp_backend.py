@@ -1,0 +1,162 @@
+"""DCN backend tests: rendezvous + TcpBackend on localhost.
+
+The loopback-swarm equivalent of the reference's DHT tests
+(tests/test_diloco_hivemind.py) -- real sockets, in-process daemons.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from opendiloco_tpu.diloco.backend import PeerProgress
+from opendiloco_tpu.diloco.rendezvous import RendezvousServer
+from opendiloco_tpu.diloco.tcp import TcpBackend, deserialize_state, serialize_state
+
+
+@pytest.fixture
+def rendezvous():
+    server = RendezvousServer(host="127.0.0.1", port=0).start_in_thread()
+    yield server
+    server.stop()
+
+
+def make_backends(rendezvous, n, **kwargs):
+    return [
+        TcpBackend(
+            [rendezvous.address],
+            peer_id=f"worker-{i}",
+            matchmaking_time=kwargs.pop("matchmaking_time", 2.0),
+            **kwargs,
+        )
+        for i in range(n)
+    ]
+
+
+def concurrent_allreduce(backends, arrays_per_peer, timeout=60.0):
+    results = [None] * len(backends)
+    errors = []
+
+    def run(i):
+        try:
+            results[i] = backends[i].all_reduce(arrays_per_peer[i], timeout=timeout)
+        except Exception as e:
+            errors.append((i, e))
+
+    threads = [threading.Thread(target=run, args=(i,)) for i in range(len(backends))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=timeout + 30)
+    assert not errors, errors
+    return results
+
+
+def test_state_serialization_roundtrip():
+    state = {
+        "master": [np.arange(7, dtype=np.float32), np.ones((3, 4), np.float64)],
+        "epoch": 5,
+        "outer_opt": {"lr": 0.7, "bufs": None, "nested": [np.zeros(2, np.int32)]},
+    }
+    meta, blob = serialize_state(state)
+    out = deserialize_state(meta, blob)
+    assert out["epoch"] == 5 and out["outer_opt"]["lr"] == 0.7
+    np.testing.assert_array_equal(out["master"][0], state["master"][0])
+    np.testing.assert_array_equal(out["master"][1], state["master"][1])
+    assert out["master"][1].dtype == np.float64
+    np.testing.assert_array_equal(out["outer_opt"]["nested"][0], np.zeros(2))
+
+
+def test_register_and_progress(rendezvous):
+    backends = make_backends(rendezvous, 2)
+    try:
+        for i, b in enumerate(backends):
+            b.report_progress(
+                PeerProgress(b.peer_id, epoch=i, samples=10 * i, samples_per_second=1.0, timestamp=time.time())
+            )
+        # second report sees both peers
+        backends[0].report_progress(
+            PeerProgress(backends[0].peer_id, 0, 0, 1.0, time.time())
+        )
+        progress = backends[0].peer_progress()
+        assert {p.peer_id for p in progress} == {"worker-0", "worker-1"}
+        assert backends[0].num_peers() == 2
+    finally:
+        for b in backends:
+            b.close()
+
+
+@pytest.mark.parametrize("n,compression", [(2, "none"), (4, "none"), (3, "scaled-fp16")])
+def test_allreduce_mean(rendezvous, n, compression):
+    backends = make_backends(rendezvous, n, compression=compression)
+    try:
+        rng = np.random.default_rng(0)
+        shapes = [(100,), (33, 5), (7,)]
+        data = [
+            [rng.normal(scale=0.1, size=s).astype(np.float32) for s in shapes]
+            for _ in range(n)
+        ]
+        results = concurrent_allreduce(backends, data)
+        expected = [np.mean([data[i][j] for i in range(n)], axis=0) for j in range(len(shapes))]
+        tol = 1e-6 if compression == "none" else 2e-3
+        for out, group in results:
+            assert group == n
+            for o, e in zip(out, expected):
+                np.testing.assert_allclose(o, e, atol=tol)
+    finally:
+        for b in backends:
+            b.close()
+
+
+def test_allreduce_survives_peer_drop(rendezvous):
+    """A registered-but-dead peer delays the round by the matchmaking window
+    only; survivors complete with the smaller group."""
+    backends = make_backends(rendezvous, 3, matchmaking_time=1.0)
+    try:
+        backends[2].close()  # unregisters
+        data = [[np.full(10, float(i + 1), np.float32)] for i in range(2)]
+        results = concurrent_allreduce(backends[:2], data, timeout=30.0)
+        for out, group in results:
+            assert group == 2
+            np.testing.assert_allclose(out[0], 1.5)
+    finally:
+        for b in backends[:2]:
+            b.close()
+
+
+def test_single_peer_allreduce(rendezvous):
+    (b,) = make_backends(rendezvous, 1, matchmaking_time=0.5)
+    try:
+        out, group = b.all_reduce([np.arange(5, dtype=np.float32)], timeout=20.0)
+        assert group == 1
+        np.testing.assert_array_equal(out[0], np.arange(5))
+    finally:
+        b.close()
+
+
+def test_fetch_state_from_peer(rendezvous):
+    backends = make_backends(rendezvous, 2)
+    try:
+        served = {
+            "master": [np.arange(4, dtype=np.float32)],
+            "epoch": 3,
+            "outer_opt": {"lr": 0.7, "momentum": 0.9, "nesterov": True, "bufs": None},
+        }
+        backends[0].serve_state(lambda: served)
+        # serves_state flag reaches the rendezvous with the next progress report
+        backends[0].report_progress(
+            PeerProgress(backends[0].peer_id, 3, 0, 1.0, time.time())
+        )
+        got = backends[1].fetch_state()
+        assert got is not None
+        assert got["epoch"] == 3
+        np.testing.assert_array_equal(got["master"][0], served["master"][0])
+    finally:
+        for b in backends:
+            b.close()
+
+
+def test_bad_rendezvous_address():
+    with pytest.raises(RuntimeError):
+        TcpBackend(["127.0.0.1:1"], peer_id="nope", rpc_timeout=2.0)
