@@ -1,0 +1,167 @@
+// Native host-side kernels for the DiLoCo outer loop.
+//
+// The reference's performance-critical native code lives in its dependencies
+// (Go libp2p daemon, NCCL, CUDA flash-attn -- SURVEY.md §2.3). On TPU the
+// device side is XLA/Pallas; what remains host-critical is the outer-loop
+// data plane: wire codec encode/decode and the butterfly-reduce
+// accumulation over multi-GB pseudo-gradient buffers. These single-pass,
+// OpenMP-parallel kernels replace multi-pass numpy pipelines.
+//
+// Build: make -C native   (produces native/libodtp.so; the Python wrapper
+// opendiloco_tpu/native/__init__.py falls back to numpy when absent)
+
+#include <cstdint>
+#include <cstddef>
+#include <cmath>
+#include <cstring>
+#include <algorithm>
+
+#if defined(__F16C__)
+#include <immintrin.h>
+#endif
+
+namespace {
+
+inline uint16_t f32_to_f16_scalar(float f) {
+#if defined(__F16C__)
+    return _cvtss_sh(f, _MM_FROUND_TO_NEAREST_INT);
+#else
+    // bit-exact round-to-nearest-even software conversion
+    uint32_t x;
+    std::memcpy(&x, &f, 4);
+    uint32_t sign = (x >> 16) & 0x8000u;
+    uint32_t mant = x & 0x7fffffu;
+    int32_t exp = (int32_t)((x >> 23) & 0xffu) - 127 + 15;
+    if (((x >> 23) & 0xffu) == 0xffu) {  // inf/nan
+        return (uint16_t)(sign | 0x7c00u | (mant ? 0x200u : 0));
+    }
+    if (exp >= 31) return (uint16_t)(sign | 0x7c00u);  // overflow -> inf
+    if (exp <= 0) {                                    // subnormal/zero
+        if (exp < -10) return (uint16_t)sign;
+        mant |= 0x800000u;
+        uint32_t shift = (uint32_t)(14 - exp);
+        uint32_t half = mant >> shift;
+        uint32_t rem = mant & ((1u << shift) - 1);
+        uint32_t halfway = 1u << (shift - 1);
+        if (rem > halfway || (rem == halfway && (half & 1))) half++;
+        return (uint16_t)(sign | half);
+    }
+    uint32_t half = (uint32_t)(exp << 10) | (mant >> 13);
+    uint32_t rem = mant & 0x1fffu;
+    if (rem > 0x1000u || (rem == 0x1000u && (half & 1))) half++;
+    return (uint16_t)(sign | half);
+#endif
+}
+
+inline float f16_to_f32_scalar(uint16_t h) {
+#if defined(__F16C__)
+    return _cvtsh_ss(h);
+#else
+    uint32_t sign = (uint32_t)(h & 0x8000u) << 16;
+    uint32_t exp = (h >> 10) & 0x1fu;
+    uint32_t mant = h & 0x3ffu;
+    uint32_t x;
+    if (exp == 0) {
+        if (mant == 0) {
+            x = sign;
+        } else {  // subnormal
+            int e = -1;
+            do { mant <<= 1; e++; } while (!(mant & 0x400u));
+            mant &= 0x3ffu;
+            x = sign | ((uint32_t)(127 - 15 - e) << 23) | (mant << 13);
+        }
+    } else if (exp == 31) {
+        x = sign | 0x7f800000u | (mant << 13);
+    } else {
+        x = sign | ((exp - 15 + 127) << 23) | (mant << 13);
+    }
+    float f;
+    std::memcpy(&f, &x, 4);
+    return f;
+#endif
+}
+
+}  // namespace
+
+extern "C" {
+
+// dst += src (the reduce in reduce-scatter)
+void odtp_add_f32(float* dst, const float* src, size_t n) {
+#pragma omp parallel for schedule(static)
+    for (ptrdiff_t i = 0; i < (ptrdiff_t)n; ++i) dst[i] += src[i];
+}
+
+// dst *= s (the mean)
+void odtp_scale_f32(float* dst, float s, size_t n) {
+#pragma omp parallel for schedule(static)
+    for (ptrdiff_t i = 0; i < (ptrdiff_t)n; ++i) dst[i] *= s;
+}
+
+// a - b -> out (pseudo-gradient)
+void odtp_sub_f32(const float* a, const float* b, float* out, size_t n) {
+#pragma omp parallel for schedule(static)
+    for (ptrdiff_t i = 0; i < (ptrdiff_t)n; ++i) out[i] = a[i] - b[i];
+}
+
+void odtp_f32_to_f16(const float* src, uint16_t* dst, size_t n) {
+#pragma omp parallel for schedule(static)
+    for (ptrdiff_t i = 0; i < (ptrdiff_t)n; ++i) dst[i] = f32_to_f16_scalar(src[i]);
+}
+
+void odtp_f16_to_f32(const uint16_t* src, float* dst, size_t n) {
+#pragma omp parallel for schedule(static)
+    for (ptrdiff_t i = 0; i < (ptrdiff_t)n; ++i) dst[i] = f16_to_f32_scalar(src[i]);
+}
+
+// fused: dst += decode_f16(src) -- the butterfly collect step in one pass
+void odtp_f16_accumulate_f32(const uint16_t* src, float* dst, size_t n) {
+#pragma omp parallel for schedule(static)
+    for (ptrdiff_t i = 0; i < (ptrdiff_t)n; ++i) dst[i] += f16_to_f32_scalar(src[i]);
+}
+
+// blockwise absmax int8 quantization (one fp32 scale per `block` values)
+void odtp_quantize_blockwise_i8(const float* src, int8_t* q, float* scales,
+                                size_t n, size_t block) {
+    size_t nblocks = (n + block - 1) / block;
+#pragma omp parallel for schedule(static)
+    for (ptrdiff_t b = 0; b < (ptrdiff_t)nblocks; ++b) {
+        size_t lo = (size_t)b * block, hi = std::min(lo + block, n);
+        float amax = 0.f;
+        for (size_t i = lo; i < hi; ++i) amax = std::max(amax, std::fabs(src[i]));
+        float s = amax > 0.f ? amax : 1.f;
+        scales[b] = s;
+        float inv = 127.f / s;
+        for (size_t i = lo; i < hi; ++i) {
+            float v = src[i] * inv;
+            v = std::min(127.f, std::max(-127.f, std::nearbyint(v)));
+            q[i] = (int8_t)v;
+        }
+    }
+}
+
+void odtp_dequantize_blockwise_i8(const int8_t* q, const float* scales,
+                                  float* dst, size_t n, size_t block) {
+    size_t nblocks = (n + block - 1) / block;
+#pragma omp parallel for schedule(static)
+    for (ptrdiff_t b = 0; b < (ptrdiff_t)nblocks; ++b) {
+        size_t lo = (size_t)b * block, hi = std::min(lo + block, n);
+        float s = scales[b] / 127.f;
+        for (size_t i = lo; i < hi; ++i) dst[i] = (float)q[i] * s;
+    }
+}
+
+// fused: dst += dequantize(q) -- collect step for 8-bit wires
+void odtp_dequantize_blockwise_i8_accumulate(const int8_t* q, const float* scales,
+                                             float* dst, size_t n, size_t block) {
+    size_t nblocks = (n + block - 1) / block;
+#pragma omp parallel for schedule(static)
+    for (ptrdiff_t b = 0; b < (ptrdiff_t)nblocks; ++b) {
+        size_t lo = (size_t)b * block, hi = std::min(lo + block, n);
+        float s = scales[b] / 127.f;
+        for (size_t i = lo; i < hi; ++i) dst[i] += (float)q[i] * s;
+    }
+}
+
+int odtp_version() { return 1; }
+
+}  // extern "C"

@@ -56,12 +56,17 @@ class OuterBackend(abc.ABC):
 
     @abc.abstractmethod
     def all_reduce(
-        self, arrays: list[np.ndarray], *, timeout: Optional[float] = None
+        self,
+        arrays: list[np.ndarray],
+        *,
+        timeout: Optional[float] = None,
+        tag: str = "grads",
     ) -> tuple[list[np.ndarray], int]:
         """Average the arrays across the group; returns (averaged, group_size).
 
         Blocks until the group round completes; raises AllReduceError on
-        timeout/failure. Wire compression is a backend concern.
+        timeout/failure. ``tag`` namespaces concurrent round types (gradient
+        vs state averaging). Wire compression is a backend concern.
         """
 
     @abc.abstractmethod

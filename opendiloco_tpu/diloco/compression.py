@@ -2,17 +2,24 @@
 
 Same menu as the reference's compression flag (open_diloco/utils.py:83-121,
 mapping to hivemind compression classes): none / fp16 / scaled-fp16 /
-uniform8bit / quantile8bit / blockwise8bit. Pure numpy host-side codecs --
-the outer loop runs on host pytrees, never on TPU.
+uniform8bit / quantile8bit / blockwise8bit.
 
-Each codec turns one float32 ndarray into (payload bytes, meta dict) and
-back. Lossy codecs are used for the *pseudo-gradients* on the wire; the
-averaged result is decoded back to float32 before the outer optimizer step.
+Design constraints:
+- ``meta`` must be JSON-serializable (it rides the frame header,
+  diloco/wire.py); binary side-channels (block scales, quantile codebooks)
+  are prepended to the payload instead.
+- Hot paths (fp16 conversion, blockwise quantization, decode+accumulate)
+  dispatch to the native kernels (native/odtp_kernels.cpp) when built, with
+  numpy fallbacks -- identical semantics either way.
+- ``decode_accumulate`` fuses the butterfly collect step (decode + sum) into
+  one pass over the buffer.
 """
 
 from __future__ import annotations
 
 import numpy as np
+
+from opendiloco_tpu import native
 
 _BLOCK = 4096
 
@@ -21,22 +28,31 @@ class Codec:
     name: str = "none"
 
     def encode(self, arr: np.ndarray) -> tuple[bytes, dict]:
-        return arr.astype(np.float32).tobytes(), {}
+        return np.ascontiguousarray(arr, np.float32).tobytes(), {}
 
     def decode(self, payload: bytes, shape: tuple[int, ...], meta: dict) -> np.ndarray:
         return np.frombuffer(payload, dtype=np.float32).reshape(shape).copy()
+
+    def decode_accumulate(
+        self, payload: bytes, meta: dict, dst: np.ndarray
+    ) -> None:
+        """dst += decode(payload); dst is float32, shape defines layout."""
+        native.add_inplace(
+            dst, np.frombuffer(payload, np.float32).reshape(dst.shape)
+        )
 
 
 class Float16Codec(Codec):
     name = "fp16"
 
     def encode(self, arr):
-        return arr.astype(np.float16).tobytes(), {}
+        return native.f32_to_f16_bytes(arr), {}
 
     def decode(self, payload, shape, meta):
-        return (
-            np.frombuffer(payload, dtype=np.float16).astype(np.float32).reshape(shape)
-        )
+        return native.f16_bytes_to_f32(payload, int(np.prod(shape))).reshape(shape)
+
+    def decode_accumulate(self, payload, meta, dst):
+        native.f16_accumulate(payload, dst)
 
 
 class ScaledFloat16Codec(Codec):
@@ -46,13 +62,21 @@ class ScaledFloat16Codec(Codec):
     name = "scaled-fp16"
 
     def encode(self, arr):
+        arr = np.asarray(arr, np.float32)
         scale = float(np.max(np.abs(arr))) if arr.size else 0.0
         scale = scale if scale > 0 else 1.0
-        return (arr / scale).astype(np.float16).tobytes(), {"scale": scale}
+        return native.f32_to_f16_bytes(arr / scale), {"scale": scale}
 
     def decode(self, payload, shape, meta):
-        out = np.frombuffer(payload, dtype=np.float16).astype(np.float32)
-        return (out * meta["scale"]).reshape(shape)
+        out = native.f16_bytes_to_f32(payload, int(np.prod(shape)))
+        out *= meta["scale"]
+        return out.reshape(shape)
+
+    def decode_accumulate(self, payload, meta, dst):
+        # accumulate unscaled then rescale the contribution: dst += s * dec
+        dec = native.f16_bytes_to_f32(payload, dst.size)
+        native.scale_inplace(dec, float(meta["scale"]))
+        native.add_inplace(dst, dec.reshape(dst.shape))
 
 
 class Uniform8BitCodec(Codec):
@@ -61,6 +85,7 @@ class Uniform8BitCodec(Codec):
     name = "uniform8bit"
 
     def encode(self, arr):
+        arr = np.asarray(arr, np.float32)
         lo = float(arr.min()) if arr.size else 0.0
         hi = float(arr.max()) if arr.size else 0.0
         span = (hi - lo) or 1.0
@@ -74,15 +99,15 @@ class Uniform8BitCodec(Codec):
 
 class Quantile8BitCodec(Codec):
     """256-bucket quantile codebook quantization (hivemind
-    Quantile8BitQuantization equivalent): robust to heavy-tailed grads."""
+    Quantile8BitQuantization equivalent): robust to heavy-tailed grads.
+    Payload layout: [256 x f32 codebook][n x u8 indices]."""
 
     name = "quantile8bit"
 
     def encode(self, arr):
-        flat = arr.reshape(-1).astype(np.float32)
+        flat = np.asarray(arr, np.float32).reshape(-1)
         if flat.size == 0:
-            return b"", {"codebook": np.zeros(256, np.float32).tobytes()}
-        # sample for speed on big tensors
+            return np.zeros(256, np.float32).tobytes(), {}
         sample = flat if flat.size <= 100_000 else np.random.default_rng(0).choice(
             flat, 100_000, replace=False
         )
@@ -91,38 +116,38 @@ class Quantile8BitCodec(Codec):
         idx = np.clip(
             np.searchsorted(edges[1:-1], flat, side="right"), 0, 255
         ).astype(np.uint8)
-        return idx.tobytes(), {"codebook": codebook.tobytes()}
+        return codebook.tobytes() + idx.tobytes(), {}
 
     def decode(self, payload, shape, meta):
-        codebook = np.frombuffer(meta["codebook"], dtype=np.float32)
-        idx = np.frombuffer(payload, dtype=np.uint8)
+        codebook = np.frombuffer(payload[: 256 * 4], dtype=np.float32)
+        idx = np.frombuffer(payload[256 * 4 :], dtype=np.uint8)
         return codebook[idx].reshape(shape)
 
 
 class Blockwise8BitCodec(Codec):
     """Per-block absmax int8 (bitsandbytes/hivemind BlockwiseQuantization
-    style): one fp32 scale per 4096 values."""
+    style): one fp32 scale per 4096 values.
+    Payload layout: [nblocks x f32 scales][n x i8]."""
 
     name = "blockwise8bit"
 
     def encode(self, arr):
-        flat = arr.reshape(-1).astype(np.float32)
-        pad = (-flat.size) % _BLOCK
-        padded = np.pad(flat, (0, pad))
-        blocks = padded.reshape(-1, _BLOCK)
-        scales = np.max(np.abs(blocks), axis=1, keepdims=True)
-        scales[scales == 0] = 1.0
-        q = np.clip(np.round(blocks / scales * 127.0), -127, 127).astype(np.int8)
-        return q.tobytes(), {"scales": scales.astype(np.float32).tobytes(), "pad": pad}
+        arr = np.asarray(arr, np.float32).reshape(-1)
+        q, scales = native.quantize_blockwise(arr, _BLOCK)
+        return scales + q, {"nblocks": (arr.size + _BLOCK - 1) // _BLOCK}
+
+    def _split(self, payload, meta):
+        nb = int(meta["nblocks"])
+        return payload[: nb * 4], payload[nb * 4 :]
 
     def decode(self, payload, shape, meta):
-        q = np.frombuffer(payload, dtype=np.int8).astype(np.float32).reshape(-1, _BLOCK)
-        scales = np.frombuffer(meta["scales"], dtype=np.float32).reshape(-1, 1)
-        flat = (q / 127.0 * scales).reshape(-1)
-        pad = meta["pad"]
-        if pad:
-            flat = flat[:-pad]
-        return flat.reshape(shape)
+        scales, q = self._split(payload, meta)
+        n = int(np.prod(shape))
+        return native.dequantize_blockwise(q, scales, n, _BLOCK).reshape(shape)
+
+    def decode_accumulate(self, payload, meta, dst):
+        scales, q = self._split(payload, meta)
+        native.dequant8_accumulate(q, scales, dst, _BLOCK)
 
 
 _CODECS = {

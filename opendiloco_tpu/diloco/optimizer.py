@@ -24,6 +24,7 @@ from typing import Any, Optional
 import jax
 import numpy as np
 
+from opendiloco_tpu import native
 from opendiloco_tpu.config import DilocoConfig
 from opendiloco_tpu.diloco.backend import OuterBackend, PeerProgress, wait_for_peers
 from opendiloco_tpu.diloco.outer_optimizer import OuterSGD
@@ -147,7 +148,7 @@ class DiLoCoOptimizer:
             np.asarray(x, dtype=np.float32)
             for x in jax.tree.leaves(jax.device_get(state["params"]))
         ]
-        pseudo_grad = [m - d for m, d in zip(self.master, device_flat)]
+        pseudo_grad = [native.sub(m, d) for m, d in zip(self.master, device_flat)]
 
         t1 = time.monotonic()
         averaged, group_size = self.backend.all_reduce(
@@ -169,6 +170,20 @@ class DiLoCoOptimizer:
         self.max_num_peers = max(self.max_num_peers, group_size)
 
         self.outer_opt.step(self.master, averaged)
+
+        # optional periodic full state averaging (hivemind
+        # average_state_every, hivemind_diloco.py:634-638): corrects any
+        # drift the lossy pseudo-gradient compression accumulates
+        if (
+            self.cfg.average_state_every > 0
+            and (self.epoch + 1) % self.cfg.average_state_every == 0
+        ):
+            averaged_state, n = self.backend.all_reduce(
+                self.master, timeout=self.cfg.averaging_timeout, tag="state"
+            )
+            self.master = [np.asarray(a, np.float32) for a in averaged_state]
+            log.info("averaged full state over %d peers at epoch %d", n, self.epoch)
+
         state = self._write_master_to_device(state)  # [H2D]
 
         self.epoch += 1

@@ -377,19 +377,21 @@ class TcpBackend(OuterBackend):
 
         pushes = [push(j) for j in range(n) if j != my_idx]
 
-        # 4. collect everyone's contribution for my part
+        # 4. collect everyone's contribution for my part (fused
+        # decode+accumulate; native single-pass kernels when built)
         async def collect():
-            acc = parts[my_idx].astype(np.float64)
+            from opendiloco_tpu import native as _native
+
+            acc = np.array(parts[my_idx], dtype=np.float32)
             for p in group:
                 if p["peer_id"] == self._peer_id:
                     continue
                 pmeta, payload = await self._wait_mailbox(
                     (round_key, "push", p["peer_id"]), deadline
                 )
-                acc += self.codec.decode(
-                    payload, (int(pmeta["shape"][0]),), pmeta["meta"]
-                )
-            return (acc / n).astype(np.float32)
+                self.codec.decode_accumulate(payload, pmeta["meta"], acc)
+            _native.scale_inplace(acc, 1.0 / n)
+            return acc
 
         results = await asyncio.gather(collect(), *pushes)
         my_avg = results[0]

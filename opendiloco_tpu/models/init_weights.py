@@ -1,0 +1,37 @@
+"""Materialize fresh Llama weights from a size config.
+
+CLI parity with the reference's init_weights.py (open_diloco/init_weights.py:7-25):
+
+    python -m opendiloco_tpu.models.init_weights \\
+        --config 2m --output tests/models/llama-2m-fresh [--seed 42]
+
+Writes an HF-compatible model directory (model.safetensors + config.json)
+loadable by both this framework and ``transformers``.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--config", required=True, help="size name (2m..1b) or config path")
+    ap.add_argument("--output", required=True, help="output model directory")
+    ap.add_argument("--seed", type=int, default=42)
+    args = ap.parse_args(argv)
+
+    import jax
+
+    from opendiloco_tpu.models import hf_io
+    from opendiloco_tpu.models.llama import init_params
+
+    cfg = hf_io.load_config(args.config)
+    params = init_params(jax.random.key(args.seed), cfg)
+    hf_io.save_params(params, cfg, args.output)
+    n = cfg.num_params()
+    print(f"wrote {n:,}-param llama ({args.config}) to {args.output}")
+
+
+if __name__ == "__main__":
+    main()

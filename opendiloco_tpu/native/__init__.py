@@ -1,0 +1,202 @@
+"""ctypes bindings for the native outer-loop kernels (native/odtp_kernels.cpp).
+
+Loads ``native/libodtp.so`` when present (``make -C native``), building it on
+first use if a compiler is available; otherwise every entry point falls back
+to numpy so the framework never hard-requires the native build.
+
+The fused entry points matter most: ``f16_accumulate`` and
+``dequant8_accumulate`` turn the butterfly collect step (decode + add over
+multi-GB buffers) into a single parallel pass.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional
+
+import numpy as np
+
+_NATIVE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "native",
+)
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libodtp.so")
+
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _try_build() -> None:
+    try:
+        subprocess.run(
+            ["make", "-C", _NATIVE_DIR, "-s"],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+    except Exception:
+        pass
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    """The loaded native library, or None (numpy fallback)."""
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    _tried = True
+    if not os.path.exists(_LIB_PATH) and os.environ.get(
+        "OPENDILOCO_TPU_NO_NATIVE_BUILD"
+    ) not in ("1", "true"):
+        _try_build()
+    if not os.path.exists(_LIB_PATH):
+        return None
+    try:
+        lib = ctypes.CDLL(_LIB_PATH)
+    except OSError:
+        return None
+    u16p = ctypes.POINTER(ctypes.c_uint16)
+    f32p = ctypes.POINTER(ctypes.c_float)
+    i8p = ctypes.POINTER(ctypes.c_int8)
+    st = ctypes.c_size_t
+    lib.odtp_add_f32.argtypes = [f32p, f32p, st]
+    lib.odtp_scale_f32.argtypes = [f32p, ctypes.c_float, st]
+    lib.odtp_sub_f32.argtypes = [f32p, f32p, f32p, st]
+    lib.odtp_f32_to_f16.argtypes = [f32p, u16p, st]
+    lib.odtp_f16_to_f32.argtypes = [u16p, f32p, st]
+    lib.odtp_f16_accumulate_f32.argtypes = [u16p, f32p, st]
+    lib.odtp_quantize_blockwise_i8.argtypes = [f32p, i8p, f32p, st, st]
+    lib.odtp_dequantize_blockwise_i8.argtypes = [i8p, f32p, f32p, st, st]
+    lib.odtp_dequantize_blockwise_i8_accumulate.argtypes = [i8p, f32p, f32p, st, st]
+    lib.odtp_version.restype = ctypes.c_int
+    _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    return get_lib() is not None
+
+
+def _f32p(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+def _u16p(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint16))
+
+
+def _i8p(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int8))
+
+
+# -- public ops (native with numpy fallback) --------------------------------
+
+
+def add_inplace(dst: np.ndarray, src: np.ndarray) -> None:
+    """dst += src over float32 buffers."""
+    lib = get_lib()
+    if lib is None or dst.dtype != np.float32 or not dst.flags.c_contiguous:
+        np.add(dst, src, out=dst)
+        return
+    src = np.ascontiguousarray(src, np.float32)
+    lib.odtp_add_f32(_f32p(dst), _f32p(src), dst.size)
+
+
+def scale_inplace(dst: np.ndarray, s: float) -> None:
+    lib = get_lib()
+    if lib is None or dst.dtype != np.float32 or not dst.flags.c_contiguous:
+        np.multiply(dst, s, out=dst)
+        return
+    lib.odtp_scale_f32(_f32p(dst), ctypes.c_float(s), dst.size)
+
+
+def sub(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """a - b -> new float32 array (pseudo-gradient)."""
+    lib = get_lib()
+    a = np.ascontiguousarray(a, np.float32)
+    b = np.ascontiguousarray(b, np.float32)
+    if lib is None:
+        return a - b
+    out = np.empty_like(a)
+    lib.odtp_sub_f32(_f32p(a), _f32p(b), _f32p(out), a.size)
+    return out
+
+
+def f32_to_f16_bytes(a: np.ndarray) -> bytes:
+    lib = get_lib()
+    a = np.ascontiguousarray(a, np.float32)
+    if lib is None:
+        return a.astype(np.float16).tobytes()
+    out = np.empty(a.size, np.uint16)
+    lib.odtp_f32_to_f16(_f32p(a.reshape(-1)), _u16p(out), a.size)
+    return out.tobytes()
+
+
+def f16_bytes_to_f32(payload: bytes, n: int) -> np.ndarray:
+    lib = get_lib()
+    if lib is None:
+        return np.frombuffer(payload, np.float16).astype(np.float32)
+    src = np.frombuffer(payload, np.uint16)
+    out = np.empty(n, np.float32)
+    lib.odtp_f16_to_f32(_u16p(src), _f32p(out), n)
+    return out
+
+
+def f16_accumulate(payload: bytes, dst: np.ndarray) -> None:
+    """dst += decode_f16(payload) in one fused pass."""
+    lib = get_lib()
+    if lib is None or dst.dtype != np.float32 or not dst.flags.c_contiguous:
+        dst += np.frombuffer(payload, np.float16).astype(np.float32).reshape(dst.shape)
+        return
+    src = np.frombuffer(payload, np.uint16)
+    lib.odtp_f16_accumulate_f32(_u16p(src), _f32p(dst), dst.size)
+
+
+def quantize_blockwise(a: np.ndarray, block: int) -> tuple[bytes, bytes]:
+    """-> (int8 payload, float32 scales payload)."""
+    lib = get_lib()
+    a = np.ascontiguousarray(a, np.float32).reshape(-1)
+    nblocks = (a.size + block - 1) // block
+    if lib is None:
+        pad = (-a.size) % block
+        padded = np.pad(a, (0, pad)).reshape(-1, block)
+        scales = np.max(np.abs(padded), axis=1)
+        scales[scales == 0] = 1.0
+        q = np.clip(
+            np.round(padded / scales[:, None] * 127.0), -127, 127
+        ).astype(np.int8)
+        return q.reshape(-1)[: a.size].tobytes(), scales.astype(np.float32).tobytes()
+    q = np.empty(a.size, np.int8)
+    scales = np.empty(nblocks, np.float32)
+    lib.odtp_quantize_blockwise_i8(_f32p(a), _i8p(q), _f32p(scales), a.size, block)
+    return q.tobytes(), scales.tobytes()
+
+
+def dequantize_blockwise(payload: bytes, scales_payload: bytes, n: int, block: int) -> np.ndarray:
+    lib = get_lib()
+    q = np.frombuffer(payload, np.int8)
+    scales = np.frombuffer(scales_payload, np.float32)
+    if lib is None:
+        pad = (-n) % block
+        qp = np.pad(q.astype(np.float32), (0, pad)).reshape(-1, block)
+        out = qp * (scales[:, None] / 127.0)
+        return out.reshape(-1)[:n].copy()
+    out = np.empty(n, np.float32)
+    lib.odtp_dequantize_blockwise_i8(_i8p(q), _f32p(scales), _f32p(out), n, block)
+    return out
+
+
+def dequant8_accumulate(payload: bytes, scales_payload: bytes, dst: np.ndarray, block: int) -> None:
+    """dst += dequantize_blockwise(payload) in one fused pass."""
+    lib = get_lib()
+    if lib is None or dst.dtype != np.float32 or not dst.flags.c_contiguous:
+        dst += dequantize_blockwise(payload, scales_payload, dst.size, block).reshape(
+            dst.shape
+        )
+        return
+    q = np.frombuffer(payload, np.int8)
+    scales = np.frombuffer(scales_payload, np.float32)
+    lib.odtp_dequantize_blockwise_i8_accumulate(
+        _i8p(q), _f32p(scales), _f32p(dst), dst.size, block
+    )

@@ -1,0 +1,56 @@
+#!/usr/bin/env bash
+# Local multi-worker DiLoCo launcher (reference: open_diloco/run_training.sh).
+#
+# Usage: ./scripts/run_training.sh <num_workers> <initial_peer|auto> [extra train flags...]
+#
+#   num_workers   number of DiLoCo workers to spawn on this machine
+#   initial_peer  rendezvous address host:port, or "auto" to start an
+#                 in-process rendezvous daemon on port 29400
+#   extra flags   forwarded verbatim to `python -m opendiloco_tpu.train`
+#
+# Example (8-worker llama-150m, 500 local steps — README.md:131-148 recipe):
+#   ./scripts/run_training.sh 8 auto --path-model 150m \
+#       --total-batch-size 512 --per-device-train-batch-size 32 \
+#       --diloco.local-steps 500 --project my-run
+
+set -euo pipefail
+
+if [ "$#" -lt 2 ]; then
+  echo "usage: $0 <num_workers> <initial_peer|auto> [train flags...]" >&2
+  exit 1
+fi
+
+NUM_WORKERS=$1
+INITIAL_PEER=$2
+shift 2
+
+REPO_DIR="$(cd "$(dirname "$0")/.." && pwd)"
+export PYTHONPATH="$REPO_DIR${PYTHONPATH:+:$PYTHONPATH}"
+
+RDV_PID=""
+if [ "$INITIAL_PEER" = "auto" ]; then
+  INITIAL_PEER="127.0.0.1:29400"
+  python -m opendiloco_tpu.diloco.rendezvous --host 127.0.0.1 --port 29400 \
+    --identity-file "$REPO_DIR/.rendezvous_identity" &
+  RDV_PID=$!
+  trap '[ -n "$RDV_PID" ] && kill $RDV_PID 2>/dev/null || true' EXIT
+  sleep 1
+fi
+
+PIDS=()
+for RANK in $(seq 0 $((NUM_WORKERS - 1))); do
+  # secondary workers keep wandb quiet (reference run_training.sh:69)
+  if [ "$RANK" -ne 0 ]; then export WANDB_MODE=${WANDB_MODE:-disabled}; fi
+  python -m opendiloco_tpu.train \
+    --diloco.initial-peers "$INITIAL_PEER" \
+    --diloco.world-rank "$RANK" \
+    --diloco.galaxy-size "$NUM_WORKERS" \
+    "$@" &
+  PIDS+=($!)
+done
+
+STATUS=0
+for PID in "${PIDS[@]}"; do
+  wait "$PID" || STATUS=$?
+done
+exit $STATUS

@@ -72,7 +72,34 @@ def test_codec_roundtrip(name, tol):
 def test_codec_sizes():
     arr = np.zeros((4096,), np.float32)
     assert len(get_codec("fp16").encode(arr)[0]) == arr.nbytes // 2
-    assert len(get_codec("blockwise8bit").encode(arr)[0]) == arr.nbytes // 4
+    # blockwise payload = 1 block scale (4B) + 4096 int8
+    assert len(get_codec("blockwise8bit").encode(arr)[0]) == arr.nbytes // 4 + 4
+
+
+def test_codec_meta_is_json_serializable():
+    """meta rides the JSON frame header (wire.py) -- bytes would crash."""
+    import json
+
+    rng = np.random.default_rng(0)
+    arr = rng.normal(size=(1000,)).astype(np.float32)
+    for name in ["none", "fp16", "scaled-fp16", "uniform8bit", "quantile8bit", "blockwise8bit"]:
+        _, meta = get_codec(name).encode(arr)
+        json.dumps(meta)  # must not raise
+
+
+@pytest.mark.parametrize(
+    "name", ["none", "fp16", "scaled-fp16", "blockwise8bit"]
+)
+def test_codec_decode_accumulate_matches_decode(name):
+    rng = np.random.default_rng(1)
+    arr = rng.normal(scale=0.1, size=(5000,)).astype(np.float32)
+    codec = get_codec(name)
+    payload, meta = codec.encode(arr)
+    base = rng.normal(size=arr.shape).astype(np.float32)
+    expected = base + codec.decode(payload, arr.shape, meta)
+    got = base.copy()
+    codec.decode_accumulate(payload, meta, got)
+    np.testing.assert_allclose(got, expected, rtol=1e-6, atol=1e-7)
 
 
 # ---------------------------------------------------------------------------
@@ -298,3 +325,74 @@ def test_fail_rank_drop_raises(tiny_cfg):
     with pytest.raises(PeerDropError):
         for ids, labels in data[2:]:
             state, m = opt.step(state, trainer.shard_batch(ids, labels, accum=1))
+
+
+class _FakeProgressBackend:
+    """Scripted backend for deterministic straggler-policy tests (the
+    reference's equivalent test is skipped as flaky,
+    test_diloco_hivemind.py:154-156)."""
+
+    peer_id = "me"
+
+    def __init__(self, script):
+        self.script = script  # list of progress snapshots, popped per poll
+        self.polls = 0
+
+    def peer_progress(self):
+        self.polls += 1
+        snap = self.script[min(self.polls - 1, len(self.script) - 1)]
+        return snap
+
+
+def test_wait_for_all_waits_until_peer_catches_up():
+    import time as _time
+
+    from opendiloco_tpu.diloco.backend import PeerProgress, wait_for_peers
+
+    behind = [PeerProgress("slow", 0, 10, samples_per_second=100.0, timestamp=0)]
+    done = [PeerProgress("slow", 0, 100, samples_per_second=100.0, timestamp=0)]
+    backend = _FakeProgressBackend([behind] * 3 + [done])
+    t0 = _time.monotonic()
+    wait_for_peers(
+        backend,
+        target_samples=100,
+        own_epoch=0,
+        strategy="wait_for_all",
+        timeout_waiting_for_peers=30.0,
+    )
+    assert backend.polls >= 4  # polled until the peer caught up
+    assert _time.monotonic() - t0 < 5.0
+
+
+def test_no_wait_returns_immediately():
+    from opendiloco_tpu.diloco.backend import PeerProgress, wait_for_peers
+
+    behind = [PeerProgress("slow", 0, 0, samples_per_second=0.0, timestamp=0)]
+    backend = _FakeProgressBackend([behind])
+    wait_for_peers(
+        backend,
+        target_samples=100,
+        own_epoch=0,
+        strategy="no_wait",
+        timeout_waiting_for_peers=30.0,
+    )
+    assert backend.polls == 0
+
+
+def test_wait_for_all_times_out_and_proceeds():
+    import time as _time
+
+    from opendiloco_tpu.diloco.backend import PeerProgress, wait_for_peers
+
+    stuck = [PeerProgress("dead", 0, 0, samples_per_second=0.0, timestamp=0)]
+    backend = _FakeProgressBackend([stuck])
+    t0 = _time.monotonic()
+    wait_for_peers(
+        backend,
+        target_samples=100,
+        own_epoch=0,
+        strategy="wait_for_all",
+        timeout_waiting_for_peers=1.0,
+    )
+    dt = _time.monotonic() - t0
+    assert 0.9 <= dt < 3.0  # gave up at the timeout, did not hang

@@ -1,0 +1,70 @@
+"""Native kernel bindings: numpy-equivalence (runs with or without the .so)."""
+
+import numpy as np
+import pytest
+
+from opendiloco_tpu import native
+
+
+@pytest.fixture(scope="module")
+def arrs():
+    rng = np.random.default_rng(0)
+    return (
+        rng.normal(size=10_000).astype(np.float32),
+        rng.normal(size=10_000).astype(np.float32),
+    )
+
+
+def test_lib_loads():
+    # informative, not a failure: CI may lack a toolchain
+    print("native available:", native.available())
+
+
+def test_add_scale_sub(arrs):
+    a, b = arrs
+    d = a.copy()
+    native.add_inplace(d, b)
+    np.testing.assert_allclose(d, a + b, rtol=1e-6)
+    native.scale_inplace(d, 0.5)
+    np.testing.assert_allclose(d, (a + b) * 0.5, rtol=1e-6)
+    np.testing.assert_allclose(native.sub(a, b), a - b)
+
+
+def test_f16_matches_numpy_bitexact(arrs):
+    a, _ = arrs
+    assert native.f32_to_f16_bytes(a) == a.astype(np.float16).tobytes()
+    payload = native.f32_to_f16_bytes(a)
+    np.testing.assert_array_equal(
+        native.f16_bytes_to_f32(payload, a.size),
+        np.frombuffer(payload, np.float16).astype(np.float32),
+    )
+
+
+def test_f16_accumulate(arrs):
+    a, b = arrs
+    payload = native.f32_to_f16_bytes(b)
+    dst = a.copy()
+    native.f16_accumulate(payload, dst)
+    np.testing.assert_allclose(
+        dst, a + np.frombuffer(payload, np.float16).astype(np.float32), rtol=1e-6
+    )
+
+
+def test_blockwise_quant_roundtrip(arrs):
+    a, _ = arrs
+    q, s = native.quantize_blockwise(a, 512)
+    out = native.dequantize_blockwise(q, s, a.size, 512)
+    assert np.abs(out - a).max() <= np.abs(a).max() * 0.02
+    dst = a.copy()
+    native.dequant8_accumulate(q, s, dst, 512)
+    np.testing.assert_allclose(dst, a + out, rtol=1e-5, atol=1e-5)
+
+
+def test_blockwise_partial_last_block():
+    rng = np.random.default_rng(2)
+    a = rng.normal(size=700).astype(np.float32)  # 512 + 188
+    q, s = native.quantize_blockwise(a, 512)
+    assert len(q) == 700 and len(s) == 8  # 2 blocks
+    out = native.dequantize_blockwise(q, s, 700, 512)
+    assert out.shape == (700,)
+    assert np.abs(out - a).max() <= np.abs(a).max() * 0.02
