@@ -117,7 +117,7 @@ class Config(BaseModel):
 
     # precision: bf16-mixed = bf16 compute / f32 master params (TPU default;
     # the reference itself recommends bf16 over fp16, README.md:295)
-    precision: Literal["bf16-mixed", "fp32"] = "bf16-mixed"
+    precision: Literal["bf16-mixed", "fp16-mixed", "fp32"] = "bf16-mixed"
 
     # in-worker parallelism (utils.py:138-152 equivalents)
     sharding_strategy: Literal[
@@ -133,6 +133,18 @@ class Config(BaseModel):
     project: str = "opendiloco_tpu"
     metric_logger_type: Literal["wandb", "dummy"] = "wandb"
     log_activations_steps: Optional[int] = None
+    # jax.profiler trace of steps [profile_start, profile_start+profile_steps)
+    profile_dir: Optional[str] = None
+    profile_start: int = 10
+    profile_steps: int = 5
+
+    # multi-host inner loop (one TPU slice spanning hosts):
+    # jax.distributed.initialize() before any jax use (train_fsdp.py:70-72
+    # NCCL-group equivalent). coordinator "host:port"; ranks from env when None
+    multihost: bool = False
+    coordinator_address: Optional[str] = None
+    num_processes: Optional[int] = None
+    process_id: Optional[int] = None
 
     ckpt: CkptConfig = CkptConfig()
     diloco: Optional[DilocoConfig] = None  # None -> plain data-parallel mode

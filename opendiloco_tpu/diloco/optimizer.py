@@ -29,6 +29,7 @@ from opendiloco_tpu.config import DilocoConfig
 from opendiloco_tpu.diloco.backend import OuterBackend, PeerProgress, wait_for_peers
 from opendiloco_tpu.diloco.outer_optimizer import OuterSGD
 from opendiloco_tpu.trainer import InnerTrainer
+from opendiloco_tpu.utils.debug import schema_fingerprint
 from opendiloco_tpu.utils.logger import get_text_logger
 
 log = get_text_logger(__name__)
@@ -66,6 +67,7 @@ class DiLoCoOptimizer:
             lr=cfg.outer_lr, momentum=cfg.outer_momentum, nesterov=cfg.outer_nesterov
         )
 
+        self._schema = schema_fingerprint(state["params"])
         self.epoch = 0  # completed outer steps
         self.local_step = 0  # inner steps within current epoch
         self.samples_in_epoch = 0
@@ -132,6 +134,12 @@ class DiLoCoOptimizer:
     # ------------------------------------------------------------------
 
     def outer_step(self, state: dict) -> tuple[dict, dict]:
+        # parameter layout must be stable across the epoch (schema-hash
+        # assertion, hivemind_diloco.py:560-568,575) -- a changed pytree
+        # here means silent desync, not a recoverable condition
+        assert schema_fingerprint(state["params"]) == self._schema, (
+            "parameter schema changed mid-epoch"
+        )
         t0 = time.monotonic()
         wait_for_peers(
             self.backend,

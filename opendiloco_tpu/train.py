@@ -64,6 +64,22 @@ def train(config: Config, backend: Optional[OuterBackend] = None) -> dict:
     world_rank = config.diloco.world_rank if config.diloco else 0
     os.environ.setdefault("DILOCO_WORLD_RANK", str(world_rank))
 
+    if config.multihost:
+        # in-worker multi-host slice: every host of the slice runs this
+        # driver; jax.distributed wires the hosts into one mesh over ICI/DCN
+        jax.distributed.initialize(
+            coordinator_address=config.coordinator_address,
+            num_processes=config.num_processes,
+            process_id=config.process_id,
+        )
+        log.info(
+            "multihost: process %d/%d, %d local / %d global devices",
+            jax.process_index(),
+            jax.process_count(),
+            jax.local_device_count(),
+            jax.device_count(),
+        )
+
     model_cfg, params = hf_io.get_model(config.path_model)
     plan = build_mesh(
         config.sharding_strategy,
@@ -156,6 +172,11 @@ def train(config: Config, backend: Optional[OuterBackend] = None) -> dict:
     data_iter = iter(loader)
     try:
         for step in range(start_step, config.total_steps):
+            if config.profile_dir and step == start_step + config.profile_start:
+                jax.profiler.start_trace(config.profile_dir)
+            if config.profile_dir and step == start_step + config.profile_start + config.profile_steps:
+                jax.profiler.stop_trace()
+                log.info("wrote profiler trace to %s", config.profile_dir)
             t0 = time.perf_counter()
             host_batch = next(data_iter)
             batch = trainer.shard_batch(

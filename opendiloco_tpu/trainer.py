@@ -39,10 +39,22 @@ class TrainerConfig:
     precision: str = "bf16-mixed"
     attn_impl: str = "xla"
     remat: bool = True
+    # fp16 dynamic loss scaling (torch GradScaler parity, train_fsdp.py:228,
+    # 383-405; bf16 needs none -- the reference itself recommends bf16)
+    init_loss_scale: float = 2.0**15
+    scale_growth_interval: int = 2000
 
     @property
     def compute_dtype(self):
-        return jnp.bfloat16 if self.precision == "bf16-mixed" else jnp.float32
+        if self.precision == "bf16-mixed":
+            return jnp.bfloat16
+        if self.precision == "fp16-mixed":
+            return jnp.float16
+        return jnp.float32
+
+    @property
+    def use_loss_scaling(self) -> bool:
+        return self.precision == "fp16-mixed"
 
 
 def make_schedule(tc: TrainerConfig) -> optax.Schedule:
@@ -100,6 +112,7 @@ class InnerTrainer:
             "params": self.p_specs,
             "opt_state": self.opt_specs,
             "step": P(),
+            "scaler": {"scale": P(), "good_steps": P()},
         }
         self.state_shardings = jax.tree.map(
             plan.sharding, self.state_specs, is_leaf=lambda x: isinstance(x, P)
@@ -143,7 +156,18 @@ class InnerTrainer:
         step = jax.device_put(
             jnp.zeros((), jnp.int32), self.state_shardings["step"]
         )
-        return {"params": params, "opt_state": opt_state, "step": step}
+        scaler = {
+            "scale": jnp.float32(
+                self.tc.init_loss_scale if self.tc.use_loss_scaling else 1.0
+            ),
+            "good_steps": jnp.zeros((), jnp.int32),
+        }
+        return {
+            "params": params,
+            "opt_state": opt_state,
+            "step": step,
+            "scaler": scaler,
+        }
 
     # -- steps ------------------------------------------------------------
 
@@ -164,8 +188,12 @@ class InnerTrainer:
         """batch arrays are [accum, global_microbatch, seq]."""
         params = state["params"]
         accum = batch["input_ids"].shape[0]
+        scale = state["scaler"]["scale"]
 
-        grad_fn = jax.value_and_grad(self._loss_fn)
+        def scaled_loss(p, ids, labels):
+            return self._loss_fn(p, ids, labels) * scale
+
+        grad_fn = jax.value_and_grad(scaled_loss)
 
         def micro(carry, mb):
             loss_sum, grad_sum = carry
@@ -177,17 +205,51 @@ class InnerTrainer:
 
         zero_grads = jax.tree.map(jnp.zeros_like, params)
         (loss_sum, grad_sum), _ = jax.lax.scan(micro, (0.0, zero_grads), batch)
-        grads = jax.tree.map(lambda g: g / accum, grad_sum)
-        loss = loss_sum / accum
+        inv = 1.0 / (accum * scale)
+        grads = jax.tree.map(lambda g: g * inv, grad_sum)
+        loss = loss_sum * inv
 
         grad_norm = optax.global_norm(grads)
         updates, opt_state = self.optimizer.update(
             grads, state["opt_state"], params
         )
-        params = optax.apply_updates(params, updates)
-        metrics = {"loss": loss, "grad_norm": grad_norm}
+        new_params = optax.apply_updates(params, updates)
+
+        if self.tc.use_loss_scaling:
+            # GradScaler semantics (found_inf_grad, utils.py:124-135): on
+            # non-finite grads skip the update and halve the scale; grow 2x
+            # after scale_growth_interval clean steps
+            finite = jnp.isfinite(grad_norm)
+            keep = lambda new, old: jax.tree.map(
+                lambda a, b: jnp.where(finite, a, b), new, old
+            )
+            new_params = keep(new_params, params)
+            opt_state = keep(opt_state, state["opt_state"])
+            good = jnp.where(finite, state["scaler"]["good_steps"] + 1, 0)
+            grow = finite & (good >= self.tc.scale_growth_interval)
+            new_scale = jnp.where(
+                finite, jnp.where(grow, scale * 2.0, scale), scale * 0.5
+            )
+            scaler = {
+                "scale": new_scale,
+                "good_steps": jnp.where(grow, 0, good),
+            }
+            metrics = {
+                "loss": loss,
+                "grad_norm": grad_norm,
+                "found_inf": (~finite).astype(jnp.float32),
+                "loss_scale": scale,
+            }
+        else:
+            scaler = state["scaler"]
+            metrics = {"loss": loss, "grad_norm": grad_norm}
         return (
-            {"params": params, "opt_state": opt_state, "step": state["step"] + 1},
+            {
+                "params": new_params,
+                "opt_state": opt_state,
+                "step": state["step"] + 1,
+                "scaler": scaler,
+            },
             metrics,
         )
 

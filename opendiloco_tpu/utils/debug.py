@@ -1,0 +1,46 @@
+"""Debugging utilities: tensor-content hashing for desync hunts.
+
+Parity: the reference ships ``hash_tensor_content`` (open_diloco/utils.py:70-80)
+to compare parameter state across workers when chasing divergence, plus a
+schema-hash assertion that the optimizer's parameter layout didn't change
+mid-epoch (hivemind_diloco.py:560-568).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Any
+
+import jax
+import numpy as np
+
+
+def hash_array(x) -> str:
+    arr = np.ascontiguousarray(jax.device_get(x))
+    h = hashlib.sha256()
+    h.update(str(arr.dtype).encode())
+    h.update(str(arr.shape).encode())
+    h.update(arr.tobytes())
+    return h.hexdigest()[:16]
+
+
+def hash_pytree(tree: Any) -> str:
+    """Content hash of an entire pytree: equal across workers iff every leaf
+    (values, shapes, dtypes) and the tree structure are equal."""
+    leaves, treedef = jax.tree.flatten(tree)
+    h = hashlib.sha256()
+    h.update(str(treedef).encode())
+    for leaf in leaves:
+        h.update(hash_array(leaf).encode())
+    return h.hexdigest()[:16]
+
+
+def schema_fingerprint(tree: Any) -> str:
+    """Hash of shapes/dtypes/structure only (no values): cheap invariant for
+    asserting the parameter layout is stable across an epoch."""
+    leaves, treedef = jax.tree.flatten(tree)
+    h = hashlib.sha256()
+    h.update(str(treedef).encode())
+    for leaf in leaves:
+        h.update(f"{getattr(leaf, 'shape', ())}/{getattr(leaf, 'dtype', '?')}".encode())
+    return h.hexdigest()[:16]

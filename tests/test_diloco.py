@@ -396,3 +396,17 @@ def test_wait_for_all_times_out_and_proceeds():
     )
     dt = _time.monotonic() - t0
     assert 0.9 <= dt < 3.0  # gave up at the timeout, did not hang
+
+
+def test_hash_pytree_and_schema():
+    from opendiloco_tpu.utils.debug import hash_pytree, schema_fingerprint
+
+    t1 = {"a": np.arange(4, dtype=np.float32), "b": [np.ones(2)]}
+    t2 = {"a": np.arange(4, dtype=np.float32), "b": [np.ones(2)]}
+    t3 = {"a": np.arange(4, dtype=np.float32) + 1, "b": [np.ones(2)]}
+    assert hash_pytree(t1) == hash_pytree(t2)
+    assert hash_pytree(t1) != hash_pytree(t3)
+    # schema ignores values but not shapes
+    assert schema_fingerprint(t1) == schema_fingerprint(t3)
+    t4 = {"a": np.arange(5, dtype=np.float32), "b": [np.ones(2)]}
+    assert schema_fingerprint(t1) != schema_fingerprint(t4)

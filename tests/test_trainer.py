@@ -112,3 +112,42 @@ def test_train_step_with_ring_attention(tiny_cfg):
         state, metrics = trainer.train_step(state, batch)
         losses.append(float(metrics["loss"]))
     np.testing.assert_allclose(np.array(losses), ref, rtol=2e-4, atol=2e-4)
+
+
+def test_fp16_loss_scaling_trains(tiny_cfg):
+    tc = TrainerConfig(
+        lr=1e-3, warmup_steps=2, total_steps=100, precision="fp16-mixed",
+        remat=False, init_loss_scale=2.0**10, scale_growth_interval=4,
+    )
+    plan = build_mesh("NO_SHARD")
+    trainer = InnerTrainer(tiny_cfg, tc, plan)
+    state = trainer.init_state(jax.random.key(0))
+    rng = np.random.default_rng(0)
+    losses, scales = [], []
+    for _ in range(6):
+        ids, labels = make_batch(rng, tiny_cfg.vocab_size)
+        state, m = trainer.train_step(state, trainer.shard_batch(ids, labels, accum=1))
+        losses.append(float(m["loss"]))
+        scales.append(float(m["loss_scale"]))
+        assert float(m["found_inf"]) == 0.0
+    assert np.all(np.isfinite(losses)) and losses[-1] < losses[0]
+    assert scales[-1] == 2.0**11  # grew once after 4 clean steps
+
+
+def test_fp16_overflow_skips_step_and_halves_scale(tiny_cfg):
+    tc = TrainerConfig(
+        lr=1e-3, warmup_steps=2, total_steps=100, precision="fp16-mixed",
+        remat=False, init_loss_scale=1e38,
+    )
+    plan = build_mesh("NO_SHARD")
+    trainer = InnerTrainer(tiny_cfg, tc, plan)
+    state = trainer.init_state(jax.random.key(0))
+    before = jax.device_get(state["params"]["final_norm"])
+    rng = np.random.default_rng(0)
+    ids, labels = make_batch(rng, tiny_cfg.vocab_size)
+    state, m = trainer.train_step(state, trainer.shard_batch(ids, labels, accum=1))
+    assert float(m["found_inf"]) == 1.0
+    np.testing.assert_array_equal(
+        jax.device_get(state["params"]["final_norm"]), before
+    )  # update skipped
+    assert float(jax.device_get(state["scaler"]["scale"])) == pytest.approx(0.5e38)
