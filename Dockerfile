@@ -1,0 +1,22 @@
+# TPU-VM image for opendiloco_tpu (parity role: the reference's CUDA
+# pytorch/pytorch base image -- here the runtime is libtpu + jax).
+FROM python:3.12-slim-bookworm
+
+RUN apt-get update && apt-get install -y --no-install-recommends \
+        build-essential git make g++ \
+    && rm -rf /var/lib/apt/lists/*
+
+WORKDIR /opt/opendiloco_tpu
+COPY pyproject.toml README.md ./
+COPY opendiloco_tpu ./opendiloco_tpu
+COPY native ./native
+COPY scripts ./scripts
+COPY bench.py ./
+
+# jax[tpu] pulls libtpu from the Google releases index on TPU VMs
+RUN pip install --no-cache-dir -U pip \
+    && pip install --no-cache-dir "jax[tpu]" -f https://storage.googleapis.com/jax-releases/libtpu_releases.html \
+    && pip install --no-cache-dir . transformers datasets safetensors wandb fsspec[gcs] \
+    && make -C native
+
+ENTRYPOINT ["python", "-m", "opendiloco_tpu.train"]

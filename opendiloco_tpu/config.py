@@ -133,6 +133,9 @@ class Config(BaseModel):
     project: str = "opendiloco_tpu"
     metric_logger_type: Literal["wandb", "dummy"] = "wandb"
     log_activations_steps: Optional[int] = None
+    # periodic evaluation on the validation split (train_diloco_torch.py:87-110)
+    eval_interval: Optional[int] = None
+    eval_batches: int = 16
     # jax.profiler trace of steps [profile_start, profile_start+profile_steps)
     profile_dir: Optional[str] = None
     profile_start: int = 10

@@ -252,10 +252,13 @@ def get_dataloader(
     world_rank: int = 0,
     galaxy_size: int = 1,
     seed: int = 42,
+    split: str = "train",
 ) -> DataLoader:
     """Reference-shaped factory (train_fsdp.py:132-168)."""
     if fake_data:
-        ds = FakeTokenizedDataset(seq_length, vocab_size, seed=seed + world_rank)
+        # a different seed stream acts as the held-out split
+        offset = 0 if split == "train" else 10_000_019
+        ds = FakeTokenizedDataset(seq_length, vocab_size, seed=seed + world_rank + offset)
     else:
         import jax
 
@@ -263,6 +266,7 @@ def get_dataloader(
             dataset_name_or_paths,
             tokenizer_name,
             seq_length,
+            split=split,
             world_rank=world_rank,
             galaxy_size=galaxy_size,
             process_index=jax.process_index(),

@@ -167,6 +167,21 @@ def train(config: Config, backend: Optional[OuterBackend] = None) -> dict:
         resume=bool(resume),
     )
 
+    eval_iter = None
+    if config.eval_interval:
+        eval_loader = get_dataloader(
+            fake_data=config.fake_data,
+            dataset_name_or_paths=config.dataset_name_or_paths,
+            tokenizer_name=config.tokenizer_name,
+            seq_length=config.seq_length,
+            batch_size=config.per_device_train_batch_size * dp,
+            vocab_size=model_cfg.vocab_size,
+            world_rank=world_rank,
+            galaxy_size=config.diloco.galaxy_size if config.diloco else 1,
+            split="validation",
+        )
+        eval_iter = iter(eval_loader)
+
     tokens_per_step = config.total_batch_size * config.seq_length
     summary = {"step": start_step, "loss": float("nan")}
     data_iter = iter(loader)
@@ -214,6 +229,16 @@ def train(config: Config, backend: Optional[OuterBackend] = None) -> dict:
                 row.update(
                     trainer.probe_norms(state["params"], host_batch["input_ids"])
                 )
+            if eval_iter is not None and real_step % config.eval_interval == 0:
+                eval_losses = []
+                for _ in range(config.eval_batches):
+                    eb = next(eval_iter)
+                    eval_losses.append(
+                        trainer.eval_loss(state["params"], eb["input_ids"], eb["labels"])
+                    )
+                row["eval_loss"] = float(np.mean(eval_losses))
+                row["eval_perplexity"] = math.exp(min(row["eval_loss"], 30.0))
+                log.info("eval at %d: loss %.4f", real_step, row["eval_loss"])
             metric_logger.log(row)
             if real_step % 10 == 0 or real_step == 1:
                 log.info(

@@ -1,0 +1,15 @@
+#!/usr/bin/env bash
+# Fetch C4 locally for offline training (reference: scripts/pull-c4.sh).
+# Streams via the datasets library instead of git-lfs cloning the whole repo.
+#   ./scripts/pull-c4.sh [out_dir] [num_shards]
+set -euo pipefail
+OUT=${1:-data/c4}
+SHARDS=${2:-8}
+python - "$OUT" "$SHARDS" <<'PY'
+import sys
+from datasets import load_dataset
+out, shards = sys.argv[1], int(sys.argv[2])
+ds = load_dataset("allenai/c4", "en", split="train", streaming=False, num_proc=shards)
+ds.save_to_disk(out, num_shards=shards)
+print(f"saved c4/en train to {out}")
+PY

@@ -1,0 +1,13 @@
+#!/usr/bin/env python
+"""Download a model repo from the HF hub (reference: scripts/pull-model.py)."""
+import argparse
+
+from huggingface_hub import snapshot_download
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("model", help="hub id, e.g. PrimeIntellect/llama-150m-fresh")
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args()
+    path = snapshot_download(args.model, local_dir=args.out)
+    print(path)

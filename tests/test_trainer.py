@@ -151,3 +151,12 @@ def test_fp16_overflow_skips_step_and_halves_scale(tiny_cfg):
         jax.device_get(state["params"]["final_norm"]), before
     )  # update skipped
     assert float(jax.device_get(state["scaler"]["scale"])) == pytest.approx(0.5e38)
+
+
+def test_tensor_parallel_equivalence(tiny_cfg):
+    """tp=2 (and tp=2 x fsdp=2) compute the same trajectory as NO_SHARD."""
+    ref, _, _ = run_steps(tiny_cfg, "NO_SHARD")
+    got_tp, _, _ = run_steps(tiny_cfg, "NO_SHARD", tp_size=2)
+    np.testing.assert_allclose(got_tp, ref, rtol=1e-5, atol=1e-5)
+    got_mix, _, _ = run_steps(tiny_cfg, "FULL_SHARD", tp_size=2)
+    np.testing.assert_allclose(got_mix, ref, rtol=1e-5, atol=1e-5)
