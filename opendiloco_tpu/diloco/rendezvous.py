@@ -20,7 +20,7 @@ import uuid
 from dataclasses import dataclass, field
 from typing import Optional
 
-from opendiloco_tpu.diloco.wire import read_frame, send_frame
+from opendiloco_tpu.diloco.wire import STREAM_LIMIT, read_frame, send_frame
 from opendiloco_tpu.utils.logger import get_text_logger
 
 log = get_text_logger(__name__)
@@ -87,7 +87,7 @@ class RendezvousServer:
 
     async def _serve_forever(self) -> None:
         self._loop = asyncio.get_running_loop()
-        self._server = await asyncio.start_server(self._handle, self.host, self.port)
+        self._server = await asyncio.start_server(self._handle, self.host, self.port, limit=STREAM_LIMIT)
         self.port = self._server.sockets[0].getsockname()[1]
         log.info("rendezvous %s listening on %s:%d", self.identity, self.host, self.port)
         self._started.set()

@@ -33,7 +33,7 @@ import numpy as np
 
 from opendiloco_tpu.diloco.backend import AllReduceError, OuterBackend, PeerProgress
 from opendiloco_tpu.diloco.compression import Codec, get_codec
-from opendiloco_tpu.diloco.wire import read_frame, request, send_frame
+from opendiloco_tpu.diloco.wire import STREAM_LIMIT, read_frame, request, send_frame
 from opendiloco_tpu.utils.logger import get_text_logger
 
 log = get_text_logger(__name__)
@@ -137,7 +137,7 @@ class TcpBackend(OuterBackend):
         self._mailbox_cv = asyncio.Condition()
         try:
             self._server = await asyncio.start_server(
-                self._handle_peer, self.host, self.port
+                self._handle_peer, self.host, self.port, limit=STREAM_LIMIT
             )
             self.port = self._server.sockets[0].getsockname()[1]
             _, meta, _ = await request(

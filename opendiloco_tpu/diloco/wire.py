@@ -22,6 +22,9 @@ from typing import Any, Optional
 MAGIC = b"ODTP"
 _HDR = struct.Struct(">4sI")
 MAX_HEADER = 16 * 1024 * 1024
+# StreamReader buffer: the 64KB default throttles multi-hundred-MB tensor
+# frames to well under 1 GB/s; 16MB keeps the read loop off the hot path
+STREAM_LIMIT = 16 * 1024 * 1024
 
 
 class WireError(RuntimeError):
@@ -33,6 +36,19 @@ def encode_frame(msg_type: str, meta: dict[str, Any], payload: bytes = b"") -> b
         {"type": msg_type, "meta": meta, "payload_len": len(payload)}
     ).encode()
     return _HDR.pack(MAGIC, len(header)) + header + payload
+
+
+def _tune_socket(writer: asyncio.StreamWriter) -> None:
+    sock = writer.get_extra_info("socket")
+    if sock is not None:
+        import socket as _socket
+
+        try:
+            sock.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
+            sock.setsockopt(_socket.SOL_SOCKET, _socket.SO_SNDBUF, 4 * 1024 * 1024)
+            sock.setsockopt(_socket.SOL_SOCKET, _socket.SO_RCVBUF, 4 * 1024 * 1024)
+        except OSError:
+            pass
 
 
 async def read_frame(
@@ -58,7 +74,13 @@ async def read_frame(
 async def send_frame(
     writer: asyncio.StreamWriter, msg_type: str, meta: dict[str, Any], payload: bytes = b""
 ) -> None:
-    writer.write(encode_frame(msg_type, meta, payload))
+    # header and payload written separately: no multi-hundred-MB concat copy
+    header = json.dumps(
+        {"type": msg_type, "meta": meta, "payload_len": len(payload)}
+    ).encode()
+    writer.write(_HDR.pack(MAGIC, len(header)) + header)
+    if payload:
+        writer.write(payload)
     await writer.drain()
 
 
@@ -73,8 +95,9 @@ async def request(
 ) -> tuple[str, dict[str, Any], bytes]:
     """One-shot RPC: connect, send one frame, read one frame, close."""
     reader, writer = await asyncio.wait_for(
-        asyncio.open_connection(host, port), timeout
+        asyncio.open_connection(host, port, limit=STREAM_LIMIT), timeout
     )
+    _tune_socket(writer)
     try:
         await send_frame(writer, msg_type, meta, payload)
         return await read_frame(reader, timeout=timeout)

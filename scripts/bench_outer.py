@@ -1,0 +1,122 @@
+#!/usr/bin/env python
+"""Outer-step benchmark: DCN butterfly all-reduce of llama-150m-sized
+pseudo-gradients between N worker processes, per compression codec.
+
+The reference logs outer all-reduce wall-clock but publishes no number
+(BASELINE.md); this gives ours a measurable line:
+
+    python scripts/bench_outer.py [--peers 2] [--model 150m] [--rounds 3]
+
+Each peer is its own process (the real deployment shape -- one worker per
+TPU-VM host); the rendezvous runs in the parent.
+"""
+import argparse
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def worker_main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--worker", action="store_true")
+    ap.add_argument("--rendezvous", required=True)
+    ap.add_argument("--rank", type=int, required=True)
+    ap.add_argument("--model", required=True)
+    ap.add_argument("--compression", required=True)
+    ap.add_argument("--rounds", type=int, required=True)
+    args = ap.parse_args()
+
+    from opendiloco_tpu.diloco.tcp import TcpBackend
+    from opendiloco_tpu.models.hf_io import load_config
+    from opendiloco_tpu.models.llama import shapes
+
+    cfg = load_config(args.model)
+    import jax
+
+    shp = jax.tree.leaves(shapes(cfg))
+    rng = np.random.default_rng(args.rank)
+    data = [rng.normal(scale=1e-3, size=s.shape).astype(np.float32) for s in shp]
+
+    backend = TcpBackend(
+        [args.rendezvous],
+        peer_id=f"bench-{args.rank}",
+        compression=args.compression,
+        matchmaking_time=1.0,
+    )
+    times = []
+    for r in range(args.rounds):
+        t0 = time.perf_counter()
+        out, n = backend.all_reduce(data, timeout=600)
+        times.append(time.perf_counter() - t0)
+    backend.close()
+    if args.rank == 0:
+        print(f"RESULT {min(times):.4f} {n}", flush=True)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--peers", type=int, default=2)
+    ap.add_argument("--model", default="150m")
+    ap.add_argument("--rounds", type=int, default=3)
+    args = ap.parse_args()
+
+    from opendiloco_tpu.diloco.rendezvous import RendezvousServer
+    from opendiloco_tpu.models.hf_io import load_config
+    from opendiloco_tpu.models.llama import shapes
+    import jax
+
+    cfg = load_config(args.model)
+    nbytes = sum(
+        int(np.prod(s.shape)) * 4 for s in jax.tree.leaves(shapes(cfg))
+    )
+    print(f"model {args.model}: {nbytes / 1e6:.0f} MB fp32, {args.peers} peers")
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.setdefault("OPENDILOCO_TPU_PLATFORM", "cpu")
+
+    server = RendezvousServer(host="127.0.0.1", port=0).start_in_thread()
+    try:
+        for compression in ["none", "fp16", "scaled-fp16", "blockwise8bit"]:
+            procs = [
+                subprocess.Popen(
+                    [
+                        sys.executable, os.path.abspath(__file__), "--worker",
+                        "--rendezvous", server.address, "--rank", str(i),
+                        "--model", args.model, "--compression", compression,
+                        "--rounds", str(args.rounds),
+                    ],
+                    stdout=subprocess.PIPE,
+                    text=True,
+                    env=env,
+                )
+                for i in range(args.peers)
+            ]
+            outs = [p.communicate(timeout=900)[0] for p in procs]
+            line = next(
+                (l for o in outs for l in o.splitlines() if l.startswith("RESULT")),
+                None,
+            )
+            if line is None or any(p.returncode for p in procs):
+                print(f"{compression:>14}: FAILED")
+                continue
+            best = float(line.split()[1])
+            print(
+                f"{compression:>14}: {best * 1e3:7.0f} ms/round  "
+                f"({nbytes / best / 1e9:.2f} GB/s effective)"
+            )
+    finally:
+        server.stop()
+
+
+if __name__ == "__main__":
+    if "--worker" in sys.argv:
+        worker_main()
+    else:
+        main()
