@@ -104,9 +104,28 @@ class DiLoCoOptimizer:
     # inner step
     # ------------------------------------------------------------------
 
+    def _behind_swarm(self) -> bool:
+        """True when another peer is >=2 epochs ahead: our pseudo-gradients
+        would poison the average (desync detection, hivemind_diloco.py:528-531).
+        One epoch of skew is normal near boundaries."""
+        for p in self.backend.peer_progress():
+            if p.peer_id != self.backend.peer_id and p.epoch >= self.epoch + 2:
+                return True
+        return False
+
     def step(self, state: dict, batch: dict) -> tuple[dict, dict]:
         """One inner optimizer step; triggers the outer step at the epoch
         boundary. Returns (state, metrics)."""
+        if self.local_step == 0 and self._behind_swarm():
+            # discard the stale local phase and adopt the swarm state before
+            # burning compute on an epoch the group has moved past
+            updated = self.load_state_from_peers(state)
+            if updated is not None:
+                state = updated
+                log.warning(
+                    "desynced from swarm; re-downloaded state at epoch %d",
+                    self.epoch,
+                )
         state, metrics = self.trainer.train_step(state, batch)
         self.local_step += 1
         self.samples_in_epoch += self.batch_size

@@ -410,3 +410,33 @@ def test_hash_pytree_and_schema():
     assert schema_fingerprint(t1) == schema_fingerprint(t3)
     t4 = {"a": np.arange(5, dtype=np.float32), "b": [np.ones(2)]}
     assert schema_fingerprint(t1) != schema_fingerprint(t4)
+
+
+def test_desync_recovery(tiny_cfg):
+    """A worker 2+ epochs behind the swarm re-downloads state instead of
+    training a stale epoch (hivemind_diloco.py:528-531 parity)."""
+    from opendiloco_tpu.diloco.backend import PeerProgress
+
+    trainer = make_trainer(tiny_cfg)
+    state = trainer.init_state(jax.random.key(7))
+    world = LoopbackWorld(1)
+    (backend,) = world.make_backends()
+    cfg = DilocoConfig(local_steps=4, backend="loopback")
+    opt = DiLoCoOptimizer(trainer, backend, cfg, state, batch_size=8)
+
+    # fabricate an advanced peer: serves state at epoch 5 and gossips it
+    advanced_master = [m + 1.0 for m in opt.master]
+    world.state_provider = lambda: {
+        "master": advanced_master,
+        "epoch": 5,
+        "outer_opt": opt.outer_opt.state_dict(),
+    }
+    world.progress["ghost"] = PeerProgress("ghost", epoch=5, samples=0,
+                                           samples_per_second=1.0, timestamp=0)
+    world.live.add("ghost")
+
+    ids, labels = next(batches(0, tiny_cfg.vocab_size, 1))
+    state, m = opt.step(state, trainer.shard_batch(ids, labels, accum=1))
+    assert opt.epoch == 5  # adopted the swarm epoch
+    for a, b in zip(opt.master, advanced_master):
+        np.testing.assert_array_equal(a, b)
