@@ -1,0 +1,453 @@
+// odtp-rendezvousd: native rendezvous daemon for the DiLoCo outer loop.
+//
+// The reference's inter-worker fabric runs through a native daemon (the Go
+// libp2p `p2pd` that hivemind spawns per process, SURVEY.md §2.3). This is
+// the TPU framework's equivalent: a single-threaded poll-loop TCP daemon
+// implementing the same framed wire protocol as the Python rendezvous
+// (opendiloco_tpu/diloco/{wire,rendezvous}.py) -- register / unregister /
+// progress gossip / who_has_state / join_group matchmaking with
+// matchmaking_time windows and TTL liveness. Workers (TcpBackend) cannot
+// tell the two implementations apart; tests run the same backend suite
+// against both.
+//
+// Build: make -C native odtp-rendezvousd
+// Run:   ./native/odtp-rendezvousd --port 29400 [--identity-file id.txt]
+//
+// Frame layout (wire.py): [4B "ODTP"][4B BE header_len][JSON header][payload]
+// header: {"type": ..., "meta": {...}, "payload_len": N}
+
+#include <arpa/inet.h>
+#include <cerrno>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <fcntl.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <signal.h>
+#include <string>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <map>
+#include <set>
+#include <vector>
+#include <algorithm>
+#include <chrono>
+#include <random>
+
+namespace {
+
+double now_s() {
+    using namespace std::chrono;
+    return duration<double>(steady_clock::now().time_since_epoch()).count();
+}
+
+// ---------------------------------------------------------------------------
+// minimal JSON helpers for the flat control-plane headers. Values extracted
+// by key; nested objects can be captured as raw substrings and re-emitted
+// verbatim (the daemon never needs to interpret "progress" internals beyond
+// the epoch).
+// ---------------------------------------------------------------------------
+
+// find the value start for "key": in `s`, or npos
+size_t find_value(const std::string& s, const std::string& key) {
+    std::string pat = "\"" + key + "\"";
+    size_t p = 0;
+    while ((p = s.find(pat, p)) != std::string::npos) {
+        size_t q = p + pat.size();
+        while (q < s.size() && isspace((unsigned char)s[q])) q++;
+        if (q < s.size() && s[q] == ':') {
+            q++;
+            while (q < s.size() && isspace((unsigned char)s[q])) q++;
+            return q;
+        }
+        p += pat.size();
+    }
+    return std::string::npos;
+}
+
+bool get_string(const std::string& s, const std::string& key, std::string* out) {
+    size_t v = find_value(s, key);
+    if (v == std::string::npos || s[v] != '"') return false;
+    std::string r;
+    for (size_t i = v + 1; i < s.size(); ++i) {
+        char c = s[i];
+        if (c == '\\' && i + 1 < s.size()) { r += s[++i]; continue; }
+        if (c == '"') { *out = r; return true; }
+        r += c;
+    }
+    return false;
+}
+
+bool get_number(const std::string& s, const std::string& key, double* out) {
+    size_t v = find_value(s, key);
+    if (v == std::string::npos) return false;
+    try {
+        *out = std::stod(s.substr(v, 32));
+        return true;
+    } catch (...) { return false; }
+}
+
+// capture a raw JSON value (object/number/string/bool/null) as a substring
+bool get_raw(const std::string& s, const std::string& key, std::string* out) {
+    size_t v = find_value(s, key);
+    if (v == std::string::npos) return false;
+    if (s[v] == '{' || s[v] == '[') {
+        char open = s[v], close = (open == '{') ? '}' : ']';
+        int depth = 0; bool in_str = false;
+        for (size_t i = v; i < s.size(); ++i) {
+            char c = s[i];
+            if (in_str) {
+                if (c == '\\') i++;
+                else if (c == '"') in_str = false;
+            } else if (c == '"') in_str = true;
+            else if (c == open) depth++;
+            else if (c == close && --depth == 0) {
+                *out = s.substr(v, i - v + 1);
+                return true;
+            }
+        }
+        return false;
+    }
+    size_t e = v;
+    while (e < s.size() && s[e] != ',' && s[e] != '}' && s[e] != ']') e++;
+    *out = s.substr(v, e - v);
+    while (!out->empty() && isspace((unsigned char)out->back())) out->pop_back();
+    return true;
+}
+
+std::string json_escape(const std::string& s) {
+    std::string r;
+    for (char c : s) {
+        if (c == '"' || c == '\\') { r += '\\'; r += c; }
+        else if (c == '\n') r += "\\n";
+        else r += c;
+    }
+    return r;
+}
+
+// ---------------------------------------------------------------------------
+// daemon state
+// ---------------------------------------------------------------------------
+
+constexpr double PEER_TTL = 60.0;
+
+struct Peer {
+    std::string id, host, raw_progress = "null";
+    int port = 0;
+    double last_seen = 0;
+    bool serves_state = false;
+
+    std::string to_json() const {
+        char buf[256];
+        snprintf(buf, sizeof buf,
+                 "{\"peer_id\":\"%s\",\"host\":\"%s\",\"port\":%d,"
+                 "\"serves_state\":%s,\"progress\":",
+                 json_escape(id).c_str(), json_escape(host).c_str(), port,
+                 serves_state ? "true" : "false");
+        return std::string(buf) + raw_progress + "}";
+    }
+};
+
+struct Round {
+    double deadline = 0;
+    std::set<std::string> joiners;
+    std::vector<int> waiter_fds;
+};
+
+struct Conn {
+    std::string inbuf;
+    std::string outbuf;
+    bool waiting_round = false;  // parked in a matchmaking round
+};
+
+std::map<std::string, Peer> g_peers;
+std::map<std::string, Round> g_rounds;
+std::map<int, Conn> g_conns;
+
+void expire_peers() {
+    double now = now_s();
+    for (auto it = g_peers.begin(); it != g_peers.end();) {
+        if (now - it->second.last_seen > PEER_TTL) {
+            fprintf(stderr, "[odtp-rendezvousd] expiring dead peer %s\n",
+                    it->first.c_str());
+            it = g_peers.erase(it);
+        } else ++it;
+    }
+}
+
+std::string peers_json() {
+    expire_peers();
+    std::string out = "[";
+    bool first = true;
+    for (auto& [id, p] : g_peers) {
+        if (!first) out += ",";
+        out += p.to_json();
+        first = false;
+    }
+    return out + "]";
+}
+
+std::string frame(const std::string& type, const std::string& meta_json) {
+    std::string header =
+        "{\"type\":\"" + type + "\",\"meta\":" + meta_json + ",\"payload_len\":0}";
+    std::string out = "ODTP";
+    uint32_t n = htonl((uint32_t)header.size());
+    out.append(reinterpret_cast<char*>(&n), 4);
+    out += header;
+    return out;
+}
+
+void queue_reply(int fd, const std::string& type, const std::string& meta) {
+    g_conns[fd].outbuf += frame(type, meta);
+}
+
+void close_round(const std::string& key) {
+    auto it = g_rounds.find(key);
+    if (it == g_rounds.end()) return;
+    // group = sorted joiner infos
+    std::vector<std::string> ids(it->second.joiners.begin(), it->second.joiners.end());
+    std::sort(ids.begin(), ids.end());
+    std::string group = "[";
+    bool first = true;
+    for (auto& id : ids) {
+        auto p = g_peers.find(id);
+        if (p == g_peers.end()) continue;
+        if (!first) group += ",";
+        group += p->second.to_json();
+        first = false;
+    }
+    group += "]";
+    for (int fd : it->second.waiter_fds) {
+        auto c = g_conns.find(fd);
+        if (c != g_conns.end()) {
+            c->second.waiting_round = false;
+            c->second.outbuf += frame("ok", "{\"group\":" + group + "}");
+        }
+    }
+    g_rounds.erase(it);
+}
+
+// handle one complete request frame on fd
+void handle(int fd, const std::string& header) {
+    std::string type;
+    if (!get_string(header, "type", &type)) return queue_reply(fd, "error", "{\"error\":\"bad header\"}");
+    std::string meta;
+    if (!get_raw(header, "meta", &meta)) meta = "{}";
+
+    if (type == "register") {
+        Peer p;
+        get_string(meta, "peer_id", &p.id);
+        get_string(meta, "host", &p.host);
+        double port = 0;
+        get_number(meta, "port", &port);
+        p.port = (int)port;
+        p.last_seen = now_s();
+        g_peers[p.id] = p;
+        fprintf(stderr, "[odtp-rendezvousd] peer %s joined from %s:%d\n",
+                p.id.c_str(), p.host.c_str(), p.port);
+        queue_reply(fd, "ok", "{\"identity\":\"odtp-rendezvousd\",\"peers\":" + peers_json() + "}");
+    } else if (type == "unregister") {
+        std::string id;
+        get_string(meta, "peer_id", &id);
+        g_peers.erase(id);
+        queue_reply(fd, "ok", "{}");
+    } else if (type == "progress") {
+        std::string id;
+        get_string(meta, "peer_id", &id);
+        auto it = g_peers.find(id);
+        if (it == g_peers.end()) {
+            // transparent re-registration after TTL expiry
+            std::string host;
+            double port = 0;
+            if (get_string(meta, "host", &host) && get_number(meta, "port", &port)) {
+                Peer p; p.id = id; p.host = host; p.port = (int)port;
+                g_peers[id] = p;
+                it = g_peers.find(id);
+            }
+        }
+        if (it != g_peers.end()) {
+            it->second.last_seen = now_s();
+            std::string prog;
+            if (get_raw(meta, "progress", &prog)) it->second.raw_progress = prog;
+            std::string serves;
+            if (get_raw(meta, "serves_state", &serves))
+                it->second.serves_state = (serves == "true");
+        }
+        queue_reply(fd, "ok", "{\"peers\":" + peers_json() + "}");
+    } else if (type == "who_has_state") {
+        expire_peers();
+        std::string exclude;
+        get_string(meta, "exclude", &exclude);
+        const Peer* best = nullptr;
+        double best_epoch = -1;
+        for (auto& [id, p] : g_peers) {
+            if (!p.serves_state || id == exclude) continue;
+            double epoch = -0.5;
+            get_number(p.raw_progress, "epoch", &epoch);
+            if (epoch > best_epoch) { best_epoch = epoch; best = &p; }
+        }
+        queue_reply(fd, "ok", best ? "{\"peer\":" + best->to_json() + "}"
+                                   : "{\"peer\":null}");
+    } else if (type == "join_group") {
+        std::string id, key;
+        get_string(meta, "peer_id", &id);
+        get_string(meta, "round", &key);
+        double window = 5.0;
+        get_number(meta, "matchmaking_time", &window);
+        auto pit = g_peers.find(id);
+        if (pit != g_peers.end()) pit->second.last_seen = now_s();
+
+        auto& rnd = g_rounds[key];  // creates on first join
+        if (rnd.deadline == 0) rnd.deadline = now_s() + window;
+        if (g_peers.count(id)) rnd.joiners.insert(id);
+        rnd.waiter_fds.push_back(fd);
+        g_conns[fd].waiting_round = true;
+
+        expire_peers();
+        bool all_in = true;
+        for (auto& [pid, _] : g_peers)
+            if (!rnd.joiners.count(pid)) { all_in = false; break; }
+        if (all_in) close_round(key);
+    } else {
+        queue_reply(fd, "error", "{\"error\":\"unknown message\"}");
+    }
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+    int port = 29400;
+    const char* identity_file = nullptr;
+    for (int i = 1; i < argc - 1; ++i) {
+        if (!strcmp(argv[i], "--port")) port = atoi(argv[i + 1]);
+        if (!strcmp(argv[i], "--identity-file")) identity_file = argv[i + 1];
+    }
+    std::string identity = "odtp-rendezvousd";
+    if (identity_file) {
+        FILE* f = fopen(identity_file, "r");
+        if (f) {
+            char buf[64] = {0};
+            if (fgets(buf, sizeof buf, f)) identity = buf;
+            fclose(f);
+        } else if ((f = fopen(identity_file, "w"))) {
+            std::mt19937_64 rng(std::random_device{}());
+            char buf[32];
+            snprintf(buf, sizeof buf, "%016llx", (unsigned long long)rng());
+            identity = buf;
+            fputs(buf, f);
+            fclose(f);
+        }
+    }
+
+    signal(SIGPIPE, SIG_IGN);
+    int lfd = socket(AF_INET, SOCK_STREAM, 0);
+    int one = 1;
+    setsockopt(lfd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof one);
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = INADDR_ANY;
+    addr.sin_port = htons((uint16_t)port);
+    if (bind(lfd, (sockaddr*)&addr, sizeof addr) || listen(lfd, 128)) {
+        perror("bind/listen");
+        return 1;
+    }
+    socklen_t alen = sizeof addr;
+    getsockname(lfd, (sockaddr*)&addr, &alen);
+    printf("rendezvous daemon: initial_peers = 0.0.0.0:%d\n", ntohs(addr.sin_port));
+    fprintf(stderr, "[odtp-rendezvousd] %s listening on :%d\n", identity.c_str(),
+            ntohs(addr.sin_port));
+    fflush(stdout);
+
+    while (true) {
+        std::vector<pollfd> pfds;
+        pfds.push_back({lfd, POLLIN, 0});
+        for (auto& [fd, c] : g_conns) {
+            short ev = 0;
+            if (!c.waiting_round && c.outbuf.empty()) ev |= POLLIN;
+            if (!c.outbuf.empty()) ev |= POLLOUT;
+            if (c.waiting_round) ev |= POLLIN;  // detect client hangup
+            pfds.push_back({fd, ev, 0});
+        }
+        // wake in time to close the earliest matchmaking window
+        int timeout_ms = 250;
+        double now = now_s();
+        for (auto& [k, r] : g_rounds)
+            timeout_ms = std::min(timeout_ms, std::max(1, (int)((r.deadline - now) * 1000)));
+        poll(pfds.data(), pfds.size(), timeout_ms);
+
+        if (pfds[0].revents & POLLIN) {
+            int cfd = accept(lfd, nullptr, nullptr);
+            if (cfd >= 0) {
+                int flag = 1;
+                setsockopt(cfd, IPPROTO_TCP, TCP_NODELAY, &flag, sizeof flag);
+                fcntl(cfd, F_SETFL, O_NONBLOCK);
+                g_conns[cfd] = Conn{};
+            }
+        }
+
+        std::vector<int> to_close;
+        for (size_t i = 1; i < pfds.size(); ++i) {
+            int fd = pfds[i].fd;
+            auto& c = g_conns[fd];
+            if (pfds[i].revents & (POLLERR | POLLHUP)) {
+                to_close.push_back(fd);
+                continue;
+            }
+            if (pfds[i].revents & POLLIN) {
+                char buf[65536];
+                ssize_t n = read(fd, buf, sizeof buf);
+                if (n <= 0) {
+                    if (n == 0 || (errno != EAGAIN && errno != EWOULDBLOCK))
+                        to_close.push_back(fd);
+                } else {
+                    c.inbuf.append(buf, n);
+                    // parse complete frames
+                    while (c.inbuf.size() >= 8) {
+                        if (memcmp(c.inbuf.data(), "ODTP", 4) != 0) {
+                            to_close.push_back(fd);
+                            break;
+                        }
+                        uint32_t hlen;
+                        memcpy(&hlen, c.inbuf.data() + 4, 4);
+                        hlen = ntohl(hlen);
+                        if (c.inbuf.size() < 8 + hlen) break;
+                        std::string header = c.inbuf.substr(8, hlen);
+                        double plen = 0;
+                        get_number(header, "payload_len", &plen);
+                        if (c.inbuf.size() < 8 + hlen + (size_t)plen) break;
+                        c.inbuf.erase(0, 8 + hlen + (size_t)plen);
+                        handle(fd, header);
+                    }
+                }
+            }
+            if ((pfds[i].revents & POLLOUT) && !c.outbuf.empty()) {
+                ssize_t n = write(fd, c.outbuf.data(), c.outbuf.size());
+                if (n > 0) c.outbuf.erase(0, n);
+                else if (errno != EAGAIN && errno != EWOULDBLOCK)
+                    to_close.push_back(fd);
+                if (c.outbuf.empty() && !c.waiting_round) to_close.push_back(fd);
+            }
+        }
+
+        // close expired matchmaking windows
+        now = now_s();
+        std::vector<std::string> expired;
+        for (auto& [k, r] : g_rounds)
+            if (now >= r.deadline) expired.push_back(k);
+        for (auto& k : expired) close_round(k);
+
+        for (int fd : to_close) {
+            // a parked waiter that hung up leaves its round
+            for (auto& [k, r] : g_rounds) {
+                r.waiter_fds.erase(
+                    std::remove(r.waiter_fds.begin(), r.waiter_fds.end(), fd),
+                    r.waiter_fds.end());
+            }
+            g_conns.erase(fd);
+            close(fd);
+        }
+    }
+}

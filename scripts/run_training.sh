@@ -30,8 +30,14 @@ export PYTHONPATH="$REPO_DIR${PYTHONPATH:+:$PYTHONPATH}"
 RDV_PID=""
 if [ "$INITIAL_PEER" = "auto" ]; then
   INITIAL_PEER="127.0.0.1:29400"
-  python -m opendiloco_tpu.diloco.rendezvous --host 127.0.0.1 --port 29400 \
-    --identity-file "$REPO_DIR/.rendezvous_identity" &
+  # prefer the native daemon when built (make -C native)
+  if [ -x "$REPO_DIR/native/odtp-rendezvousd" ]; then
+    "$REPO_DIR/native/odtp-rendezvousd" --port 29400 \
+      --identity-file "$REPO_DIR/.rendezvous_identity" &
+  else
+    python -m opendiloco_tpu.diloco.rendezvous --host 127.0.0.1 --port 29400 \
+      --identity-file "$REPO_DIR/.rendezvous_identity" &
+  fi
   RDV_PID=$!
   trap '[ -n "$RDV_PID" ] && kill $RDV_PID 2>/dev/null || true' EXIT
   sleep 1

@@ -4,6 +4,9 @@ The loopback-swarm equivalent of the reference's DHT tests
 (tests/test_diloco_hivemind.py) -- real sockets, in-process daemons.
 """
 
+import os
+import re
+import subprocess
 import threading
 import time
 
@@ -14,12 +17,41 @@ from opendiloco_tpu.diloco.backend import PeerProgress
 from opendiloco_tpu.diloco.rendezvous import RendezvousServer
 from opendiloco_tpu.diloco.tcp import TcpBackend, deserialize_state, serialize_state
 
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_NATIVE_DAEMON = os.path.join(_REPO, "native", "odtp-rendezvousd")
 
-@pytest.fixture
-def rendezvous():
-    server = RendezvousServer(host="127.0.0.1", port=0).start_in_thread()
-    yield server
-    server.stop()
+
+class _NativeDaemon:
+    """Handle mimicking RendezvousServer for the C++ daemon binary."""
+
+    def __init__(self):
+        self.proc = subprocess.Popen(
+            [_NATIVE_DAEMON, "--port", "0"], stdout=subprocess.PIPE, text=True
+        )
+        line = self.proc.stdout.readline()
+        m = re.search(r":(\d+)", line)
+        assert m, f"daemon did not announce a port: {line!r}"
+        self.address = f"127.0.0.1:{m.group(1)}"
+
+    def stop(self):
+        self.proc.terminate()
+        self.proc.wait(timeout=5)
+
+
+@pytest.fixture(params=["python", "native"])
+def rendezvous(request):
+    """Every test in this file runs against BOTH rendezvous implementations:
+    the asyncio server and the C++ daemon (native/odtp_rendezvousd.cpp)."""
+    if request.param == "native":
+        if not os.path.exists(_NATIVE_DAEMON):
+            pytest.skip("native daemon not built (make -C native)")
+        server = _NativeDaemon()
+        yield server
+        server.stop()
+    else:
+        server = RendezvousServer(host="127.0.0.1", port=0).start_in_thread()
+        yield server
+        server.stop()
 
 
 def make_backends(rendezvous, n, **kwargs):

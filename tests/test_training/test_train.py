@@ -199,3 +199,42 @@ def test_worker_sigkill_survivor_continues(tmp_path):
         assert rows[-1]["outer_epoch"] == 4
     finally:
         server.stop()
+
+
+@pytest.mark.slow
+def test_graft_dryrun_multichip(tmp_path):
+    """The driver's multichip dry-run must work for 4 and 8 virtual devices."""
+    for n in (4, 8):
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={n}"
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        r = subprocess.run(
+            [
+                sys.executable,
+                "-c",
+                "import jax; jax.config.update('jax_platforms', 'cpu');"
+                f"import __graft_entry__ as g; g.dryrun_multichip({n})",
+            ],
+            capture_output=True,
+            text=True,
+            timeout=600,
+            env=env,
+            cwd=REPO,
+        )
+        assert r.returncode == 0, r.stderr[-2000:]
+        assert "dryrun_multichip ok" in r.stdout
+
+
+@pytest.mark.slow
+def test_profile_dir_writes_trace(tmp_path):
+    prof = tmp_path / "trace"
+    r = run_cli(
+        base_args(tmp_path, tmp_path / "prof.pkl", [
+            "--total-steps", "8", "--no-ckpt.interval",
+            "--profile-dir", str(prof), "--profile-start", "2", "--profile-steps", "3",
+        ])
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    files = list(prof.rglob("*"))
+    assert any(f.is_file() for f in files), "no trace files written"
