@@ -156,12 +156,18 @@ class InnerTrainer:
         step = jax.device_put(
             jnp.zeros((), jnp.int32), self.state_shardings["step"]
         )
-        scaler = {
-            "scale": jnp.float32(
-                self.tc.init_loss_scale if self.tc.use_loss_scaling else 1.0
-            ),
-            "good_steps": jnp.zeros((), jnp.int32),
-        }
+        # device_put with the replicated sharding: an uncommitted scalar has
+        # a different aval than the train-step output and would force a
+        # second full compile at step 2
+        scaler = jax.device_put(
+            {
+                "scale": jnp.float32(
+                    self.tc.init_loss_scale if self.tc.use_loss_scaling else 1.0
+                ),
+                "good_steps": jnp.zeros((), jnp.int32),
+            },
+            self.state_shardings["scaler"],
+        )
         return {
             "params": params,
             "opt_state": opt_state,

@@ -440,3 +440,23 @@ def test_desync_recovery(tiny_cfg):
     assert opt.epoch == 5  # adopted the swarm epoch
     for a, b in zip(opt.master, advanced_master):
         np.testing.assert_array_equal(a, b)
+
+
+def test_no_recompilation_across_outer_step(tiny_cfg):
+    """SURVEY hard-part 3: the inner jit step must not recompile after the
+    outer step rewrites params (same shapes/shardings/donation)."""
+    trainer = make_trainer(tiny_cfg)
+    state = trainer.init_state(jax.random.key(7))
+    world = LoopbackWorld(1)
+    (backend,) = world.make_backends()
+    opt = DiLoCoOptimizer(
+        trainer, backend, DilocoConfig(local_steps=2, backend="loopback"), state, 8
+    )
+    data = list(batches(3, tiny_cfg.vocab_size, 5))
+    for ids, labels in data[:2]:
+        state, _ = opt.step(state, trainer.shard_batch(ids, labels, accum=1))
+    assert opt.epoch == 1  # outer step happened
+    n_compiles = trainer._train_step._cache_size()
+    for ids, labels in data[2:]:
+        state, _ = opt.step(state, trainer.shard_batch(ids, labels, accum=1))
+    assert trainer._train_step._cache_size() == n_compiles == 1
