@@ -36,10 +36,12 @@ class Codec:
     def decode_accumulate(
         self, payload: bytes, meta: dict, dst: np.ndarray
     ) -> None:
-        """dst += decode(payload); dst is float32, shape defines layout."""
-        native.add_inplace(
-            dst, np.frombuffer(payload, np.float32).reshape(dst.shape)
-        )
+        """dst += decode(payload); dst is float32, shape defines layout.
+
+        Base implementation routes through ``self.decode`` so every codec is
+        correct by construction; subclasses override with fused single-pass
+        kernels where they exist."""
+        native.add_inplace(dst, self.decode(payload, dst.shape, meta))
 
 
 class Float16Codec(Codec):

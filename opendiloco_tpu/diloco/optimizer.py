@@ -160,6 +160,24 @@ class DiLoCoOptimizer:
             "parameter schema changed mid-epoch"
         )
         t0 = time.monotonic()
+
+        # overlap the D2H transfer with the straggler wait (SURVEY hard-part
+        # 2): the params are final at the boundary, so fetch them while
+        # polling slow peers instead of after
+        fetch_result: list = []
+
+        def _fetch():
+            fetch_result.append(
+                [
+                    np.asarray(x, dtype=np.float32)
+                    for x in jax.tree.leaves(jax.device_get(state["params"]))
+                ]
+            )
+
+        import threading
+
+        fetcher = threading.Thread(target=_fetch)
+        fetcher.start()
         wait_for_peers(
             self.backend,
             target_samples=self.target_samples,
@@ -169,12 +187,10 @@ class DiLoCoOptimizer:
             log=log,
         )
         wait_s = time.monotonic() - t0
+        fetcher.join()
+        device_flat = fetch_result[0]
 
-        # pseudo-gradient = master - current device params  [D2H]
-        device_flat = [
-            np.asarray(x, dtype=np.float32)
-            for x in jax.tree.leaves(jax.device_get(state["params"]))
-        ]
+        # pseudo-gradient = master - current device params
         pseudo_grad = [native.sub(m, d) for m, d in zip(self.master, device_flat)]
 
         t1 = time.monotonic()

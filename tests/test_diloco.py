@@ -88,7 +88,8 @@ def test_codec_meta_is_json_serializable():
 
 
 @pytest.mark.parametrize(
-    "name", ["none", "fp16", "scaled-fp16", "blockwise8bit"]
+    "name",
+    ["none", "fp16", "scaled-fp16", "uniform8bit", "quantile8bit", "blockwise8bit"],
 )
 def test_codec_decode_accumulate_matches_decode(name):
     rng = np.random.default_rng(1)
