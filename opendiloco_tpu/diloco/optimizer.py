@@ -130,16 +130,23 @@ class DiLoCoOptimizer:
         self.local_step += 1
         self.samples_in_epoch += self.batch_size
 
-        elapsed = max(time.monotonic() - self._epoch_t0, 1e-6)
-        self.backend.report_progress(
-            PeerProgress(
-                peer_id=self.backend.peer_id,
-                epoch=self.epoch,
-                samples=self.samples_in_epoch,
-                samples_per_second=self.samples_in_epoch / elapsed,
-                timestamp=time.time(),
+        # progress gossip is a synchronous rendezvous RPC on the TCP backend;
+        # rate-limit it so the training loop never blocks on it per-step
+        # (always report at the epoch boundary so matchmaking sees fresh state)
+        now = time.monotonic()
+        at_boundary = self.local_step >= self.cfg.local_steps
+        if at_boundary or now - getattr(self, "_last_report", 0.0) > 0.5:
+            self._last_report = now
+            elapsed = max(now - self._epoch_t0, 1e-6)
+            self.backend.report_progress(
+                PeerProgress(
+                    peer_id=self.backend.peer_id,
+                    epoch=self.epoch,
+                    samples=self.samples_in_epoch,
+                    samples_per_second=self.samples_in_epoch / elapsed,
+                    timestamp=time.time(),
+                )
             )
-        )
 
         metrics = dict(metrics)
         metrics["epoch"] = self.epoch

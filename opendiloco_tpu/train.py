@@ -185,6 +185,44 @@ def train(config: Config, backend: Optional[OuterBackend] = None) -> dict:
     tokens_per_step = config.total_batch_size * config.seq_length
     summary = {"step": start_step, "loss": float("nan")}
     data_iter = iter(loader)
+    pending = None  # (real_step, device_metrics, dt, extras) of the prior step
+
+    def flush(p) -> None:
+        """Materialize a step's metrics row. Deferred one step behind the
+        dispatch so the float() fetch never stalls the accelerator pipeline."""
+        nonlocal summary
+        real_step, metrics, dt, extras = p
+        loss = float(metrics["loss"])
+        row = {
+            "Loss": loss,
+            "Perplexity": math.exp(min(loss, 30.0)),
+            "step": real_step,
+            "lr": trainer.current_lr(real_step),
+            "effective_step": real_step
+            * (config.diloco.galaxy_size if config.diloco else 1),
+            "total_samples": real_step * config.total_batch_size,
+            "time_taken": dt,
+            "tokens_per_second": tokens_per_step / dt,
+            "grad_norm": float(metrics["grad_norm"]),
+        }
+        if diloco_opt is not None:
+            row["num_peers"] = diloco_opt.max_num_peers
+            row["outer_epoch"] = diloco_opt.epoch
+            for k in ("outer_step_s", "outer_allreduce_s", "outer_wait_s"):
+                if k in metrics:
+                    row[k] = metrics[k]
+        row.update(extras)
+        metric_logger.log(row)
+        if real_step % 10 == 0 or real_step == 1:
+            log.info(
+                "step %d loss %.4f lr %.2e %.0f tok/s",
+                real_step,
+                loss,
+                row["lr"],
+                row["tokens_per_second"],
+            )
+        summary = {"step": real_step, "loss": loss}
+
     try:
         for step in range(start_step, config.total_steps):
             if config.profile_dir and step == start_step + config.profile_start:
@@ -202,31 +240,18 @@ def train(config: Config, backend: Optional[OuterBackend] = None) -> dict:
             else:
                 state, metrics = trainer.train_step(state, batch)
 
+            # the prior step's results are certainly ready now: flush them
+            # while this step runs on device
+            if pending is not None:
+                flush(pending)
             real_step = step + 1
-            loss = float(metrics["loss"])
             dt = time.perf_counter() - t0
-            row = {
-                "Loss": loss,
-                "Perplexity": math.exp(min(loss, 30.0)),
-                "step": real_step,
-                "lr": trainer.current_lr(real_step),
-                "effective_step": real_step * (config.diloco.galaxy_size if config.diloco else 1),
-                "total_samples": real_step * config.total_batch_size,
-                "time_taken": dt,
-                "tokens_per_second": tokens_per_step / dt,
-                "grad_norm": float(metrics["grad_norm"]),
-            }
-            if diloco_opt is not None:
-                row["num_peers"] = diloco_opt.max_num_peers
-                row["outer_epoch"] = diloco_opt.epoch
-                for k in ("outer_step_s", "outer_allreduce_s", "outer_wait_s"):
-                    if k in metrics:
-                        row[k] = metrics[k]
+            extras: dict = {}
             if (
                 config.log_activations_steps
                 and real_step % config.log_activations_steps == 0
             ):
-                row.update(
+                extras.update(
                     trainer.probe_norms(state["params"], host_batch["input_ids"])
                 )
             if eval_iter is not None and real_step % config.eval_interval == 0:
@@ -236,21 +261,14 @@ def train(config: Config, backend: Optional[OuterBackend] = None) -> dict:
                     eval_losses.append(
                         trainer.eval_loss(state["params"], eb["input_ids"], eb["labels"])
                     )
-                row["eval_loss"] = float(np.mean(eval_losses))
-                row["eval_perplexity"] = math.exp(min(row["eval_loss"], 30.0))
-                log.info("eval at %d: loss %.4f", real_step, row["eval_loss"])
-            metric_logger.log(row)
-            if real_step % 10 == 0 or real_step == 1:
-                log.info(
-                    "step %d loss %.4f lr %.2e %.0f tok/s",
-                    real_step,
-                    loss,
-                    row["lr"],
-                    row["tokens_per_second"],
-                )
-            summary = {"step": real_step, "loss": loss}
+                extras["eval_loss"] = float(np.mean(eval_losses))
+                extras["eval_perplexity"] = math.exp(min(extras["eval_loss"], 30.0))
+                log.info("eval at %d: loss %.4f", real_step, extras["eval_loss"])
+            pending = (real_step, metrics, dt, extras)
 
             if config.ckpt.interval and real_step % config.ckpt.interval == 0:
+                flush(pending)
+                pending = None
                 ckpt_lib.save_checkpoint(
                     config.ckpt.path,
                     real_step,
@@ -258,9 +276,12 @@ def train(config: Config, backend: Optional[OuterBackend] = None) -> dict:
                     diloco_rank=world_rank if config.diloco else None,
                     diloco_state=diloco_opt.state_dict() if diloco_opt else None,
                     dataloader_state=loader.state_dict(),
-                    extra={"loss": loss, "step": real_step},
+                    extra={"loss": summary["loss"], "step": real_step},
                 )
                 ckpt_lib.delete_old_checkpoints(config.ckpt.path, config.ckpt.topk)
+        if pending is not None:
+            flush(pending)
+            pending = None
     except PeerDropError:
         log.error("a DiLoCo worker dropped and fail_rank_drop is set; exiting")
         raise
