@@ -36,12 +36,41 @@ def model_flops_per_token(cfg, seq: int) -> float:
     return 6 * n_matmul + attn
 
 
+def _watchdog(seconds: float):
+    """The TPU tunnel can wedge (ops hang forever); emit a diagnostic JSON
+    line and hard-exit rather than hanging the driver."""
+    import os
+    import threading
+
+    def fire():
+        print(
+            json.dumps(
+                {
+                    "metric": "llama-150m inner-loop throughput (seq 1024, bf16)",
+                    "value": 0,
+                    "unit": "tokens/sec/chip",
+                    "vs_baseline": 0,
+                    "extra": {"error": f"accelerator unresponsive after {seconds}s"},
+                }
+            ),
+            flush=True,
+        )
+        os._exit(3)
+
+    t = threading.Timer(seconds, fire)
+    t.daemon = True
+    t.start()
+    return t
+
+
 def main():
     import jax
 
     from opendiloco_tpu.models.hf_io import get_model
     from opendiloco_tpu.parallel.mesh import build_mesh
     from opendiloco_tpu.trainer import InnerTrainer, TrainerConfig
+
+    watchdog = _watchdog(540.0)
 
     cfg, _ = get_model("150m")
     seq, per_dev_bs, accum = 1024, 16, 1
@@ -75,6 +104,7 @@ def main():
     tokens_per_sec_chip = tokens_per_sec / n_chips
     mfu = tokens_per_sec_chip * model_flops_per_token(cfg, seq) / peak_flops_per_chip()
 
+    watchdog.cancel()
     print(
         json.dumps(
             {
