@@ -186,6 +186,7 @@ def train(config: Config, backend: Optional[OuterBackend] = None) -> dict:
     summary = {"step": start_step, "loss": float("nan")}
     data_iter = iter(loader)
     pending = None  # (real_step, device_metrics, dt, extras) of the prior step
+    profiling = False
 
     def flush(p) -> None:
         """Materialize a step's metrics row. Deferred one step behind the
@@ -227,8 +228,10 @@ def train(config: Config, backend: Optional[OuterBackend] = None) -> dict:
         for step in range(start_step, config.total_steps):
             if config.profile_dir and step == start_step + config.profile_start:
                 jax.profiler.start_trace(config.profile_dir)
-            if config.profile_dir and step == start_step + config.profile_start + config.profile_steps:
+                profiling = True
+            if profiling and step == start_step + config.profile_start + config.profile_steps:
                 jax.profiler.stop_trace()
+                profiling = False
                 log.info("wrote profiler trace to %s", config.profile_dir)
             t0 = time.perf_counter()
             host_batch = next(data_iter)
@@ -286,6 +289,15 @@ def train(config: Config, backend: Optional[OuterBackend] = None) -> dict:
         log.error("a DiLoCo worker dropped and fail_rank_drop is set; exiting")
         raise
     finally:
+        if profiling:
+            # a window extending past total_steps must still flush the trace;
+            # never let a trace-serialization failure mask the real error or
+            # skip the remaining cleanup
+            try:
+                jax.profiler.stop_trace()
+                log.info("wrote profiler trace to %s", config.profile_dir)
+            except Exception:
+                log.exception("failed to flush profiler trace")
         loader.stop()
         metric_logger.finish()
         if owns_backend and backend is not None:
