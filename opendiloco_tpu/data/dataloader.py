@@ -253,6 +253,7 @@ def get_dataloader(
     galaxy_size: int = 1,
     seed: int = 42,
     split: str = "train",
+    streaming: bool = True,
 ) -> DataLoader:
     """Reference-shaped factory (train_fsdp.py:132-168)."""
     if fake_data:
@@ -267,6 +268,7 @@ def get_dataloader(
             tokenizer_name,
             seq_length,
             split=split,
+            streaming=streaming,
             world_rank=world_rank,
             galaxy_size=galaxy_size,
             process_index=jax.process_index(),

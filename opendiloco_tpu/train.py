@@ -123,6 +123,7 @@ def train(config: Config, backend: Optional[OuterBackend] = None) -> dict:
         vocab_size=model_cfg.vocab_size,
         world_rank=world_rank,
         galaxy_size=config.diloco.galaxy_size if config.diloco else 1,
+        streaming=config.dataset_streaming,
     )
 
     state = trainer.init_state(jax.random.key(42), params)
@@ -179,6 +180,7 @@ def train(config: Config, backend: Optional[OuterBackend] = None) -> dict:
             world_rank=world_rank,
             galaxy_size=config.diloco.galaxy_size if config.diloco else 1,
             split="validation",
+            streaming=config.dataset_streaming,
         )
         eval_iter = iter(eval_loader)
 
