@@ -1,0 +1,97 @@
+"""Checkpoint utility unit tests (discovery, retention, layout)."""
+
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from opendiloco_tpu import ckpt as ckpt_lib
+
+
+def test_ckpt_dir_layout():
+    assert ckpt_lib.ckpt_dir("/x", 500) == "/x/model_step_500"
+    assert (
+        ckpt_lib.ckpt_dir("/x/", 500, diloco_rank=3)
+        == "/x/model_step_500/diloco_rank_3"
+    )
+
+
+def test_get_resume_info_discovery(tmp_path):
+    # nothing there
+    ok, d, step = ckpt_lib.get_resume_info(True, str(tmp_path))
+    assert not ok and d is None and step == 0
+    # create some steps; discovery picks the numerically largest
+    for s in (10, 9, 100):
+        os.makedirs(tmp_path / f"model_step_{s}")
+    ok, d, step = ckpt_lib.get_resume_info(True, str(tmp_path))
+    assert ok and step == 100 and d.endswith("model_step_100")
+    # explicit dir
+    ok, d, step = ckpt_lib.get_resume_info(
+        str(tmp_path / "model_step_10"), str(tmp_path)
+    )
+    assert ok and step == 10
+    # explicit dir with diloco rank appended
+    ok, d, step = ckpt_lib.get_resume_info(
+        str(tmp_path / "model_step_10"), str(tmp_path), diloco_rank=2
+    )
+    assert ok and step == 10 and d.endswith("model_step_10/diloco_rank_2")
+    # disabled
+    assert ckpt_lib.get_resume_info(None, str(tmp_path)) == (False, None, 0)
+    assert ckpt_lib.get_resume_info(False, str(tmp_path)) == (False, None, 0)
+
+
+def test_delete_old_checkpoints(tmp_path):
+    for s in (1, 2, 3, 4, 5):
+        os.makedirs(tmp_path / f"model_step_{s}")
+    ckpt_lib.delete_old_checkpoints(str(tmp_path), topk=2)
+    left = sorted(os.listdir(tmp_path))
+    assert left == ["model_step_4", "model_step_5"]
+    # topk=None is a no-op
+    ckpt_lib.delete_old_checkpoints(str(tmp_path), topk=None)
+    assert sorted(os.listdir(tmp_path)) == left
+
+
+def test_check_checkpoint_path_access(tmp_path):
+    ckpt_lib.check_checkpoint_path_access(str(tmp_path / "new_dir"), rank=1)
+    with pytest.raises(OSError):
+        ckpt_lib.check_checkpoint_path_access("/proc/definitely/not/writable")
+
+
+def test_save_load_roundtrip_with_diloco_state(tmp_path, tiny_cfg):
+    from opendiloco_tpu.parallel.mesh import build_mesh
+    from opendiloco_tpu.trainer import InnerTrainer, TrainerConfig
+
+    trainer = InnerTrainer(
+        tiny_cfg,
+        TrainerConfig(precision="fp32", remat=False, total_steps=10, warmup_steps=2),
+        build_mesh("FULL_SHARD"),
+    )
+    state = trainer.init_state(jax.random.key(0))
+    diloco_state = {
+        "master": [np.arange(6, dtype=np.float32)],
+        "outer_opt": {"lr": 0.7, "momentum": 0.9, "nesterov": True, "bufs": None},
+        "epoch": 2,
+        "local_step": 1,
+    }
+    d = ckpt_lib.save_checkpoint(
+        str(tmp_path),
+        7,
+        state,
+        diloco_rank=1,
+        diloco_state=diloco_state,
+        dataloader_state={"dataset": {"samples_seen": 99, "seed": 1}},
+        extra={"loss": 1.5},
+    )
+    assert d.endswith("model_step_7/diloco_rank_1")
+
+    state2, dstate2, lstate2, extra2 = ckpt_lib.load_checkpoint(d, state)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+        jax.device_get(state["params"]),
+        jax.device_get(state2["params"]),
+    )
+    assert dstate2["epoch"] == 2 and dstate2["local_step"] == 1
+    np.testing.assert_array_equal(dstate2["master"][0], diloco_state["master"][0])
+    assert lstate2["dataset"]["samples_seen"] == 99
+    assert extra2["loss"] == 1.5

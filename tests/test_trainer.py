@@ -160,3 +160,28 @@ def test_tensor_parallel_equivalence(tiny_cfg):
     np.testing.assert_allclose(got_tp, ref, rtol=1e-5, atol=1e-5)
     got_mix, _, _ = run_steps(tiny_cfg, "FULL_SHARD", tp_size=2)
     np.testing.assert_allclose(got_mix, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_mesh_validation_errors():
+    from opendiloco_tpu.parallel.mesh import build_mesh
+
+    with pytest.raises(ValueError, match="unknown sharding strategy"):
+        build_mesh("ZERO_INFINITY")
+    with pytest.raises(ValueError, match="not divisible"):
+        build_mesh("NO_SHARD", tp_size=3)  # 8 devices % 3 != 0
+    # explicit sizes that don't multiply out
+    with pytest.raises(ValueError):
+        build_mesh("HYBRID_SHARD", dp_size=3, fsdp_size=3)
+
+
+def test_mesh_shapes_per_strategy():
+    from opendiloco_tpu.parallel.mesh import build_mesh
+
+    assert build_mesh("NO_SHARD").mesh.shape == {"dp": 8, "fsdp": 1, "sp": 1, "tp": 1}
+    assert build_mesh("FULL_SHARD").mesh.shape == {"dp": 1, "fsdp": 8, "sp": 1, "tp": 1}
+    plan = build_mesh("HYBRID_SHARD", fsdp_size=4)
+    assert plan.mesh.shape == {"dp": 2, "fsdp": 4, "sp": 1, "tp": 1}
+    assert plan.data_parallel_size == 8
+    plan = build_mesh("NO_SHARD", sp_size=2, tp_size=2)
+    assert plan.mesh.shape == {"dp": 2, "fsdp": 1, "sp": 2, "tp": 2}
+    assert plan.data_parallel_size == 2
