@@ -96,6 +96,7 @@ class Config(BaseModel):
     path_model: str = "configs/config_150m.json"
     attn_implementation: Literal["xla", "pallas", "ring"] = "xla"
     remat: bool = True
+    fused_loss: bool = False  # fused lm-head+xent Pallas kernel
 
     # data
     dataset_name_or_paths: str = "allenai/c4"

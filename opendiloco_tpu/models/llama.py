@@ -190,10 +190,14 @@ def forward(
     remat: bool = True,
     positions: Optional[jax.Array] = None,
     return_aux: bool = False,
+    return_hidden: bool = False,
     ring_mesh=None,
     ring_axis: str = "sp",
 ):
     """input_ids [B, T] int32 -> logits [B, T, V] float32.
+
+    return_hidden=True returns (final_hidden [B, T, D], head [D, V]) instead
+    of logits -- the hook for fused lm-head losses (ops/fused_xent.py).
 
     return_aux=True additionally returns activation-probe metrics
     {"attn_out_norm": [L], "lm_head_norm": scalar} (the reference's
@@ -232,6 +236,8 @@ def forward(
         if cfg.tie_word_embeddings
         else cparams["lm_head"]
     )
+    if return_hidden:
+        return h, head
     logits = (h @ head).astype(jnp.float32)
     if return_aux:
         aux = {

@@ -1,0 +1,237 @@
+"""Fused lm-head + cross-entropy Pallas kernel.
+
+The single largest HBM cost of the small-model train step is materializing
+float32 logits [tokens, vocab] (e.g. 2 GB for 16k tokens x 32k vocab) just to
+reduce them to one scalar. This kernel streams vocab tiles of the head
+matmul through VMEM with an online log-sum-exp, so the full logits never
+touch HBM; the backward pass recomputes tiles and accumulates dh and dW the
+same way (FlashAttention-style recompute, applied to the classifier).
+
+Opt-in via TrainerConfig.fused_loss; numerically equivalent to the
+logits-materializing path (interpret-mode parity tests).
+
+Shapes: h [N, D] tokens, w [D, V] head, labels [N] int32 (IGNORE=-100).
+Returns per-token nll [N] float32 (0 where ignored); mean-reduction happens
+in the caller.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+IGNORE = -100
+
+
+def _pick(n: int, pref: int) -> int:
+    for b in (pref, pref // 2, pref // 4, 128):
+        if b >= 128 and n % b == 0:
+            return b
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# forward: grid (token_blocks, vocab_tiles); scratch carries online stats
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(h_ref, w_ref, lbl_ref, nll_ref, lse_ref, m_s, l_s, tgt_s, *, block_v):
+    j = pl.program_id(1)
+    nv = pl.num_programs(1)
+
+    @pl.when(j == 0)
+    def _():
+        m_s[:] = jnp.full_like(m_s, -1e30)
+        l_s[:] = jnp.zeros_like(l_s)
+        tgt_s[:] = jnp.zeros_like(tgt_s)
+
+    s = jax.lax.dot_general(
+        h_ref[:].astype(jnp.float32),
+        w_ref[:].astype(jnp.float32),
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # [block_n, block_v]
+
+    m_prev = m_s[:]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+    l_s[:] = l_s[:] * jnp.exp(m_prev - m_new) + jnp.sum(
+        jnp.exp(s - m_new), axis=1, keepdims=True
+    )
+    m_s[:] = m_new
+
+    # gather the target logit if it falls inside this vocab tile
+    lbl = lbl_ref[:].reshape(-1, 1)  # [block_n, 1]
+    local = lbl - j * block_v
+    cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    hit = cols == local  # at most one column matches
+    tgt_s[:] = tgt_s[:] + jnp.sum(jnp.where(hit, s, 0.0), axis=1, keepdims=True)
+
+    @pl.when(j == nv - 1)
+    def _():
+        lse = m_s[:] + jnp.log(l_s[:])
+        mask = (lbl != IGNORE).astype(jnp.float32)
+        nll_ref[:] = ((lse - tgt_s[:]) * mask).reshape(nll_ref.shape)
+        lse_ref[:] = lse.reshape(lse_ref.shape)
+
+
+def _fwd(h, w, labels, block_n, block_v):
+    n, d = h.shape
+    v = w.shape[1]
+    grid = (n // block_n, v // block_v)
+    nll, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, block_v=block_v),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block_n, d), lambda i, j: (i, 0)),
+            pl.BlockSpec((d, block_v), lambda i, j: (0, j)),
+            pl.BlockSpec((block_n,), lambda i, j: (i,)),
+        ],
+        out_specs=[
+            pl.BlockSpec((block_n,), lambda i, j: (i,)),
+            pl.BlockSpec((block_n,), lambda i, j: (i,)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n,), jnp.float32),
+            jax.ShapeDtypeStruct((n,), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_n, 1), jnp.float32),
+            pltpu.VMEM((block_n, 1), jnp.float32),
+            pltpu.VMEM((block_n, 1), jnp.float32),
+        ],
+    )(h, w, labels)
+    return nll, lse
+
+
+# ---------------------------------------------------------------------------
+# backward: recompute tiles; dh accumulates over vocab tiles (scratch),
+# dw accumulates over token blocks (output revisiting)
+# ---------------------------------------------------------------------------
+
+
+def _bwd_kernel(
+    h_ref, w_ref, lbl_ref, lse_ref, g_ref, dh_ref, dw_ref, dh_s, *, block_v
+):
+    i = pl.program_id(0)
+    j = pl.program_id(1)
+    ni = pl.num_programs(0)
+    nv = pl.num_programs(1)
+
+    @pl.when(j == 0)
+    def _():
+        dh_s[:] = jnp.zeros_like(dh_s)
+
+    hf = h_ref[:].astype(jnp.float32)
+    wf = w_ref[:].astype(jnp.float32)
+    s = jax.lax.dot_general(
+        hf, wf, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    p = jnp.exp(s - lse_ref[:].reshape(-1, 1))
+
+    lbl = lbl_ref[:].reshape(-1, 1)
+    local = lbl - j * block_v
+    cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    onehot = (cols == local).astype(jnp.float32)
+
+    g = g_ref[:].reshape(-1, 1)  # upstream per-token grad, 0 where ignored
+    dlog = g * (p - onehot)  # [block_n, block_v]
+
+    dh_s[:] = dh_s[:] + jax.lax.dot_general(
+        dlog, wf, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+
+    dw_update = jax.lax.dot_general(
+        hf, dlog, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+
+    @pl.when(i == 0)
+    def _():
+        dw_ref[:] = dw_update.astype(dw_ref.dtype)
+
+    @pl.when(i > 0)
+    def _():
+        dw_ref[:] = dw_ref[:] + dw_update.astype(dw_ref.dtype)
+
+    @pl.when(j == nv - 1)
+    def _():
+        dh_ref[:] = dh_s[:].astype(dh_ref.dtype)
+
+
+def _bwd_impl(h, w, labels, lse, g, block_n, block_v):
+    n, d = h.shape
+    v = w.shape[1]
+    grid = (n // block_n, v // block_v)
+    dh, dw = pl.pallas_call(
+        functools.partial(_bwd_kernel, block_v=block_v),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block_n, d), lambda i, j: (i, 0)),
+            pl.BlockSpec((d, block_v), lambda i, j: (0, j)),
+            pl.BlockSpec((block_n,), lambda i, j: (i,)),
+            pl.BlockSpec((block_n,), lambda i, j: (i,)),
+            pl.BlockSpec((block_n,), lambda i, j: (i,)),
+        ],
+        out_specs=[
+            pl.BlockSpec((block_n, d), lambda i, j: (i, 0)),
+            pl.BlockSpec((d, block_v), lambda i, j: (0, j)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n, d), jnp.float32),
+            jax.ShapeDtypeStruct((d, v), jnp.float32),
+        ],
+        scratch_shapes=[pltpu.VMEM((block_n, d), jnp.float32)],
+    )(h, w, labels, lse, g)
+    return dh, dw
+
+
+# ---------------------------------------------------------------------------
+# public entry with custom vjp
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _fused_nll(h, w, labels, block_n, block_v):
+    nll, _ = _fwd(h, w, labels, block_n, block_v)
+    return nll
+
+
+def _fused_fwd(h, w, labels, block_n, block_v):
+    nll, lse = _fwd(h, w, labels, block_n, block_v)
+    return nll, (h, w, labels, lse)
+
+
+def _fused_bwd(block_n, block_v, res, g):
+    h, w, labels, lse = res
+    mask = (labels != IGNORE).astype(jnp.float32)
+    dh, dw = _bwd_impl(h, w, labels, lse, g * mask, block_n, block_v)
+    return dh.astype(h.dtype), dw.astype(w.dtype), None
+
+
+_fused_nll.defvjp(_fused_fwd, _fused_bwd)
+
+
+def fused_linear_cross_entropy(
+    h: jax.Array, w: jax.Array, labels: jax.Array
+) -> jax.Array:
+    """Mean nll over non-ignored labels; h [N, D], w [D, V], labels [N].
+
+    Falls back to the materializing path for shapes the kernel can't tile.
+    """
+    n, d = h.shape
+    v = w.shape[1]
+    block_n = _pick(n, 1024)
+    block_v = _pick(v, 2048)
+    mask = labels != IGNORE
+    count = jnp.maximum(jnp.sum(mask), 1)
+    if block_n == 0 or block_v == 0 or d % 128 != 0:
+        logits = (h.astype(jnp.float32) @ w.astype(jnp.float32))
+        lp = jax.nn.log_softmax(logits, axis=-1)
+        safe = jnp.where(mask, labels, 0)
+        nll = -jnp.take_along_axis(lp, safe[:, None], axis=1)[:, 0] * mask
+        return jnp.sum(nll) / count
+    nll = _fused_nll(h, w, labels, block_n, block_v)
+    return jnp.sum(nll) / count

@@ -98,6 +98,7 @@ def train(config: Config, backend: Optional[OuterBackend] = None) -> dict:
         precision=config.precision,
         attn_impl=config.attn_implementation,
         remat=config.remat,
+        fused_loss=config.fused_loss,
     )
     trainer = InnerTrainer(model_cfg, tc, plan)
 

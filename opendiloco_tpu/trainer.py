@@ -39,6 +39,9 @@ class TrainerConfig:
     precision: str = "bf16-mixed"
     attn_impl: str = "xla"
     remat: bool = True
+    # fused lm-head + cross-entropy Pallas kernel (ops/fused_xent.py):
+    # avoids materializing [tokens, vocab] float32 logits in HBM
+    fused_loss: bool = False
     # fp16 dynamic loss scaling (torch GradScaler parity, train_fsdp.py:228,
     # 383-405; bf16 needs none -- the reference itself recommends bf16)
     init_loss_scale: float = 2.0**15
@@ -178,6 +181,26 @@ class InnerTrainer:
     # -- steps ------------------------------------------------------------
 
     def _loss_fn(self, params: dict, input_ids: jax.Array, labels: jax.Array):
+        if self.tc.fused_loss:
+            from opendiloco_tpu.ops.fused_xent import fused_linear_cross_entropy
+
+            hidden, head = forward(
+                params,
+                input_ids,
+                self.model_cfg,
+                compute_dtype=self.tc.compute_dtype,
+                attn_impl=self.tc.attn_impl,
+                remat=self.tc.remat,
+                return_hidden=True,
+                ring_mesh=self.plan.mesh,
+                ring_axis=self.plan.sp_axis or "sp",
+            )
+            b, t, d = hidden.shape
+            return fused_linear_cross_entropy(
+                hidden[:, :-1].reshape(-1, d),
+                head,
+                labels[:, 1:].reshape(-1),
+            )
         logits = forward(
             params,
             input_ids,

@@ -22,6 +22,23 @@ def qkv():
 
 
 @pytest.fixture
+def interpret_pallas_fused(monkeypatch):
+    """Interpret-mode pallas for the fused-xent module."""
+    import jax.experimental.pallas as pl
+
+    orig = pl.pallas_call
+
+    def patched(*args, **kwargs):
+        kwargs["interpret"] = True
+        return orig(*args, **kwargs)
+
+    from opendiloco_tpu.ops import fused_xent
+
+    monkeypatch.setattr(fused_xent.pl, "pallas_call", patched)
+    return patched
+
+
+@pytest.fixture
 def interpret_pallas(monkeypatch):
     """Run pallas kernels in interpreter mode (no TPU in CI)."""
     import jax.experimental.pallas as pl
@@ -140,3 +157,35 @@ def test_model_forward_with_ring(tiny_cfg):
         np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=5e-4)
     finally:
         ra.configure_ring(None)
+
+
+def test_fused_loss_matches_standard(interpret_pallas_fused):
+    """Trainer with fused_loss=True computes the same losses/trajectory."""
+    from opendiloco_tpu.models.llama import LlamaConfig
+    from opendiloco_tpu.parallel.mesh import build_mesh
+    from opendiloco_tpu.trainer import InnerTrainer, TrainerConfig
+
+    cfg = LlamaConfig(
+        vocab_size=256, hidden_size=128, intermediate_size=256,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128,
+    )
+    rng = np.random.default_rng(0)
+    ids = ((rng.integers(0, 256, (8, 1)) + np.arange(65)) % 256).astype(np.int32)
+
+    losses = {}
+    for fused in (False, True):
+        tc = TrainerConfig(
+            lr=1e-3, warmup_steps=2, total_steps=50, precision="fp32",
+            remat=False, fused_loss=fused,
+        )
+        trainer = InnerTrainer(cfg, tc, build_mesh("NO_SHARD"))
+        state = trainer.init_state(jax.random.key(1))
+        run = []
+        for _ in range(3):
+            state, m = trainer.train_step(
+                state, trainer.shard_batch(ids, ids.copy(), accum=1)
+            )
+            run.append(float(m["loss"]))
+        losses[fused] = run
+    np.testing.assert_allclose(losses[True], losses[False], rtol=1e-5, atol=1e-6)
