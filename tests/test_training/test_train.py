@@ -238,3 +238,34 @@ def test_profile_dir_writes_trace(tmp_path):
     assert r.returncode == 0, r.stderr[-2000:]
     files = list(prof.rglob("*"))
     assert any(f.is_file() for f in files), "no trace files written"
+
+
+@pytest.mark.slow
+def test_run_training_sh_launcher(tmp_path):
+    """The documented multi-worker launcher works end to end (auto
+    rendezvous via the native daemon when built, else Python)."""
+    env = dict(os.environ)
+    env["OPENDILOCO_TPU_PLATFORM"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    env["WANDB_MODE"] = "disabled"
+    r = subprocess.run(
+        [
+            os.path.join(REPO, "scripts", "run_training.sh"), "2", "auto",
+            "--path-model", "2m", "--fake-data", "--seq-length", "64",
+            "--per-device-train-batch-size", "4", "--total-batch-size", "16",
+            "--total-steps", "8", "--precision", "fp32",
+            "--metric-logger-type", "dummy",
+            "--project", str(tmp_path / "w.pkl"),
+            "--no-ckpt.interval",
+            "--diloco.local-steps", "4",
+            "--diloco.matchmaking-time", "1.5",
+            "--diloco.backend", "tcp",
+            "--diloco.skip-load-from-peers",
+        ],
+        capture_output=True,
+        text=True,
+        timeout=600,
+        env=env,
+        cwd=REPO,
+    )
+    assert r.returncode == 0, (r.stdout[-1500:], r.stderr[-1500:])
