@@ -77,10 +77,14 @@ def main():
     n_chips = len(jax.devices())
     bs = per_dev_bs * n_chips
 
+    import os
+
     plan = build_mesh("NO_SHARD")
     tc = TrainerConfig(
         lr=4e-4, warmup_steps=10, total_steps=1000, precision="bf16-mixed",
-        attn_impl="pallas", remat=True,
+        attn_impl=os.environ.get("OPENDILOCO_TPU_BENCH_ATTN", "pallas"),
+        remat=True,
+        fused_loss=os.environ.get("OPENDILOCO_TPU_BENCH_FUSED", "0") in ("1", "true"),
     )
     trainer = InnerTrainer(cfg, tc, plan)
     state = trainer.init_state(jax.random.key(0))

@@ -165,3 +165,24 @@ void odtp_dequantize_blockwise_i8_accumulate(const int8_t* q, const float* scale
 int odtp_version() { return 1; }
 
 }  // extern "C"
+
+extern "C" {
+
+// branchless binary search of each value into 255 sorted bucket edges
+// (the hot path of quantile-codebook quantization)
+void odtp_quantile_assign(const float* src, const float* edges255,
+                          uint8_t* out, size_t n) {
+#pragma omp parallel for schedule(static)
+    for (ptrdiff_t i = 0; i < (ptrdiff_t)n; ++i) {
+        float v = src[i];
+        unsigned lo = 0, hi = 255;  // bucket index range; edges255[k] separates k|k+1
+        while (lo < hi) {
+            unsigned mid = (lo + hi) >> 1;
+            if (v >= edges255[mid]) lo = mid + 1;  // side="right": ties go up
+            else hi = mid;
+        }
+        out[i] = (uint8_t)lo;
+    }
+}
+
+}  // extern "C"

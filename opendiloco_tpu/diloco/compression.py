@@ -115,9 +115,7 @@ class Quantile8BitCodec(Codec):
         )
         edges = np.quantile(sample, np.linspace(0, 1, 257))
         codebook = ((edges[:-1] + edges[1:]) * 0.5).astype(np.float32)
-        idx = np.clip(
-            np.searchsorted(edges[1:-1], flat, side="right"), 0, 255
-        ).astype(np.uint8)
+        idx = native.quantile_assign(flat, edges[1:-1].astype(np.float32))
         return codebook.tobytes() + idx.tobytes(), {}
 
     def decode(self, payload, shape, meta):

@@ -69,6 +69,8 @@ def get_lib() -> Optional[ctypes.CDLL]:
     lib.odtp_quantize_blockwise_i8.argtypes = [f32p, i8p, f32p, st, st]
     lib.odtp_dequantize_blockwise_i8.argtypes = [i8p, f32p, f32p, st, st]
     lib.odtp_dequantize_blockwise_i8_accumulate.argtypes = [i8p, f32p, f32p, st, st]
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    lib.odtp_quantile_assign.argtypes = [f32p, f32p, u8p, st]
     lib.odtp_version.restype = ctypes.c_int
     _lib = lib
     return _lib
@@ -200,3 +202,23 @@ def dequant8_accumulate(payload: bytes, scales_payload: bytes, dst: np.ndarray, 
     lib.odtp_dequantize_blockwise_i8_accumulate(
         _i8p(q), _f32p(scales), _f32p(dst), dst.size, block
     )
+
+
+def quantile_assign(flat: np.ndarray, inner_edges: np.ndarray) -> np.ndarray:
+    """Assign each value to one of 256 buckets split by 255 sorted inner
+    edges (searchsorted side='right' semantics)."""
+    lib = get_lib()
+    flat = np.ascontiguousarray(flat, np.float32)
+    inner_edges = np.ascontiguousarray(inner_edges, np.float32)
+    if lib is None:
+        return np.clip(
+            np.searchsorted(inner_edges, flat, side="right"), 0, 255
+        ).astype(np.uint8)
+    out = np.empty(flat.size, np.uint8)
+    lib.odtp_quantile_assign(
+        _f32p(flat),
+        _f32p(inner_edges),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        flat.size,
+    )
+    return out
