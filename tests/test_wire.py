@@ -1,0 +1,83 @@
+"""Wire protocol unit tests: framing, malformed input, large payloads."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from opendiloco_tpu.diloco import wire
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def test_roundtrip():
+    payload = np.arange(1000, dtype=np.float32).tobytes()
+    frame = wire.encode_frame("push", {"round": "r1", "from": "a"}, payload)
+
+    async def go():
+        reader = asyncio.StreamReader()
+        reader.feed_data(frame)
+        reader.feed_eof()
+        return await wire.read_frame(reader)
+
+    msg, meta, out = run(go())
+    assert msg == "push" and meta == {"round": "r1", "from": "a"}
+    assert out == payload
+
+
+def test_bad_magic_rejected():
+    async def go():
+        reader = asyncio.StreamReader()
+        reader.feed_data(b"NOPE" + b"\x00" * 100)
+        reader.feed_eof()
+        return await wire.read_frame(reader)
+
+    with pytest.raises(wire.WireError, match="bad frame header"):
+        run(go())
+
+
+def test_oversized_header_rejected():
+    import struct
+
+    async def go():
+        reader = asyncio.StreamReader()
+        reader.feed_data(struct.pack(">4sI", b"ODTP", wire.MAX_HEADER + 1))
+        reader.feed_eof()
+        return await wire.read_frame(reader)
+
+    with pytest.raises(wire.WireError):
+        run(go())
+
+
+def test_truncated_frame_raises():
+    frame = wire.encode_frame("x", {}, b"12345678")
+
+    async def go():
+        reader = asyncio.StreamReader()
+        reader.feed_data(frame[:-4])  # missing payload tail
+        reader.feed_eof()
+        return await wire.read_frame(reader)
+
+    with pytest.raises(asyncio.IncompleteReadError):
+        run(go())
+
+
+def test_timeout():
+    async def go():
+        reader = asyncio.StreamReader()  # never fed
+        return await wire.read_frame(reader, timeout=0.2)
+
+    with pytest.raises(asyncio.TimeoutError):
+        run(go())
+
+
+def test_pack_unpack_arrays():
+    payloads = [b"aaa", b"bbbb", b""]
+    metas = [{"k": 1}, {"k": 2}, {"k": 3}]
+    blob, out_meta = wire.pack_arrays(payloads, metas)
+    assert blob == b"aaabbbb"
+    back = wire.unpack_arrays(blob, out_meta)
+    assert [p for p, _ in back] == payloads
+    assert [m["k"] for _, m in back] == [1, 2, 3]
