@@ -269,3 +269,74 @@ def test_run_training_sh_launcher(tmp_path):
         cwd=REPO,
     )
     assert r.returncode == 0, (r.stdout[-1500:], r.stderr[-1500:])
+
+
+@pytest.mark.slow
+def test_multi_worker_resume_deterministic(tmp_path):
+    """Both DiLoCo workers restart from step-8 checkpoints (fresh rendezvous,
+    like the reference's test_multi_gpu_hivemind restart phase) and reproduce
+    the uninterrupted run's losses."""
+    from opendiloco_tpu.diloco.rendezvous import RendezvousServer
+
+    def launch(server, rank, logf, extra):
+        args = base_args(
+            tmp_path,
+            logf,
+            [
+                "--total-steps", "12",
+                "--ckpt.interval", "4",
+                "--diloco.local-steps", "4",
+                "--diloco.initial-peers", server.address,
+                "--diloco.world-rank", str(rank),
+                "--diloco.galaxy-size", "2",
+                "--diloco.matchmaking-time", "2.0",
+                "--diloco.backend", "tcp",
+                "--diloco.skip-load-from-peers",
+                *extra,
+            ],
+        )
+        env = dict(os.environ)
+        env["OPENDILOCO_TPU_PLATFORM"] = "cpu"
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        return subprocess.Popen(
+            [sys.executable, "-m", "opendiloco_tpu.train", *args],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env, cwd=REPO,
+        )
+
+    # phase 1: full run with checkpoints
+    server = RendezvousServer(host="127.0.0.1", port=0).start_in_thread()
+    try:
+        procs = [
+            launch(server, r, tmp_path / f"full{r}.pkl", []) for r in range(2)
+        ]
+        for p in procs:
+            _, err = p.communicate(timeout=600)
+            assert p.returncode == 0, err[-3000:]
+    finally:
+        server.stop()
+
+    # phase 2: fresh rendezvous, both resume from step 8
+    server = RendezvousServer(host="127.0.0.1", port=0).start_in_thread()
+    try:
+        procs = [
+            launch(
+                server, r, tmp_path / f"res{r}.pkl",
+                ["--ckpt.resume", str(tmp_path / "ckpts" / "model_step_8")],
+            )
+            for r in range(2)
+        ]
+        for p in procs:
+            _, err = p.communicate(timeout=600)
+            assert p.returncode == 0, err[-3000:]
+    finally:
+        server.stop()
+
+    for r in range(2):
+        full = {m["step"]: m for m in read_metrics(tmp_path / f"full{r}.pkl")}
+        res = read_metrics(tmp_path / f"res{r}.pkl")
+        assert [m["step"] for m in res] == [9, 10, 11, 12]
+        for m in res:
+            np.testing.assert_allclose(m["Loss"], full[m["step"]]["Loss"], atol=1e-2)
+            assert m["lr"] == full[m["step"]]["lr"]
