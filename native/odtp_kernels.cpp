@@ -186,3 +186,49 @@ void odtp_quantile_assign(const float* src, const float* edges255,
 }
 
 }  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Bulk data plane: full-buffer socket I/O on raw fds.
+//
+// The butterfly all-reduce moves multi-hundred-MB pseudo-gradient parts
+// between workers. Python asyncio allocates and re-joins chunked reads;
+// these loops pump bytes directly between the socket and the (numpy-owned)
+// buffer -- zero copies, no GIL (ctypes releases it for the duration).
+// Returns 0 on success, -errno on socket failure, -1 on EOF mid-transfer.
+
+#include <sys/socket.h>
+#include <sys/types.h>
+#include <cerrno>
+
+extern "C" {
+
+int odtp_sendall(int fd, const void* buf, size_t n) {
+    const char* p = (const char*)buf;
+    while (n > 0) {
+        ssize_t w = ::send(fd, p, n, MSG_NOSIGNAL);
+        if (w < 0) {
+            if (errno == EINTR) continue;
+            return -errno;
+        }
+        p += w;
+        n -= (size_t)w;
+    }
+    return 0;
+}
+
+int odtp_recvall(int fd, void* buf, size_t n) {
+    char* p = (char*)buf;
+    while (n > 0) {
+        ssize_t r = ::recv(fd, p, n, 0);
+        if (r < 0) {
+            if (errno == EINTR) continue;
+            return -errno;
+        }
+        if (r == 0) return -1;  // peer closed mid-transfer
+        p += r;
+        n -= (size_t)r;
+    }
+    return 0;
+}
+
+}  // extern "C"

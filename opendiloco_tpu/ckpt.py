@@ -32,6 +32,12 @@ log = get_text_logger(__name__)
 _STEP_RE = re.compile(r"model_step_(\d+)$")
 
 
+def _process_index() -> int:
+    """This host's index in the multihost slice (own seam so tests can
+    simulate other hosts without fooling Orbax's process sync)."""
+    return jax.process_index()
+
+
 def _is_remote(path: str) -> bool:
     return "://" in path
 
@@ -108,16 +114,22 @@ def save_checkpoint(
             force=True,
         )
 
-    if diloco_state is not None:
+    # Host-side sidecars: dataloader state depends on this host's data shard,
+    # so it is scoped by jax.process_index() (reference writes per-rank
+    # ``__{rank}_0.pt``, ckpt_utils.py:83-87); the shared per-worker files
+    # (diloco master, global state) are written by process 0 only so
+    # multihost processes never race on the same path.
+    pi = _process_index()
+    if diloco_state is not None and pi == 0:
         meta, blob = _pack_tree(diloco_state)
         with _fs_open(f"{d}/diloco_state.bin", "wb") as f:
             f.write(blob)
         with _fs_open(f"{d}/diloco_state.json", "w") as f:
             json.dump(meta, f)
     if dataloader_state is not None:
-        with _fs_open(f"{d}/dataloader.json", "w") as f:
+        with _fs_open(f"{d}/dataloader_{pi}.json", "w") as f:
             json.dump(_jsonify(dataloader_state), f)
-    if extra:
+    if extra and pi == 0:
         with _fs_open(f"{d}/global_state.json", "w") as f:
             json.dump(_jsonify(extra), f)
     log.info("saved checkpoint step %d -> %s", step, d)
@@ -154,9 +166,12 @@ def load_checkpoint(
         diloco_state = _unpack_tree(meta, blob)
 
     dataloader_state = None
-    if _exists(f"{d}/dataloader.json"):
-        with _fs_open(f"{d}/dataloader.json", "r") as f:
-            dataloader_state = json.load(f)
+    pi = _process_index()
+    for name in (f"dataloader_{pi}.json", "dataloader.json"):  # legacy fallback
+        if _exists(f"{d}/{name}"):
+            with _fs_open(f"{d}/{name}", "r") as f:
+                dataloader_state = json.load(f)
+            break
 
     extra = {}
     if _exists(f"{d}/global_state.json"):
@@ -218,7 +233,12 @@ def delete_old_checkpoints(ckpt_path: str, topk: Optional[int]) -> None:
             import fsspec
 
             fs, _, (p,) = fsspec.get_fs_token_paths(d)
-            fs.rm(p, recursive=True)
+            try:
+                fs.rm(p, recursive=True)
+            except (FileNotFoundError, OSError) as e:
+                # every diloco rank runs GC on the shared path; losing a
+                # double-delete race must not kill training at ckpt time
+                log.warning("retention GC of %s failed (%s); continuing", d, e)
         else:
             shutil.rmtree(d, ignore_errors=True)
 

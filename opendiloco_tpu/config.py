@@ -105,6 +105,7 @@ class Config(BaseModel):
     tokenizer_name: str = "mistralai/Mistral-7B-v0.1"
     seq_length: int = 1024
     num_workers: int = 1  # host dataloading threads
+    prefetch_depth: int = 2  # async H2D read-ahead batches (0 disables)
 
     # optimization (train_fsdp.py:250-260)
     lr: float = 4e-4

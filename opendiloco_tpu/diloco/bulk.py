@@ -1,0 +1,217 @@
+"""Threaded bulk data plane for large tensor transfers.
+
+The asyncio control plane (diloco/tcp.py) is right for matchmaking, gossip
+and small frames, but for multi-hundred-MB butterfly parts it pays an
+allocation and a copy per read and runs every byte through the event loop.
+This module is the native data plane the reference delegates to hivemind's
+libp2p daemon (SURVEY §2.3): plain blocking sockets on dedicated threads,
+``sendall`` straight from the tensor buffer and ``recv`` straight into a
+preallocated numpy buffer -- zero application-side copies. The byte pumping
+itself runs in C (native/odtp_kernels.cpp ``odtp_sendall``/``odtp_recvall``)
+with the GIL released when the native library is built.
+
+Wire format: identical ODTP frames (diloco/wire.py), one connection per
+peer pair, persistent across rounds; each frame is acknowledged with a
+single byte so senders get backpressure parity with the RPC path.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+import threading
+from typing import Callable, Optional
+
+import numpy as np
+
+from opendiloco_tpu import native
+from opendiloco_tpu.diloco.wire import MAGIC, MAX_HEADER, WireError
+from opendiloco_tpu.utils.logger import get_text_logger
+
+log = get_text_logger(__name__)
+
+_HDR = struct.Struct(">4sI")
+_ACK = b"\x01"
+
+
+def _tune(sock: socket.socket) -> None:
+    try:
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF, 4 * 1024 * 1024)
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, 4 * 1024 * 1024)
+    except OSError:
+        pass
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = np.empty(n, np.uint8)
+    native.sock_recvall(sock, buf)
+    return buf.tobytes()
+
+
+def send_frame_sync(
+    sock: socket.socket, msg_type: str, meta: dict, payload=b""
+) -> None:
+    nbytes = (
+        payload.nbytes if isinstance(payload, np.ndarray) else len(payload)
+    )
+    header = json.dumps(
+        {"type": msg_type, "meta": meta, "payload_len": nbytes}
+    ).encode()
+    native.sock_sendall(sock, _HDR.pack(MAGIC, len(header)) + header)
+    if nbytes:
+        native.sock_sendall(sock, payload)
+
+
+def read_frame_sync(sock: socket.socket) -> tuple[str, dict, np.ndarray]:
+    """Read one frame; the payload lands in a fresh numpy uint8 buffer
+    (single allocation, received in place)."""
+    hdr = _recv_exact(sock, _HDR.size)
+    magic, hlen = _HDR.unpack(hdr)
+    if magic != MAGIC or hlen > MAX_HEADER:
+        raise WireError(f"bad bulk frame header: magic={magic!r} hlen={hlen}")
+    header = json.loads(_recv_exact(sock, hlen))
+    n = header.get("payload_len", 0)
+    payload = np.empty(n, np.uint8)
+    if n:
+        native.sock_recvall(sock, payload)
+    return header["type"], header.get("meta", {}), payload
+
+
+class BulkServer:
+    """Accepts persistent bulk connections; one handler thread each.
+
+    ``deliver(msg, meta, payload)`` is called from handler threads for every
+    received frame (payload is a numpy uint8 buffer).
+    """
+
+    def __init__(self, deliver: Callable[[str, dict, np.ndarray], None], host: str):
+        self._deliver = deliver
+        self._sock = socket.create_server((host, 0))
+        self.port = self._sock.getsockname()[1]
+        self._stop = threading.Event()
+        self._conns: set[socket.socket] = set()
+        self._lock = threading.Lock()
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="odtp-bulk-accept", daemon=True
+        )
+        self._accept_thread.start()
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return  # socket closed by stop()
+            _tune(conn)
+            with self._lock:
+                self._conns.add(conn)
+            threading.Thread(
+                target=self._handle, args=(conn,), name="odtp-bulk-conn", daemon=True
+            ).start()
+
+    def _handle(self, conn: socket.socket) -> None:
+        try:
+            while not self._stop.is_set():
+                try:
+                    msg, meta, payload = read_frame_sync(conn)
+                except (ConnectionError, OSError, WireError):
+                    return
+                self._deliver(msg, meta, payload)
+                native.sock_sendall(conn, _ACK)
+        except Exception:
+            if not self._stop.is_set():
+                log.exception("bulk handler error")
+        finally:
+            with self._lock:
+                self._conns.discard(conn)
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        with self._lock:
+            for c in list(self._conns):
+                try:
+                    c.close()
+                except OSError:
+                    pass
+
+
+class BulkSender:
+    """Persistent outgoing bulk connections, one per destination, with a
+    per-destination lock serializing frames."""
+
+    def __init__(self, connect_timeout: float = 10.0):
+        self._timeout = connect_timeout
+        self._conns: dict[tuple, socket.socket] = {}
+        self._locks: dict[tuple, threading.Lock] = {}
+        self._meta_lock = threading.Lock()
+
+    def send(
+        self,
+        host: str,
+        port: int,
+        msg: str,
+        meta: dict,
+        payload,
+        *,
+        lock_timeout: float = 30.0,
+    ) -> None:
+        key = (host, port)
+        with self._meta_lock:
+            lock = self._locks.setdefault(key, threading.Lock())
+        # bounded wait: a zombie transfer from a timed-out round must not
+        # wedge the retry forever (the caller falls back / re-forms the group)
+        if not lock.acquire(timeout=lock_timeout):
+            raise TimeoutError(f"bulk destination {key} busy")
+        try:
+            for attempt in (0, 1):
+                sock = self._conns.get(key)
+                if sock is None:
+                    sock = socket.create_connection(
+                        (host, port), timeout=self._timeout
+                    )
+                    # keep the socket BLOCKING (settimeout would flip it to
+                    # non-blocking and break the native C recv/send path);
+                    # bound hangs with kernel-level timeouts instead
+                    sock.settimeout(None)
+                    tv = struct.pack("ll", 300, 0)
+                    sock.setsockopt(socket.SOL_SOCKET, socket.SO_RCVTIMEO, tv)
+                    sock.setsockopt(socket.SOL_SOCKET, socket.SO_SNDTIMEO, tv)
+                    _tune(sock)
+                    self._conns[key] = sock
+                try:
+                    send_frame_sync(sock, msg, meta, payload)
+                    ack = np.empty(1, np.uint8)
+                    native.sock_recvall(sock, ack)
+                    if ack[0] != _ACK[0]:
+                        raise WireError(f"bad bulk ack {ack[0]!r}")
+                    return
+                except (ConnectionError, OSError, WireError):
+                    # stale pooled connection: reconnect once, then give up
+                    self._drop(key)
+                    if attempt == 1:
+                        raise
+        finally:
+            lock.release()
+
+    def _drop(self, key: tuple) -> None:
+        sock = self._conns.pop(key, None)
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def close(self) -> None:
+        with self._meta_lock:
+            for key in list(self._conns):
+                self._drop(key)

@@ -28,10 +28,14 @@ class Codec:
     name: str = "none"
 
     def encode(self, arr: np.ndarray) -> tuple[bytes, dict]:
-        return np.ascontiguousarray(arr, np.float32).tobytes(), {}
+        # zero-copy when already contiguous f32: a memoryview over the array
+        # buffer goes straight to the socket (the array outlives the send)
+        return memoryview(np.ascontiguousarray(arr, np.float32)).cast("B"), {}
 
     def decode(self, payload: bytes, shape: tuple[int, ...], meta: dict) -> np.ndarray:
-        return np.frombuffer(payload, dtype=np.float32).reshape(shape).copy()
+        # read-only view over the received payload -- every consumer either
+        # reduces it into an accumulator or copies it during reassembly
+        return np.frombuffer(payload, dtype=np.float32).reshape(shape)
 
     def decode_accumulate(
         self, payload: bytes, meta: dict, dst: np.ndarray

@@ -98,7 +98,11 @@ class DiLoCoOptimizer:
         self.outer_opt.load_state_dict(remote["outer_opt"])
         self.local_step = 0
         self.samples_in_epoch = 0
-        return self._write_master_to_device(state)
+        state = self._write_master_to_device(state)
+        # resume the LR schedule where the swarm is, not at warmup step 0
+        return self.trainer.force_step_position(
+            state, self.epoch * self.cfg.local_steps
+        )
 
     # ------------------------------------------------------------------
     # inner step
@@ -266,6 +270,7 @@ class DiLoCoOptimizer:
             "outer_opt": self.outer_opt.state_dict(),
             "epoch": self.epoch,
             "local_step": self.local_step,
+            "samples_in_epoch": self.samples_in_epoch,
         }
 
     def load_state_dict(self, sd: dict) -> None:
@@ -273,3 +278,8 @@ class DiLoCoOptimizer:
         self.outer_opt.load_state_dict(sd["outer_opt"])
         self.epoch = int(sd["epoch"])
         self.local_step = int(sd["local_step"])
+        # older checkpoints lack samples_in_epoch; reconstruct so a mid-epoch
+        # resume reports true progress and peers' wait_for_all doesn't stall
+        self.samples_in_epoch = int(
+            sd.get("samples_in_epoch", self.local_step * self.batch_size)
+        )

@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import asyncio
 import hashlib
+import os
 import threading
 import time
 import uuid
@@ -98,8 +99,15 @@ class TcpBackend(OuterBackend):
     ):
         if not initial_peers:
             raise ValueError("TcpBackend needs at least one rendezvous address")
-        self.rendezvous_addr = initial_peers[0].rsplit(":", 1)
-        self.rendezvous = (self.rendezvous_addr[0], int(self.rendezvous_addr[1]))
+        # ALL initial peers are usable rendezvous daemons; the swarm fails
+        # over in list order when the current one dies (reference capability:
+        # the hivemind DHT survives bootstrap-peer death, train_fsdp.py:205-212)
+        self.rendezvous_list = [
+            (h, int(p)) for h, p in (a.rsplit(":", 1) for a in initial_peers)
+        ]
+        self._rdv_idx = 0
+        self._rdv_last_probe = 0.0
+        self._RDV_FAILBACK_S = float(os.environ.get("ODTP_RDV_FAILBACK_S", 60.0))
         self.host = host
         self.port = port
         self._peer_id = peer_id or f"peer-{uuid.uuid4().hex[:12]}"
@@ -108,6 +116,20 @@ class TcpBackend(OuterBackend):
         self.rpc_timeout = rpc_timeout
 
         self._state_provider: Optional[Callable[[], dict]] = None
+        # persistent peer connections: (host, port) -> (reader, writer);
+        # per-key locks serialize frames on a connection (event-loop only)
+        self._conn_pool: dict[tuple, tuple] = {}
+        self._conn_locks: dict[tuple, asyncio.Lock] = {}
+        # bulk data plane: large payloads bypass asyncio (diloco/bulk.py)
+        self._bulk_threshold = int(os.environ.get("ODTP_BULK_THRESHOLD", 1 << 20))
+        self._bulk_server = None
+        self._bulk_sender = None
+        self._bulk_ports: dict[tuple, Optional[int]] = {}
+        if self._bulk_threshold > 0:
+            from opendiloco_tpu.diloco.bulk import BulkSender, BulkServer
+
+            self._bulk_server = BulkServer(self._deliver_bulk, host)
+            self._bulk_sender = BulkSender()
         self._progress_cache: list[PeerProgress] = []
         self._own_progress: Optional[PeerProgress] = None
         # mailbox: (round, kind, sender_or_part) -> (meta, payload)
@@ -140,11 +162,8 @@ class TcpBackend(OuterBackend):
                 self._handle_peer, self.host, self.port, limit=STREAM_LIMIT
             )
             self.port = self._server.sockets[0].getsockname()[1]
-            _, meta, _ = await request(
-                *self.rendezvous,
-                "register",
-                {"peer_id": self._peer_id, "host": self.host, "port": self.port},
-                timeout=self.rpc_timeout,
+            _, meta, _ = await self._rdv_request(
+                "register", self._register_meta(), timeout=self.rpc_timeout
             )
             log.info(
                 "%s registered with rendezvous %s (%d peers known)",
@@ -163,34 +182,159 @@ class TcpBackend(OuterBackend):
             except asyncio.CancelledError:
                 pass
 
+    @property
+    def rendezvous(self) -> tuple[str, int]:
+        return self.rendezvous_list[self._rdv_idx]
+
+    def _register_meta(self) -> dict:
+        return {"peer_id": self._peer_id, "host": self.host, "port": self.port}
+
+    async def _announce_to(self, addr: tuple[str, int], timeout: float) -> None:
+        """Register (and re-push progress) with a specific daemon."""
+        await request(*addr, "register", self._register_meta(), timeout=timeout)
+        if self._own_progress is not None:
+            p = self._own_progress
+            await request(
+                *addr,
+                "progress",
+                {
+                    **self._register_meta(),
+                    "progress": {
+                        "epoch": p.epoch,
+                        "samples": p.samples,
+                        "samples_per_second": p.samples_per_second,
+                        "timestamp": p.timestamp,
+                    },
+                    "serves_state": self._state_provider is not None,
+                },
+                timeout=timeout,
+            )
+
+    async def _rdv_request(
+        self, msg: str, meta: dict, payload: bytes = b"", *, timeout: float = None
+    ) -> tuple[str, dict, bytes]:
+        """Rendezvous RPC with failover.
+
+        Convergence policy: every peer prefers the LOWEST-index live daemon
+        in ``initial_peers``. On connection failure, rotate forward (retrying
+        the same daemon once first if the failure was a bare timeout -- one
+        slow RPC against a healthy daemon must not split the swarm); while
+        running on a higher-index daemon, periodically probe the earlier
+        ones and fail back, so peers that diverged onto different daemons
+        re-converge within ``_RDV_FAILBACK_S`` seconds.
+        """
+        timeout = timeout or self.rpc_timeout
+        # fail-back probe toward the preferred (lowest-index) daemon
+        if self._rdv_idx != 0 and (
+            time.monotonic() - self._rdv_last_probe > self._RDV_FAILBACK_S
+        ):
+            self._rdv_last_probe = time.monotonic()
+            for k in range(self._rdv_idx):
+                try:
+                    await self._announce_to(
+                        self.rendezvous_list[k], min(5.0, timeout)
+                    )
+                    log.info(
+                        "rendezvous failback: %s is reachable again",
+                        self.rendezvous_list[k],
+                    )
+                    self._rdv_idx = k
+                    break
+                except (OSError, ConnectionError, asyncio.TimeoutError):
+                    continue
+
+        last_err: Optional[Exception] = None
+        retried_timeout = False
+        attempts = 0
+        while attempts < len(self.rendezvous_list):
+            addr = self.rendezvous_list[self._rdv_idx]
+            try:
+                return await request(*addr, msg, meta, payload, timeout=timeout)
+            except (OSError, ConnectionError, asyncio.TimeoutError) as e:
+                last_err = e
+                if isinstance(e, asyncio.TimeoutError) and not retried_timeout:
+                    retried_timeout = True  # same daemon, one more chance
+                    continue
+                attempts += 1
+                if len(self.rendezvous_list) == 1:
+                    break
+                self._rdv_idx = (self._rdv_idx + 1) % len(self.rendezvous_list)
+                self._rdv_last_probe = time.monotonic()
+                nxt = self.rendezvous_list[self._rdv_idx]
+                log.warning(
+                    "rendezvous %s unreachable (%s); failing over to %s",
+                    addr,
+                    e,
+                    nxt,
+                )
+                try:
+                    await self._announce_to(nxt, timeout)
+                except Exception as reg_err:
+                    last_err = reg_err
+                    continue
+        raise last_err if last_err else OSError("no rendezvous reachable")
+
     def _run(self, coro, timeout: Optional[float] = None):
+        import concurrent.futures
+
         fut = asyncio.run_coroutine_threadsafe(coro, self._loop)
-        return fut.result(timeout)
+        try:
+            return fut.result(timeout)
+        except concurrent.futures.TimeoutError:
+            # kill the timed-out coroutine: a zombie all-reduce round would
+            # keep consuming the retry's (round_key, fingerprint) mailbox
+            # frames and starve it into AllReduceError
+            fut.cancel()
+            raise asyncio.TimeoutError(
+                f"backend coroutine timed out after {timeout}s"
+            ) from None
 
     # -- peer server ---------------------------------------------------------
 
     async def _handle_peer(self, reader, writer) -> None:
+        """Serve frames until the peer hangs up: connections persist across
+        rounds so bulk transfers keep a warmed-up TCP window instead of
+        re-running slow-start on every push/result frame."""
         try:
-            msg, meta, payload = await read_frame(reader, timeout=300.0)
-            if msg in ("push", "result"):
-                key = (
-                    meta["round"],
-                    msg,
-                    meta["part"] if msg == "result" else meta["from"],
-                )
-                async with self._mailbox_cv:
-                    self._mailbox[key] = (meta, payload)
-                    self._gc_mailbox()
-                    self._mailbox_cv.notify_all()
-                await send_frame(writer, "ok", {})
-            elif msg == "fetch_state":
-                if self._state_provider is None:
-                    await send_frame(writer, "error", {"error": "no state"})
+            while True:
+                try:
+                    msg, meta, payload = await read_frame(reader, timeout=300.0)
+                except (
+                    asyncio.IncompleteReadError,
+                    ConnectionResetError,
+                    asyncio.TimeoutError,  # idle between outer rounds
+                ):
+                    break
+                if msg in ("push", "result"):
+                    key = (
+                        meta["round"],
+                        msg,
+                        meta["part"] if msg == "result" else meta["from"],
+                    )
+                    async with self._mailbox_cv:
+                        self._mailbox[key] = (meta, payload)
+                        self._gc_mailbox()
+                        self._mailbox_cv.notify_all()
+                    await send_frame(writer, "ok", {})
+                elif msg == "bulk_hello":
+                    await send_frame(
+                        writer,
+                        "ok",
+                        {
+                            "bulk_port": self._bulk_server.port
+                            if self._bulk_server
+                            else 0
+                        },
+                    )
+                elif msg == "fetch_state":
+                    if self._state_provider is None:
+                        await send_frame(writer, "error", {"error": "no state"})
+                    else:
+                        smeta, sblob = serialize_state(self._state_provider())
+                        await send_frame(writer, "state", smeta, sblob)
                 else:
-                    smeta, sblob = serialize_state(self._state_provider())
-                    await send_frame(writer, "state", smeta, sblob)
-            else:
-                await send_frame(writer, "error", {"error": f"unknown {msg!r}"})
+                    await send_frame(writer, "error", {"error": f"unknown {msg!r}"})
+                    break  # stream sync can't be trusted past an unknown frame
         except Exception:
             log.exception("peer handler error")
         finally:
@@ -199,6 +343,135 @@ class TcpBackend(OuterBackend):
                 await writer.wait_closed()
             except Exception:
                 pass
+
+    async def _peer_request(
+        self,
+        host: str,
+        port: int,
+        msg: str,
+        meta: dict,
+        payload: bytes = b"",
+        *,
+        timeout: float = 30.0,
+    ) -> tuple[str, dict, bytes]:
+        """RPC to a worker peer over a pooled persistent connection.
+
+        One connection per peer, reused across frames and rounds; a stale
+        connection (server dropped it while idle) is re-opened once. A
+        timeout mid-transfer is NOT retried -- the caller's round retry
+        logic owns that decision.
+        """
+        key = (host, port)
+        lock = self._conn_locks.setdefault(key, asyncio.Lock())
+        from opendiloco_tpu.diloco.wire import _tune_socket
+
+        for attempt in (0, 1):
+            async with lock:
+                entry = self._conn_pool.get(key)
+                if entry is None or entry[1].is_closing():
+                    reader, writer = await asyncio.wait_for(
+                        asyncio.open_connection(host, port, limit=STREAM_LIMIT),
+                        timeout,
+                    )
+                    _tune_socket(writer)
+                    entry = (reader, writer)
+                    self._conn_pool[key] = entry
+                reader, writer = entry
+                try:
+                    await send_frame(writer, msg, meta, payload)
+                    return await read_frame(reader, timeout=timeout)
+                except (
+                    OSError,
+                    ConnectionError,
+                    asyncio.IncompleteReadError,
+                    asyncio.TimeoutError,
+                ) as e:
+                    self._conn_pool.pop(key, None)
+                    writer.close()
+                    if attempt == 1 or isinstance(e, asyncio.TimeoutError):
+                        raise
+                except BaseException:
+                    # cancellation mid-send leaves a half-written frame on
+                    # the wire; a reused connection would desynchronize the
+                    # peer's stream parser -- never pool it again
+                    self._conn_pool.pop(key, None)
+                    writer.close()
+                    raise
+        raise AssertionError("unreachable")
+
+    def _deliver_bulk(self, msg: str, meta: dict, payload) -> None:
+        """Mailbox delivery from a bulk-server handler thread."""
+        if msg not in ("push", "result"):
+            return
+        key = (
+            meta["round"],
+            msg,
+            meta["part"] if msg == "result" else meta["from"],
+        )
+
+        def _post():
+            async def _set():
+                async with self._mailbox_cv:
+                    self._mailbox[key] = (meta, payload)
+                    self._gc_mailbox()
+                    self._mailbox_cv.notify_all()
+
+            asyncio.ensure_future(_set())
+
+        self._loop.call_soon_threadsafe(_post)
+
+    async def _bulk_port_of(self, host: str, port: int) -> Optional[int]:
+        """The peer's bulk-plane port (cached; None = peer has no bulk plane)."""
+        key = (host, port)
+        if key not in self._bulk_ports:
+            try:
+                msg, meta, _ = await self._peer_request(
+                    host, port, "bulk_hello", {}, timeout=self.rpc_timeout
+                )
+                self._bulk_ports[key] = (
+                    int(meta["bulk_port"]) if msg == "ok" and meta.get("bulk_port") else None
+                )
+            except Exception:
+                return None  # transient: don't cache failure
+        return self._bulk_ports[key]
+
+    async def _send_part(
+        self, host: str, port: int, msg: str, meta: dict, payload, *, timeout: float
+    ) -> None:
+        """Route one butterfly frame: bulk plane for large payloads, asyncio
+        RPC otherwise (and as fallback)."""
+        nbytes = payload.nbytes if hasattr(payload, "nbytes") else len(payload)
+        if self._bulk_sender is not None and nbytes >= self._bulk_threshold:
+            bulk_port = await self._bulk_port_of(host, port)
+            if bulk_port:
+                try:
+                    await self._loop.run_in_executor(
+                        None,
+                        lambda: self._bulk_sender.send(
+                            host, bulk_port, msg, meta, payload
+                        ),
+                    )
+                    return
+                except Exception as e:
+                    # forget the cached bulk port: the peer may have
+                    # restarted with a fresh ephemeral one (re-discovered
+                    # via bulk_hello on the next large payload)
+                    self._bulk_ports.pop((host, port), None)
+                    log.warning(
+                        "bulk send to %s:%d failed (%s); using RPC path",
+                        host,
+                        bulk_port,
+                        e,
+                    )
+        await self._peer_request(host, port, msg, meta, payload, timeout=timeout)
+
+    def _close_conn_pool(self) -> None:
+        for _, writer in self._conn_pool.values():
+            try:
+                writer.close()
+            except Exception:
+                pass
+        self._conn_pool.clear()
 
     def _gc_mailbox(self, max_age: float = 600.0) -> None:
         """Drop payloads from abandoned rounds (failed retries leave
@@ -249,8 +522,7 @@ class TcpBackend(OuterBackend):
             return
         try:
             _, meta, _ = self._run(
-                request(
-                    *self.rendezvous,
+                self._rdv_request(
                     "progress",
                     {
                         "peer_id": self._peer_id,
@@ -266,7 +538,7 @@ class TcpBackend(OuterBackend):
                     },
                     timeout=self.rpc_timeout,
                 ),
-                timeout=self.rpc_timeout + 5,
+                timeout=self.rpc_timeout * 3 * len(self.rendezvous_list) + 5,
             )
         except Exception as e:
             log.warning("progress report failed: %s", e)
@@ -324,9 +596,10 @@ class TcpBackend(OuterBackend):
         raise AllReduceError(f"all-reduce failed: {last_err}")
 
     async def _all_reduce_round(self, arrays: list[np.ndarray], join_key: str, deadline: float):
+        timings: dict[str, float] = {}
+        t_mm = time.monotonic()
         # 1. matchmake
-        _, meta, _ = await request(
-            *self.rendezvous,
+        _, meta, _ = await self._rdv_request(
             "join_group",
             {
                 "peer_id": self._peer_id,
@@ -353,15 +626,27 @@ class TcpBackend(OuterBackend):
         ).hexdigest()[:8]
         round_key = f"{join_key}:{fp}"
 
-        # 2. flatten + split into n parts (by element count)
-        flat = np.concatenate([a.reshape(-1).astype(np.float32) for a in arrays])
+        timings["matchmake_s"] = time.monotonic() - t_mm
+
+        # 2. flatten + split into n parts (by element count). Contiguous-f32
+        # leaves flatten as views; a single leaf needs no copy at all (the
+        # copy cost matters: the host core also feeds the sockets)
+        t_ph = time.monotonic()
+        flats = [
+            a.reshape(-1)
+            if a.dtype == np.float32 and a.flags.c_contiguous
+            else np.ascontiguousarray(a, np.float32).reshape(-1)
+            for a in arrays
+        ]
+        flat = flats[0] if len(flats) == 1 else np.concatenate(flats)
         bounds = np.linspace(0, flat.size, n + 1).astype(np.int64)
         parts = [flat[bounds[j] : bounds[j + 1]] for j in range(n)]
+        timings["flatten_s"] = time.monotonic() - t_ph
 
         # 3. push part j to its owner
         async def push(j):
             payload, cmeta = self.codec.encode(parts[j])
-            await request(
+            await self._send_part(
                 group[j]["host"],
                 group[j]["port"],
                 "push",
@@ -393,13 +678,15 @@ class TcpBackend(OuterBackend):
             _native.scale_inplace(acc, 1.0 / n)
             return acc
 
+        t_ph = time.monotonic()
         results = await asyncio.gather(collect(), *pushes)
         my_avg = results[0]
+        timings["scatter_reduce_s"] = time.monotonic() - t_ph
 
         # 5. fan the averaged part back out; gather the other parts
         async def send_result(j):
             payload, cmeta = self.codec.encode(my_avg)
-            await request(
+            await self._send_part(
                 group[j]["host"],
                 group[j]["port"],
                 "result",
@@ -427,10 +714,13 @@ class TcpBackend(OuterBackend):
                 )
             return out
 
+        t_ph = time.monotonic()
         results = await asyncio.gather(
             recv_results(), *[send_result(j) for j in range(n) if j != my_idx]
         )
         parts_avg = results[0]
+        timings["all_gather_s"] = time.monotonic() - t_ph
+        self.last_round_timings = timings
 
         # 6. reassemble
         flat_avg = np.concatenate([parts_avg[j] for j in range(n)])
@@ -452,19 +742,20 @@ class TcpBackend(OuterBackend):
     def fetch_state(self) -> Optional[dict]:
         try:
             _, meta, _ = self._run(
-                request(
-                    *self.rendezvous,
+                self._rdv_request(
                     "who_has_state",
                     {"exclude": self._peer_id},
                     timeout=self.rpc_timeout,
                 ),
-                timeout=self.rpc_timeout + 5,
+                # headroom for a full failover sweep (request + re-register
+                # + progress re-push per rotation)
+                timeout=self.rpc_timeout * 3 * len(self.rendezvous_list) + 5,
             )
             peer = meta.get("peer")
             if not peer:
                 return None
             msg, smeta, blob = self._run(
-                request(
+                self._peer_request(
                     peer["host"],
                     peer["port"],
                     "fetch_state",
@@ -486,7 +777,7 @@ class TcpBackend(OuterBackend):
     def close(self) -> None:
         try:
             self._run(
-                request(
+                request(  # best-effort, current daemon only: no failover dance
                     *self.rendezvous,
                     "unregister",
                     {"peer_id": self._peer_id},
@@ -496,5 +787,10 @@ class TcpBackend(OuterBackend):
             )
         except Exception:
             pass
+        if self._bulk_server is not None:
+            self._bulk_server.stop()
+        if self._bulk_sender is not None:
+            self._bulk_sender.close()
         if self._loop and self._server:
+            self._loop.call_soon_threadsafe(self._close_conn_pool)
             self._loop.call_soon_threadsafe(self._server.close)

@@ -72,6 +72,9 @@ def get_lib() -> Optional[ctypes.CDLL]:
     u8p = ctypes.POINTER(ctypes.c_uint8)
     lib.odtp_quantile_assign.argtypes = [f32p, f32p, u8p, st]
     lib.odtp_version.restype = ctypes.c_int
+    for fn in (lib.odtp_sendall, lib.odtp_recvall):
+        fn.argtypes = [ctypes.c_int, ctypes.c_void_p, st]
+        fn.restype = ctypes.c_int
     _lib = lib
     return _lib
 
@@ -222,3 +225,37 @@ def quantile_assign(flat: np.ndarray, inner_edges: np.ndarray) -> np.ndarray:
         flat.size,
     )
     return out
+
+
+def sock_sendall(sock, buf) -> None:
+    """Send an entire contiguous buffer on a connected socket. Native path
+    pumps bytes in C with the GIL released; fallback is socket.sendall
+    (also zero-copy for memoryview/ndarray)."""
+    lib = get_lib()
+    if lib is None:
+        sock.sendall(buf if isinstance(buf, (bytes, memoryview)) else memoryview(buf))
+        return
+    a = np.frombuffer(buf, np.uint8)  # zero-copy view, works read-only
+    rc = lib.odtp_sendall(sock.fileno(), ctypes.c_void_p(a.ctypes.data), a.size)
+    if rc != 0:
+        raise OSError(-rc, f"odtp_sendall failed (rc={rc})")
+
+
+def sock_recvall(sock, buf: np.ndarray) -> None:
+    """Receive exactly len(buf) bytes into a writable contiguous buffer."""
+    lib = get_lib()
+    if lib is None:
+        view = memoryview(buf).cast("B")
+        got = 0
+        while got < len(view):
+            r = sock.recv_into(view[got:])
+            if r == 0:
+                raise ConnectionResetError("peer closed mid-transfer")
+            got += r
+        return
+    a = np.frombuffer(buf, np.uint8)
+    rc = lib.odtp_recvall(sock.fileno(), ctypes.c_void_p(a.ctypes.data), a.size)
+    if rc == -1:
+        raise ConnectionResetError("peer closed mid-transfer")
+    if rc != 0:
+        raise OSError(-rc, f"odtp_recvall failed (rc={rc})")

@@ -188,6 +188,16 @@ def train(config: Config, backend: Optional[OuterBackend] = None) -> dict:
     tokens_per_step = config.total_batch_size * config.seq_length
     summary = {"step": start_step, "loss": float("nan")}
     data_iter = iter(loader)
+    prefetcher = None
+    if config.prefetch_depth > 0:
+        from opendiloco_tpu.data.prefetch import DevicePrefetcher
+
+        prefetcher = DevicePrefetcher(
+            data_iter,
+            lambda hb: trainer.shard_batch(hb["input_ids"], hb["labels"], accum),
+            depth=config.prefetch_depth,
+            state_fn=loader.state_dict,
+        )
     pending = None  # (real_step, device_metrics, dt, extras) of the prior step
     profiling = False
 
@@ -237,10 +247,13 @@ def train(config: Config, backend: Optional[OuterBackend] = None) -> dict:
                 profiling = False
                 log.info("wrote profiler trace to %s", config.profile_dir)
             t0 = time.perf_counter()
-            host_batch = next(data_iter)
-            batch = trainer.shard_batch(
-                host_batch["input_ids"], host_batch["labels"], accum
-            )
+            if prefetcher is not None:
+                host_batch, batch = next(prefetcher)
+            else:
+                host_batch = next(data_iter)
+                batch = trainer.shard_batch(
+                    host_batch["input_ids"], host_batch["labels"], accum
+                )
             if diloco_opt is not None:
                 state, metrics = diloco_opt.step(state, batch)
             else:
@@ -281,7 +294,9 @@ def train(config: Config, backend: Optional[OuterBackend] = None) -> dict:
                     state,
                     diloco_rank=world_rank if config.diloco else None,
                     diloco_state=diloco_opt.state_dict() if diloco_opt else None,
-                    dataloader_state=loader.state_dict(),
+                    dataloader_state=(
+                        prefetcher.state_dict() if prefetcher else loader.state_dict()
+                    ),
                     extra={"loss": summary["loss"], "step": real_step},
                 )
                 ckpt_lib.delete_old_checkpoints(config.ckpt.path, config.ckpt.topk)
@@ -301,6 +316,8 @@ def train(config: Config, backend: Optional[OuterBackend] = None) -> dict:
                 log.info("wrote profiler trace to %s", config.profile_dir)
             except Exception:
                 log.exception("failed to flush profiler trace")
+        if prefetcher is not None:
+            prefetcher.stop()
         loader.stop()
         metric_logger.finish()
         if owns_backend and backend is not None:

@@ -178,6 +178,36 @@ class InnerTrainer:
             "scaler": scaler,
         }
 
+    def force_step_position(self, state: dict, step: int) -> dict:
+        """Teleport the LR-schedule position to ``step``.
+
+        Used when a late joiner adopts the swarm's epoch (reference stubs
+        scheduler sync, hivemind_diloco.py:54-58; here we own the stack, so a
+        joiner at outer epoch E resumes the cosine schedule at
+        E*local_steps instead of re-running warmup). Rewrites ``state["step"]``
+        and every integer scalar counter inside the optax state (the adamw
+        schedule reads its own ``count``), keeping shardings so the jit cache
+        stays warm.
+        """
+        state = dict(state)
+        state["step"] = jax.device_put(
+            jnp.asarray(step, jnp.int32), self.state_shardings["step"]
+        )
+
+        def fix(leaf, shard):
+            if (
+                hasattr(leaf, "dtype")
+                and getattr(leaf, "ndim", None) == 0
+                and jnp.issubdtype(leaf.dtype, jnp.integer)
+            ):
+                return jax.device_put(jnp.asarray(step, leaf.dtype), shard)
+            return leaf
+
+        state["opt_state"] = jax.tree.map(
+            fix, state["opt_state"], self.state_shardings["opt_state"]
+        )
+        return state
+
     # -- steps ------------------------------------------------------------
 
     def _loss_fn(self, params: dict, input_ids: jax.Array, labels: jax.Array):

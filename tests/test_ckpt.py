@@ -95,3 +95,62 @@ def test_save_load_roundtrip_with_diloco_state(tmp_path, tiny_cfg):
     np.testing.assert_array_equal(dstate2["master"][0], diloco_state["master"][0])
     assert lstate2["dataset"]["samples_seen"] == 99
     assert extra2["loss"] == 1.5
+
+
+def test_multihost_sidecar_scoping(tmp_path, tiny_cfg, monkeypatch):
+    """Sidecar files are scoped by jax.process_index(): each host keeps its
+    own dataloader state (reference's per-rank __{rank}_0.pt layout,
+    ckpt_utils.py:83-87) and only process 0 writes the shared files."""
+    from opendiloco_tpu.parallel.mesh import build_mesh
+    from opendiloco_tpu.trainer import InnerTrainer, TrainerConfig
+
+    trainer = InnerTrainer(
+        tiny_cfg,
+        TrainerConfig(precision="fp32", remat=False, total_steps=10, warmup_steps=2),
+        build_mesh("NO_SHARD"),
+    )
+    state = trainer.init_state(jax.random.key(0))
+
+    # process 0 writes everything
+    ckpt_lib.save_checkpoint(
+        str(tmp_path), 3, state, diloco_rank=0,
+        diloco_state={"epoch": 1}, dataloader_state={"samples_seen": 10},
+        extra={"loss": 1.0},
+    )
+    # simulate host process 1: same step/rank path, different loader shard
+    monkeypatch.setattr(ckpt_lib, "_process_index", lambda: 1)
+    d = ckpt_lib.save_checkpoint(
+        str(tmp_path), 3, state, diloco_rank=0,
+        diloco_state={"epoch": 1}, dataloader_state={"samples_seen": 20},
+        extra={"loss": 2.0},
+    )
+    files = set(os.listdir(d))
+    assert {"dataloader_0.json", "dataloader_1.json"} <= files
+    # process 1 did not clobber the shared files nor write its own copy twice
+    _, dstate, lstate, extra = ckpt_lib.load_checkpoint(d, state)
+    assert lstate == {"samples_seen": 20}  # process 1 reads its own shard
+    assert extra == {"loss": 1.0}  # shared file still process 0's
+    monkeypatch.setattr(ckpt_lib, "_process_index", lambda: 0)
+    _, _, lstate0, _ = ckpt_lib.load_checkpoint(d, state)
+    assert lstate0 == {"samples_seen": 10}
+
+
+def test_legacy_dataloader_sidecar_fallback(tmp_path, tiny_cfg):
+    """Checkpoints written before process-index scoping (dataloader.json)
+    still restore."""
+    import json
+
+    from opendiloco_tpu.parallel.mesh import build_mesh
+    from opendiloco_tpu.trainer import InnerTrainer, TrainerConfig
+
+    trainer = InnerTrainer(
+        tiny_cfg,
+        TrainerConfig(precision="fp32", remat=False, total_steps=10, warmup_steps=2),
+        build_mesh("NO_SHARD"),
+    )
+    state = trainer.init_state(jax.random.key(0))
+    d = ckpt_lib.save_checkpoint(str(tmp_path), 4, state, diloco_rank=0)
+    with open(os.path.join(d, "dataloader.json"), "w") as f:
+        json.dump({"samples_seen": 7}, f)
+    _, _, lstate, _ = ckpt_lib.load_checkpoint(d, state)
+    assert lstate == {"samples_seen": 7}

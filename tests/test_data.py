@@ -75,3 +75,60 @@ def test_empty_dataset_raises_not_hangs():
     with _pytest.raises(RuntimeError, match="no samples"):
         next(it)
     loader.stop()
+
+
+def test_device_prefetcher_exact_resume_state():
+    """DevicePrefetcher.state_dict() reflects the last *consumed* batch, not
+    the read-ahead position: resuming from it replays exactly the batches the
+    consumer never saw."""
+    from opendiloco_tpu.data.prefetch import DevicePrefetcher
+
+    ds = FakeTokenizedDataset(8, 50, seed=3)
+    loader = DataLoader(ds, batch_size=4, prefetch=8)
+    pf = DevicePrefetcher(
+        iter(loader),
+        lambda hb: hb["input_ids"] * 2,  # stand-in for shard_batch
+        depth=3,
+        state_fn=loader.state_dict,
+    )
+    consumed = []
+    for _ in range(3):
+        host, dev = next(pf)
+        np.testing.assert_array_equal(dev, host["input_ids"] * 2)
+        consumed.append(host)
+    import time as _time
+
+    _time.sleep(0.3)  # let the worker read well ahead
+    sd = pf.state_dict()
+    tail = [next(pf)[0] for _ in range(2)]
+    pf.stop()
+    loader.stop()
+
+    loader2 = DataLoader(FakeTokenizedDataset(8, 50, seed=999), batch_size=4, prefetch=8)
+    loader2.load_state_dict(sd)
+    it2 = iter(loader2)
+    resumed = [next(it2) for _ in range(2)]
+    loader2.stop()
+    for a, b in zip(tail, resumed):
+        np.testing.assert_array_equal(a["input_ids"], b["input_ids"])
+
+
+def test_device_prefetcher_propagates_errors_and_stops():
+    from opendiloco_tpu.data.prefetch import DevicePrefetcher
+
+    def boom_iter():
+        yield {"input_ids": np.zeros((2, 4), np.int32)}
+        raise ValueError("boom")
+
+    pf = DevicePrefetcher(boom_iter(), lambda hb: hb["input_ids"], depth=2)
+    next(pf)
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="boom"):
+        next(pf)
+    # exhausted iterators end cleanly
+    pf2 = DevicePrefetcher(iter([{"x": 1}]), lambda hb: hb, depth=2)
+    assert next(pf2)[0] == {"x": 1}
+    with _pytest.raises(StopIteration):
+        next(pf2)
+    pf2.stop()

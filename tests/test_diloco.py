@@ -263,8 +263,38 @@ def test_state_dict_roundtrip(tiny_cfg):
     )
     opt2.load_state_dict(sd)
     assert opt2.epoch == opt.epoch and opt2.local_step == opt.local_step
+    assert opt2.samples_in_epoch == opt.samples_in_epoch == 2 * 8
     for a, b in zip(opt2.master, opt.master):
         np.testing.assert_array_equal(a, b)
+    # legacy checkpoints (no samples_in_epoch key) reconstruct mid-epoch
+    # progress from local_step so boundary reports don't under-count
+    legacy = {k: v for k, v in sd.items() if k != "samples_in_epoch"}
+    opt2.load_state_dict(legacy)
+    assert opt2.samples_in_epoch == opt2.local_step * 8
+
+
+def test_mid_epoch_resume_reports_full_progress(tiny_cfg):
+    """Resume from a mid-epoch checkpoint (ckpt interval not a multiple of
+    local_steps): the boundary progress report must count the pre-resume
+    samples, or peers' WAIT_FOR_ALL stalls until timeout."""
+    _, _, opt = run_diloco_single(
+        tiny_cfg, 6, local_steps=4, outer_lr=0.7, momentum=0.9
+    )
+    sd = opt.state_dict()  # epoch 1, local_step 2 -> mid-epoch
+
+    trainer = make_trainer(tiny_cfg)
+    state = trainer.init_state(jax.random.key(9))
+    world = LoopbackWorld(1)
+    (backend,) = world.make_backends()
+    opt2 = DiLoCoOptimizer(
+        trainer, backend, DilocoConfig(local_steps=4, backend="loopback"), state, 8
+    )
+    opt2.load_state_dict(sd)
+    for ids, labels in batches(5, tiny_cfg.vocab_size, 2):
+        state, m = opt2.step(state, trainer.shard_batch(ids, labels, accum=1))
+    assert opt2.epoch == 2  # boundary reached after only 2 post-resume steps
+    reported = world.progress[backend.peer_id]
+    assert reported.samples == 4 * 8  # full epoch, not just 2*8
 
 
 def test_peer_drop_elastic(tiny_cfg):
@@ -441,6 +471,13 @@ def test_desync_recovery(tiny_cfg):
     assert opt.epoch == 5  # adopted the swarm epoch
     for a, b in zip(opt.master, advanced_master):
         np.testing.assert_array_equal(a, b)
+    # LR-schedule position teleported to the swarm's inner step (not warmup):
+    # 5 epochs * 4 local steps, plus the one step just taken
+    assert int(jax.device_get(state["step"])) == 5 * cfg.local_steps + 1
+    # and the jit cache stayed warm through force_step_position
+    ids, labels = next(batches(1, tiny_cfg.vocab_size, 1))
+    state, _ = opt.step(state, trainer.shard_batch(ids, labels, accum=1))
+    assert trainer._train_step._cache_size() == 1
 
 
 def test_no_recompilation_across_outer_step(tiny_cfg):
