@@ -232,3 +232,38 @@ int odtp_recvall(int fd, void* buf, size_t n) {
 }
 
 }  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Quantile codebook construction (the encode half of the quantile8bit codec;
+// assignment already lives in odtp_quantile_assign above). Strided sample of
+// up to 100k values, one sort, numpy-style linear-interpolated quantiles --
+// replaces a host numpy pipeline that dominated encode on 100M+ buffers.
+
+#include <vector>
+
+extern "C" {
+
+void odtp_quantile_edges(const float* src, size_t n, float* edges257) {
+    const size_t cap = 100000;
+    std::vector<float> s;
+    if (n <= cap) {
+        s.assign(src, src + n);
+    } else {
+        s.resize(cap);
+        double stride = (double)n / (double)cap;
+        for (size_t i = 0; i < cap; ++i) s[i] = src[(size_t)(i * stride)];
+    }
+    std::sort(s.begin(), s.end());
+    size_t m = s.size();
+    if (m == 0) { for (int j = 0; j <= 256; ++j) edges257[j] = 0.f; return; }
+    for (int j = 0; j <= 256; ++j) {
+        double h = (double)j / 256.0 * (double)(m - 1);
+        size_t lo = (size_t)h;
+        double frac = h - (double)lo;
+        double v = s[lo];
+        if (lo + 1 < m) v += frac * ((double)s[lo + 1] - (double)s[lo]);
+        edges257[j] = (float)v;
+    }
+}
+
+}  // extern "C"

@@ -26,6 +26,44 @@ import numpy as np
 IGNORE_INDEX = -100
 
 
+def build_tokenizer(tokenizer_name: str):
+    """Tokenizer with the reference's pad-token default (train_fsdp.py:219)."""
+    from transformers import AutoTokenizer
+
+    tokenizer = AutoTokenizer.from_pretrained(tokenizer_name)
+    if tokenizer.pad_token is None:
+        tokenizer.pad_token = "</s>"
+    return tokenizer
+
+
+def parse_hf_path(dataset_name_or_paths: str, world_rank: int):
+    """-> (name, config_name|None, n_paths). Comma list = one source per
+    galaxy worker; "name:config" selects an HF builder config; allenai/c4
+    defaults to "en" (train_fsdp.py loads c4 "en")."""
+    paths = dataset_name_or_paths.split(",")
+    path = paths[world_rank % len(paths)] if len(paths) > 1 else paths[0]
+    name, _, config_name = path.partition(":")
+    if not config_name and name == "allenai/c4":
+        config_name = "en"
+    return name, config_name or None, len(paths)
+
+
+def tokenize_text(tokenizer, text: str, seq_length: int) -> dict[str, np.ndarray]:
+    """Fixed-length sample with pad masked to IGNORE_INDEX in the labels
+    (DataCollatorForLanguageModeling mlm=False semantics)."""
+    tok = tokenizer(
+        text,
+        max_length=seq_length,
+        truncation=True,
+        padding="max_length",
+        return_tensors="np",
+    )
+    ids = tok["input_ids"][0].astype(np.int32)
+    mask = tok["attention_mask"][0].astype(bool)
+    labels = np.where(mask, ids, IGNORE_INDEX).astype(np.int32)
+    return {"input_ids": ids, "labels": labels}
+
+
 class _ProducerError:
     """Sentinel carrying a prefetch-thread failure to the consumer."""
 
@@ -96,26 +134,17 @@ class HFStreamingDataset:
     def _build(self) -> None:
         from datasets import load_dataset
         from datasets.distributed import split_dataset_by_node
-        from transformers import AutoTokenizer
 
         a = self.args
-        self.tokenizer = AutoTokenizer.from_pretrained(a["tokenizer_name"])
-        if self.tokenizer.pad_token is None:
-            self.tokenizer.pad_token = "</s>"  # train_fsdp.py:219
-
-        paths = a["dataset_name_or_paths"].split(",")
-        # per-galaxy-worker data source when multiple paths given
-        path = paths[a["world_rank"] % len(paths)] if len(paths) > 1 else paths[0]
-        # "name:config" selects an HF builder config; allenai/c4 needs one,
-        # so default it (train_fsdp.py loads c4 "en")
-        name, _, config_name = path.partition(":")
-        if not config_name and name == "allenai/c4":
-            config_name = "en"
+        self.tokenizer = build_tokenizer(a["tokenizer_name"])
+        name, config_name, n_paths = parse_hf_path(
+            a["dataset_name_or_paths"], a["world_rank"]
+        )
         ds = load_dataset(
-            name, config_name or None, split=a["split"], streaming=a["streaming"]
+            name, config_name, split=a["split"], streaming=a["streaming"]
         )
         # two-level shard: galaxy worker x local host (train_fsdp.py:151-159)
-        if len(paths) == 1 and a["galaxy_size"] > 1:
+        if n_paths == 1 and a["galaxy_size"] > 1:
             ds = split_dataset_by_node(
                 ds, world_size=a["galaxy_size"], rank=a["world_rank"]
             )
@@ -139,19 +168,10 @@ class HFStreamingDataset:
             if seen_this_pass < skip:
                 seen_this_pass += 1
                 continue
-            tok = self.tokenizer(
-                sample["text"],
-                max_length=self.seq_length,
-                truncation=True,
-                padding="max_length",
-                return_tensors="np",
-            )
-            ids = tok["input_ids"][0].astype(np.int32)
-            mask = tok["attention_mask"][0].astype(bool)
-            labels = np.where(mask, ids, IGNORE_INDEX).astype(np.int32)
+            out = tokenize_text(self.tokenizer, sample["text"], self.seq_length)
             self.samples_seen += 1
             seen_this_pass += 1
-            yield {"input_ids": ids, "labels": labels}
+            yield out
 
     def state_dict(self) -> dict:
         sd: dict[str, Any] = {"samples_seen": self.samples_seen}
@@ -260,7 +280,7 @@ def get_dataloader(
         # a different seed stream acts as the held-out split
         offset = 0 if split == "train" else 10_000_019
         ds = FakeTokenizedDataset(seq_length, vocab_size, seed=seed + world_rank + offset)
-    else:
+    elif streaming:
         import jax
 
         ds = HFStreamingDataset(
@@ -268,7 +288,25 @@ def get_dataloader(
             tokenizer_name,
             seq_length,
             split=split,
-            streaming=streaming,
+            streaming=True,
+            world_rank=world_rank,
+            galaxy_size=galaxy_size,
+            process_index=jax.process_index(),
+            process_count=jax.process_count(),
+            seed=seed,
+        )
+    else:
+        # non-streaming: index-based sampling (O(1) resume, per-epoch
+        # reshuffle) instead of the streaming path's skip-ahead
+        import jax
+
+        from opendiloco_tpu.data.index import load_hf_indexed
+
+        ds = load_hf_indexed(
+            dataset_name_or_paths,
+            tokenizer_name,
+            seq_length,
+            split=split,
             world_rank=world_rank,
             galaxy_size=galaxy_size,
             process_index=jax.process_index(),

@@ -71,6 +71,7 @@ def get_lib() -> Optional[ctypes.CDLL]:
     lib.odtp_dequantize_blockwise_i8_accumulate.argtypes = [i8p, f32p, f32p, st, st]
     u8p = ctypes.POINTER(ctypes.c_uint8)
     lib.odtp_quantile_assign.argtypes = [f32p, f32p, u8p, st]
+    lib.odtp_quantile_edges.argtypes = [f32p, st, f32p]
     lib.odtp_version.restype = ctypes.c_int
     for fn in (lib.odtp_sendall, lib.odtp_recvall):
         fn.argtypes = [ctypes.c_int, ctypes.c_void_p, st]
@@ -259,3 +260,21 @@ def sock_recvall(sock, buf: np.ndarray) -> None:
         raise ConnectionResetError("peer closed mid-transfer")
     if rc != 0:
         raise OSError(-rc, f"odtp_recvall failed (rc={rc})")
+
+
+def quantile_edges(flat: np.ndarray) -> np.ndarray:
+    """257 quantile edges of a strided <=100k sample of ``flat`` (the
+    codebook build of the quantile8bit codec), float32."""
+    lib = get_lib()
+    flat = np.ascontiguousarray(flat, np.float32).reshape(-1)
+    if lib is None:
+        cap = 100_000
+        if flat.size <= cap:
+            sample = flat
+        else:
+            stride = flat.size / cap
+            sample = flat[(np.arange(cap) * stride).astype(np.int64)]
+        return np.quantile(sample, np.linspace(0, 1, 257)).astype(np.float32)
+    out = np.empty(257, np.float32)
+    lib.odtp_quantile_edges(_f32p(flat), flat.size, _f32p(out))
+    return out

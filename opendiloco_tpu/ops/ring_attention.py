@@ -5,8 +5,19 @@ SP/CP anywhere; seq_length is a scalar config, train_fsdp.py:111). On TPU it
 is first-class: the sequence dim shards over the "sp" mesh axis, each device
 holds one contiguous chunk of q/k/v, and K/V chunks rotate around the ring
 via ``jax.lax.ppermute`` while flash-style online-softmax statistics
-(m, l, acc) accumulate in float32. Peak memory per device is O(T/sp * T/sp)
-per rotation step, never the full [T, T].
+(m, l, acc) accumulate in float32. Peak memory per device is
+O(T/sp * T/sp) per rotation step, never the full [T, T].
+
+GQA is computed grouped: Q is viewed as [B, T, Hkv, G, D] and contracted
+against the narrow K/V directly -- K/V are never materialized at q-head
+width.
+
+The backward pass is a hand-written VJP (not autodiff through the scan):
+the forward saves only (q, k, v, out, lse); the backward re-rotates K/V
+around the ring a second time with dK/dV accumulators travelling along, so
+no rotation activations are kept live and each chunk's gradient lands back
+on its owner after a full revolution. This is the standard flash-attention
+backward recurrence (dS = P * (dP - rowsum(dO*O))) in ring form.
 
 Causality falls out of global position masks: a K/V chunk from a later ring
 position contributes nothing (its probabilities underflow to exp(-inf)=0),
@@ -36,53 +47,56 @@ def configure_ring(mesh, axis: str = "sp") -> None:
     _RING_AXIS = axis
 
 
-def _block_attn(q, k, v, q_pos, k_pos, m, l, acc, *, causal):
-    """One online-softmax accumulation step.
+def _grouped(q: jax.Array, hkv: int) -> jax.Array:
+    """[B, T, Hq, D] -> [B, T, Hkv, G, D] view for grouped-query attention."""
+    b, t, hq, d = q.shape
+    return q.reshape(b, t, hkv, hq // hkv, d)
 
-    q: [B, Tq, H, D]; k/v: [B, Tk, H, D]; positions are global indices.
-    m/l: [B, H, Tq, 1]; acc: [B, H, Tq, D] (all float32).
-    """
-    d = q.shape[-1]
+
+def _scores(qg: jax.Array, k: jax.Array, q_pos, k_pos, *, causal) -> jax.Array:
+    """Masked attention logits [B, Hkv, G, Tq, Tk] (float32)."""
+    d = qg.shape[-1]
     s = jnp.einsum(
-        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+        "bqhgd,bkhd->bhgqk",
+        qg,
+        k.astype(jnp.float32),
+        preferred_element_type=jnp.float32,
     ) * (d**-0.5)
     if causal:
         mask = q_pos[:, None] >= k_pos[None, :]
-        s = jnp.where(mask[None, None], s, _NEG_INF)
+        s = jnp.where(mask[None, None, None], s, _NEG_INF)
+    return s
+
+
+def _block_attn(qg, k, v, q_pos, k_pos, m, l, acc, *, causal):
+    """One online-softmax accumulation step (grouped heads).
+
+    qg: [B, Tq, Hkv, G, D]; k/v: [B, Tk, Hkv, D]; positions are global.
+    m/l: [B, Hkv, G, Tq, 1]; acc: [B, Hkv, G, Tq, D] (all float32).
+    """
+    s = _scores(qg, k, q_pos, k_pos, causal=causal)
     m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
     p = jnp.exp(s - m_new)
     corr = jnp.exp(m - m_new)
     l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
     acc_new = acc * corr + jnp.einsum(
-        "bhqk,bkhd->bhqd", p, v.astype(jnp.float32), preferred_element_type=jnp.float32
+        "bhgqk,bkhd->bhgqd",
+        p,
+        v.astype(jnp.float32),
+        preferred_element_type=jnp.float32,
     )
     return m_new, l_new, acc_new
 
 
-def ring_attention(
-    q: jax.Array,
-    k: jax.Array,
-    v: jax.Array,
-    *,
-    axis_name: str = "sp",
-    causal: bool = True,
-) -> jax.Array:
-    """Must run inside shard_map with the sequence dim sharded on axis_name.
-
-    q/k/v: local chunks [B, T_local, H, D] -> out [B, T_local, H, D].
-    """
+def _ring_forward(q, k, v, axis_name, causal):
+    """-> (out [B, Tl, Hq, D], lse [B, Hkv, G, Tq, 1] float32)."""
     b, tl, hq, d = q.shape
     hkv = k.shape[2]
-    if hkv != hq:
-        rep = hq // hkv
-        k = jnp.repeat(k, rep, axis=2)
-        v = jnp.repeat(v, rep, axis=2)
+    qg = _grouped(q.astype(jnp.float32), hkv)
 
     idx = jax.lax.axis_index(axis_name)
     n = jax.lax.axis_size(axis_name)
-    qf = q.astype(jnp.float32)
     q_pos = idx * tl + jnp.arange(tl, dtype=jnp.int32)
-
     perm = [(i, (i + 1) % n) for i in range(n)]
 
     def step(carry, i):
@@ -90,27 +104,116 @@ def ring_attention(
         src = (idx - i) % n  # whose chunk we hold at this rotation
         k_pos = src * tl + jnp.arange(tl, dtype=jnp.int32)
         m, l, acc = _block_attn(
-            qf, k_cur.astype(jnp.float32), v_cur, q_pos, k_pos, m, l, acc,
-            causal=causal,
+            qg, k_cur, v_cur, q_pos, k_pos, m, l, acc, causal=causal
         )
-        # rotate for the next step (skipped result on the last iteration)
+        # rotate for the next step (result intentionally unused on the
+        # final iteration -- K/V are simply back at their owners)
         k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
         v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
         return (k_nxt, v_nxt, m, l, acc), None
 
-    m0 = jnp.full((b, hq, tl, 1), _NEG_INF, jnp.float32)
-    l0 = jnp.zeros((b, hq, tl, 1), jnp.float32)
-    acc0 = jnp.zeros((b, hq, tl, d), jnp.float32)
+    g = hq // hkv
+    m0 = jnp.full((b, hkv, g, tl, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, hkv, g, tl, 1), jnp.float32)
+    acc0 = jnp.zeros((b, hkv, g, tl, d), jnp.float32)
     # stats become device-varying after the first accumulation step; the scan
     # carry must have that type from the start
     m0, l0, acc0 = jax.lax.pcast((m0, l0, acc0), axis_name, to="varying")
-    (k, v, m, l, acc), _ = jax.lax.scan(
+    (_, _, m, l, acc), _ = jax.lax.scan(
         step, (k, v, m0, l0, acc0), jnp.arange(n), length=n
     )
 
     l_safe = jnp.where(l == 0, 1.0, l)
-    out = (acc / l_safe).astype(q.dtype)  # [B, H, Tl, D]
-    return out.transpose(0, 2, 1, 3)
+    lse = m + jnp.log(l_safe)
+    out = acc / l_safe  # [B, Hkv, G, Tq, D]
+    out = out.transpose(0, 3, 1, 2, 4).reshape(b, tl, hq, d).astype(q.dtype)
+    return out, lse
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str = "sp",
+    causal: bool = True,
+) -> jax.Array:
+    """Must run inside shard_map with the sequence dim sharded on axis_name.
+
+    q/k/v: local chunks [B, T_local, Hq|Hkv, D] -> out [B, T_local, Hq, D].
+    """
+    out, _ = _ring_forward(q, k, v, axis_name, causal)
+    return out
+
+
+def _ring_fwd(q, k, v, axis_name, causal):
+    out, lse = _ring_forward(q, k, v, axis_name, causal)
+    return out, (q, k, v, out, lse)
+
+
+def _ring_bwd(axis_name, causal, res, dout):
+    """Flash backward in ring form: dK/dV accumulators rotate WITH their K/V
+    chunks, so after a full revolution each chunk's gradient is home."""
+    q, k, v, out, lse = res
+    b, tl, hq, d = q.shape
+    hkv = k.shape[2]
+    scale = d**-0.5
+
+    qg = _grouped(q.astype(jnp.float32), hkv)
+    dog = _grouped(dout.astype(jnp.float32), hkv)
+    outg = _grouped(out.astype(jnp.float32), hkv)
+    # D_i = rowsum(dO * O): [B, Hkv, G, Tq, 1]
+    D = jnp.sum(dog * outg, axis=-1).transpose(0, 2, 3, 1)[..., None]
+
+    idx = jax.lax.axis_index(axis_name)
+    n = jax.lax.axis_size(axis_name)
+    q_pos = idx * tl + jnp.arange(tl, dtype=jnp.int32)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(carry, i):
+        k_cur, v_cur, dk_cur, dv_cur, dq = carry
+        src = (idx - i) % n
+        k_pos = src * tl + jnp.arange(tl, dtype=jnp.int32)
+        s = _scores(qg, k_cur, q_pos, k_pos, causal=causal)
+        p = jnp.exp(s - lse)  # masked entries underflow to exactly 0
+        dv_cur = dv_cur + jnp.einsum(
+            "bhgqk,bqhgd->bkhd", p, dog, preferred_element_type=jnp.float32
+        )
+        dp = jnp.einsum(
+            "bqhgd,bkhd->bhgqk",
+            dog,
+            v_cur.astype(jnp.float32),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - D)
+        dq = dq + scale * jnp.einsum(
+            "bhgqk,bkhd->bqhgd",
+            ds,
+            k_cur.astype(jnp.float32),
+            preferred_element_type=jnp.float32,
+        )
+        dk_cur = dk_cur + scale * jnp.einsum(
+            "bhgqk,bqhgd->bkhd", ds, qg, preferred_element_type=jnp.float32
+        )
+        rotated = [
+            jax.lax.ppermute(x, axis_name, perm)
+            for x in (k_cur, v_cur, dk_cur, dv_cur)
+        ]
+        return (*rotated, dq), None
+
+    dk0 = jnp.zeros((b, tl, hkv, d), jnp.float32)
+    dv0 = jnp.zeros_like(dk0)
+    dq0 = jnp.zeros((b, tl, hkv, hq // hkv, d), jnp.float32)
+    dk0, dv0, dq0 = jax.lax.pcast((dk0, dv0, dq0), axis_name, to="varying")
+    (_, _, dk, dv, dq), _ = jax.lax.scan(
+        step, (k, v, dk0, dv0, dq0), jnp.arange(n), length=n
+    )
+    # n rotations = full revolution: dk/dv are back at their owners
+    dq = dq.reshape(b, tl, hq, d).astype(q.dtype)
+    return dq, dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+ring_attention.defvjp(_ring_fwd, _ring_bwd)
 
 
 def ring_attention_auto(
@@ -132,7 +235,8 @@ def ring_attention_auto(
     P = jax.sharding.PartitionSpec
     spec = P(None, axis, None, None)
     fn = jax.shard_map(
-        functools.partial(ring_attention, axis_name=axis, causal=True),
+        # positional args: custom_vjp nondiff_argnums are position-based
+        lambda q, k, v: ring_attention(q, k, v, axis, True),
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,

@@ -189,3 +189,72 @@ def test_fused_loss_matches_standard(interpret_pallas_fused):
             run.append(float(m["loss"]))
         losses[fused] = run
     np.testing.assert_allclose(losses[True], losses[False], rtol=1e-5, atol=1e-6)
+
+
+def _ring_out(q, k, v, n_dev):
+    from opendiloco_tpu.ops import ring_attention as ra
+
+    devices = np.asarray(jax.devices()[:n_dev]).reshape(1, 1, n_dev, 1)
+    mesh = jax.sharding.Mesh(devices, ("dp", "fsdp", "sp", "tp"))
+    return np.asarray(ra.ring_attention_auto(q, k, v, mesh=mesh, axis="sp"))
+
+
+def test_ring_attention_long_seq_sweep():
+    """Long-context sweep (VJP'd path is the same code): ring matches dense
+    at 4k, is self-consistent across ring sizes at 8k/16k, and runs at 32k
+    -- per-device working set stays O(T * T/n), never the full [T, T]."""
+    rng = np.random.default_rng(2)
+    B, HQ, HKV, D = 1, 2, 1, 32
+
+    def mk(T):
+        q = jnp.asarray(rng.normal(size=(B, T, HQ, D)), jnp.float32)
+        k = jnp.asarray(rng.normal(size=(B, T, HKV, D)), jnp.float32)
+        v = jnp.asarray(rng.normal(size=(B, T, HKV, D)), jnp.float32)
+        return q, k, v
+
+    # exactness vs dense reference at 4k
+    q, k, v = mk(4096)
+    ref = np.asarray(xla_attention(q, k, v, causal=True))
+    np.testing.assert_allclose(_ring_out(q, k, v, 4), ref, atol=2e-5)
+
+    # ring-size consistency at 8k and 16k (different rotation schedules
+    # must agree with each other without a dense reference in memory)
+    for T in (8192, 16384):
+        q, k, v = mk(T)
+        a = _ring_out(q, k, v, 4)
+        b = _ring_out(q, k, v, 8)
+        np.testing.assert_allclose(a, b, atol=2e-5)
+
+    # 32k smoke: runs and stays finite on an 8-way ring
+    q, k, v = mk(32768)
+    out = _ring_out(q, k, v, 8)
+    assert np.all(np.isfinite(out))
+
+
+def test_ring_attention_backward_no_repeat_gqa():
+    """The grouped-GQA backward produces K/V grads at K/V head width (the
+    kernel never materializes q-head-wide K/V)."""
+    from opendiloco_tpu.ops import ring_attention as ra
+
+    rng = np.random.default_rng(3)
+    B, T, HQ, HKV, D = 2, 256, 8, 2, 32
+    q = jnp.asarray(rng.normal(size=(B, T, HQ, D)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(B, T, HKV, D)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(B, T, HKV, D)), jnp.float32)
+    devices = np.asarray(jax.devices()[:4]).reshape(1, 1, 4, 1)
+    mesh = jax.sharding.Mesh(devices, ("dp", "fsdp", "sp", "tp"))
+
+    def loss_ring(q, k, v):
+        return jnp.sum(ra.ring_attention_auto(q, k, v, mesh=mesh, axis="sp") ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(xla_attention(q, k, v, causal=True) ** 2)
+
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    gg = jax.jit(jax.grad(loss_ring, argnums=(0, 1, 2)))(q, k, v)
+    assert gg[1].shape == (B, T, HKV, D) and gg[2].shape == (B, T, HKV, D)
+    for a, b in zip(gr, gg):
+        scale = np.abs(np.asarray(a)).max()
+        np.testing.assert_allclose(
+            np.asarray(b), np.asarray(a), atol=3e-5 * max(scale, 1.0)
+        )

@@ -132,3 +132,107 @@ def test_device_prefetcher_propagates_errors_and_stops():
     with _pytest.raises(StopIteration):
         next(pf2)
     pf2.stop()
+
+
+class _TextSource:
+    """Map-style source of n distinct pre-tokenized samples."""
+
+    def __init__(self, n, width=8):
+        self.n, self.width = n, width
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        return {"input_ids": np.full(self.width, i, np.int32)}
+
+
+def test_index_sampler_is_permutation_and_reshuffles():
+    from opendiloco_tpu.data.index import IndexSampler, permuted_index
+
+    n = 1000
+    for seed in (0, 7):
+        order = [permuted_index(i, n, seed) for i in range(n)]
+        assert sorted(order) == list(range(n))  # bijection
+    s = IndexSampler(n, seed=3)
+    it = iter(s)
+    epoch0 = [next(it) for _ in range(n)]
+    epoch1 = [next(it) for _ in range(n)]
+    assert sorted(epoch0) == sorted(epoch1) == list(range(n))
+    assert epoch0 != epoch1  # per-epoch reshuffle
+    assert epoch0 != list(range(n))  # actually shuffled
+
+
+def test_index_sampler_shard_partition():
+    from opendiloco_tpu.data.index import IndexSampler
+
+    n, world = 1024, 4
+    per_rank = n // world
+    seen = []
+    for rank in range(world):
+        it = iter(IndexSampler(n, seed=5, rank=rank, world=world))
+        seen.append({next(it) for _ in range(per_rank)})
+    union = set().union(*seen)
+    assert len(union) == n  # disjoint + complete
+    assert all(len(s) == per_rank for s in seen)
+
+
+def test_indexed_dataset_o1_resume_exact():
+    """Resume state is (epoch, pos): restoring it replays the identical
+    remaining stream with no skip-ahead."""
+    from opendiloco_tpu.data.index import IndexedDataset
+
+    ds = IndexedDataset(_TextSource(64), seq_length=8, seed=9)
+    it = iter(ds)
+    for _ in range(10):
+        next(it)
+    sd = ds.state_dict()
+    expect = [next(it)["input_ids"][0] for _ in range(8)]
+
+    ds2 = IndexedDataset(_TextSource(64), seq_length=8, seed=9)
+    ds2.load_state_dict(sd)
+    got = [next(iter(ds2))["input_ids"][0] for _ in range(1)]
+    it2 = iter(ds2)
+    got = [got[0]] + [next(it2)["input_ids"][0] for _ in range(7)]
+    np.testing.assert_array_equal(expect, got)
+
+
+def test_indexed_dataset_through_dataloader():
+    """IndexedDataset plugs into the stateful DataLoader: batch-exact resume
+    mid-epoch."""
+    from opendiloco_tpu.data.index import IndexedDataset
+
+    loader = DataLoader(IndexedDataset(_TextSource(40), seq_length=8, seed=1), batch_size=4)
+    it = iter(loader)
+    for _ in range(3):
+        next(it)
+    sd = loader.state_dict()
+    expect = [next(it) for _ in range(2)]
+    loader.stop()
+
+    loader2 = DataLoader(IndexedDataset(_TextSource(40), seq_length=8, seed=1), batch_size=4)
+    loader2.load_state_dict(sd)
+    it2 = iter(loader2)
+    got = [next(it2) for _ in range(2)]
+    loader2.stop()
+    for a, b in zip(expect, got):
+        np.testing.assert_array_equal(a["input_ids"], b["input_ids"])
+
+
+def test_index_sampler_rejects_overshard():
+    from opendiloco_tpu.data.index import IndexSampler
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="cannot shard"):
+        IndexSampler(32, rank=0, world=64)
+
+
+def test_indexed_dataset_legacy_samples_seen_resume():
+    """Checkpoints from the old skip-ahead path ({'samples_seen': N}) map
+    into (epoch, pos) instead of crashing."""
+    from opendiloco_tpu.data.index import IndexedDataset
+
+    ds = IndexedDataset(_TextSource(40), seq_length=8, seed=1)
+    ds.load_state_dict({"samples_seen": 95})
+    assert ds.sampler.epoch == 2 and ds.sampler.pos == 15
+    next(iter(ds))  # stream is live

@@ -68,3 +68,27 @@ def test_blockwise_partial_last_block():
     out = native.dequantize_blockwise(q, s, 700, 512)
     assert out.shape == (700,)
     assert np.abs(out - a).max() <= np.abs(a).max() * 0.02
+
+
+def test_quantile_edges_native_matches_numpy():
+    """The C quantile-codebook build is bit-compatible with the numpy
+    fallback (same strided sample, same linear interpolation)."""
+    import opendiloco_tpu.native as native_mod
+    from opendiloco_tpu import native
+
+    if not native.available():
+        import pytest
+
+        pytest.skip("native lib not built")
+    rng = np.random.default_rng(1)
+    for n in (100, 99_999, 1_000_001):
+        x = rng.standard_normal(n).astype(np.float32)
+        got = native.quantile_edges(x)
+        lib, native_mod._lib = native_mod._lib, None
+        tried, native_mod._tried = native_mod._tried, True
+        try:
+            ref = native.quantile_edges(x)
+        finally:
+            native_mod._lib, native_mod._tried = lib, tried
+        np.testing.assert_allclose(got, ref, atol=1e-6)
+        assert np.all(np.diff(got) >= 0)  # edges are sorted
