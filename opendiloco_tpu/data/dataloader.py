@@ -129,6 +129,7 @@ class HFStreamingDataset:
         self.seq_length = seq_length
         self.samples_seen = 0
         self._resume_state: Optional[dict] = None
+        self._skip_on_next_iter = 0
         self._build()
 
     def _build(self) -> None:
@@ -158,11 +159,9 @@ class HFStreamingDataset:
         if self._resume_state is not None and hasattr(self.dataset, "load_state_dict"):
             self.dataset.load_state_dict(self._resume_state)
             self._resume_state = None
-        skip = 0
-        if self._resume_state is None and self.samples_seen and not hasattr(
-            self.dataset, "load_state_dict"
-        ):
-            skip = self.samples_seen  # deterministic skip-ahead fallback
+        # deterministic skip-ahead applies only to the first pass after a
+        # resume -- an organic epoch wrap must NOT skip the whole stream
+        skip, self._skip_on_next_iter = self._skip_on_next_iter, 0
         seen_this_pass = 0
         for sample in self.dataset:
             if seen_this_pass < skip:
@@ -172,6 +171,12 @@ class HFStreamingDataset:
             self.samples_seen += 1
             seen_this_pass += 1
             yield out
+
+    def set_epoch(self, epoch: int) -> None:
+        """Re-seed the streaming shuffle buffer for a new data epoch (HF
+        IterableDataset.set_epoch passthrough)."""
+        if hasattr(self.dataset, "set_epoch"):
+            self.dataset.set_epoch(epoch)
 
     def state_dict(self) -> dict:
         sd: dict[str, Any] = {"samples_seen": self.samples_seen}
@@ -184,8 +189,10 @@ class HFStreamingDataset:
 
     def load_state_dict(self, sd: dict) -> None:
         self.samples_seen = sd.get("samples_seen", 0)
-        if "hf_state" in sd:
+        if "hf_state" in sd and hasattr(self.dataset, "load_state_dict"):
             self._resume_state = sd["hf_state"]
+        else:
+            self._skip_on_next_iter = self.samples_seen
 
 
 class DataLoader:
@@ -200,11 +207,14 @@ class DataLoader:
         self.dataset = dataset
         self.batch_size = batch_size
         self.prefetch = prefetch
+        self._epoch = 0  # data epochs completed (persisted for resume)
         self._queue: Optional[queue.Queue] = None
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
 
     def _producer(self) -> None:
+        if self._epoch and hasattr(self.dataset, "set_epoch"):
+            self.dataset.set_epoch(self._epoch)  # resume into the right shuffle
         it = iter(self.dataset)
         fresh = True
         while not self._stop.is_set():
@@ -221,7 +231,13 @@ class DataLoader:
                             RuntimeError("dataset yielded no samples")
                         ))
                         return
-                    it = iter(self.dataset)  # wrap around: next epoch
+                    # wrap around: next epoch, reshuffled when the dataset
+                    # supports it (HF streaming shuffle buffers re-seed via
+                    # set_epoch; the indexed sampler reshuffles on its own)
+                    self._epoch += 1
+                    if hasattr(self.dataset, "set_epoch"):
+                        self.dataset.set_epoch(self._epoch)
+                    it = iter(self.dataset)
                     fresh = True
             out = {
                 k: np.stack([b[k] for b in batch]) for k in batch[0].keys()
@@ -229,7 +245,7 @@ class DataLoader:
             # snapshot dataset state as of *after* this batch: state_dict()
             # is exact for the last batch the consumer actually received,
             # regardless of how far the prefetch queue has run ahead
-            snap = self.dataset.state_dict()
+            snap = (self.dataset.state_dict(), self._epoch)
             while not self._stop.is_set():
                 try:
                     self._queue.put((out, snap), timeout=0.5)
@@ -255,10 +271,14 @@ class DataLoader:
 
     def state_dict(self) -> dict:
         state = getattr(self, "_delivered_state", None)
-        return {"dataset": state if state is not None else self.dataset.state_dict()}
+        if state is None:
+            return {"dataset": self.dataset.state_dict(), "epoch": self._epoch}
+        ds_state, epoch = state
+        return {"dataset": ds_state, "epoch": epoch}
 
     def load_state_dict(self, sd: dict) -> None:
         self.dataset.load_state_dict(sd["dataset"])
+        self._epoch = int(sd.get("epoch", 0))
 
 
 def get_dataloader(

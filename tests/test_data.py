@@ -236,3 +236,92 @@ def test_indexed_dataset_legacy_samples_seen_resume():
     ds.load_state_dict({"samples_seen": 95})
     assert ds.sampler.epoch == 2 and ds.sampler.pos == 15
     next(iter(ds))  # stream is live
+
+
+def test_wraparound_calls_set_epoch():
+    """Epoch wrap-around re-seeds the dataset's shuffle (no more identical
+    order every epoch for streaming sources)."""
+
+    class EpochSource(_FiniteDataset):
+        def __init__(self, n):
+            super().__init__(n)
+            self.epochs = []
+
+        def set_epoch(self, e):
+            self.epochs.append(e)
+
+    src = EpochSource(3)
+    loader = DataLoader(src, batch_size=2, prefetch=1)
+    it = iter(loader)
+    for _ in range(4):  # 8 samples from a 3-sample source -> >=2 wraps
+        next(it)
+    loader.stop()
+    assert src.epochs[:2] == [1, 2]
+
+
+def test_data_epoch_persists_across_resume():
+    """The wrap-around epoch counter is checkpointed: a resumed loader
+    re-enters the same shuffle epoch instead of restarting at epoch 0."""
+
+    class EpochSource(_FiniteDataset):
+        def __init__(self, n):
+            super().__init__(n)
+            self.epochs = []
+
+        def set_epoch(self, e):
+            self.epochs.append(e)
+
+    src = EpochSource(4)
+    loader = DataLoader(src, batch_size=2, prefetch=1)
+    it = iter(loader)
+    for _ in range(5):  # 10 samples from 4 -> 2 wraps
+        next(it)
+    sd = loader.state_dict()
+    loader.stop()
+    assert sd["epoch"] >= 1
+
+    src2 = EpochSource(4)
+    loader2 = DataLoader(src2, batch_size=2, prefetch=1)
+    loader2.load_state_dict(sd)
+    next(iter(loader2))
+    loader2.stop()
+    assert src2.epochs[0] == sd["epoch"]  # resumed into the right epoch
+
+
+def test_streaming_skip_ahead_only_after_resume():
+    """The skip-ahead resume fallback must not skip the stream on an organic
+    epoch wrap (which previously killed training at epoch 2)."""
+    from opendiloco_tpu.data.dataloader import HFStreamingDataset
+
+    class FakeTok:
+        def __call__(self, text, **kw):
+            n = kw["max_length"]
+            return {
+                "input_ids": np.full((1, n), int(text), np.int64),
+                "attention_mask": np.ones((1, n), np.int64),
+            }
+
+    ds = HFStreamingDataset.__new__(HFStreamingDataset)
+    ds.seq_length = 4
+    ds.samples_seen = 0
+    ds._resume_state = None
+    ds._skip_on_next_iter = 0
+    ds.tokenizer = FakeTok()
+    ds.dataset = [{"text": str(i)} for i in range(3)]  # no load_state_dict
+
+    first = [s["input_ids"][0] for s in ds]
+    assert len(first) == 3
+    second = [s["input_ids"][0] for s in ds]  # organic wrap: no skip
+    assert len(second) == 3
+
+    ds2 = HFStreamingDataset.__new__(HFStreamingDataset)
+    ds2.seq_length = 4
+    ds2._resume_state = None
+    ds2._skip_on_next_iter = 0
+    ds2.tokenizer = FakeTok()
+    ds2.dataset = [{"text": str(i)} for i in range(3)]
+    ds2.load_state_dict({"samples_seen": 2})
+    resumed = [s["input_ids"][0] for s in ds2]
+    assert len(resumed) == 1 and resumed[0] == 2  # skipped exactly 2
+    again = [s["input_ids"][0] for s in ds2]
+    assert len(again) == 3  # skip applied once only
