@@ -6,14 +6,21 @@ softmax: never materializes the [T, T] score matrix; scores and softmax
 statistics accumulate in float32 on the MXU/VPU while q/k/v stream through
 VMEM tiles.
 
-Layout: kernels run per (batch, q-head, q-block) grid point with the full
-K/V for that head resident in VMEM (fine up to ~8k seq; longer sequences use
-ring attention over the sp mesh axis, ops/ring_attention.py). GQA is handled
-in the BlockSpec index maps (q-head h reads kv-head h // rep) -- KV is never
-materialized at q-head width in the forward pass.
+Layout: grid (batch, q-head, q-block, k-block) with the k-block dimension
+sequential ("arbitrary") -- K/V stream through VMEM one [block_k, d] tile
+per step while the online-softmax state (m, l, acc) persists in VMEM
+scratch across k-steps. Per-step VMEM is O(block_q*d + block_k*d),
+independent of T, so sequence length is bounded by HBM, not VMEM. GQA is
+handled in the BlockSpec index maps (q-head h reads kv-head h // rep) --
+KV is never materialized at q-head width.
+
+Causal blocks above the diagonal are skipped with pl.when, and their
+BlockSpec index maps clamp to the last needed tile so the revisited block
+index elides the DMA too -- a skipped step costs neither compute nor HBM
+traffic, only a grid step.
 
 Backward follows the standard FA2 recompute scheme: delta = rowsum(dO * O),
-one kernel for dq (loop over k blocks), one for dk/dv (loop over q blocks,
+one kernel for dq (streaming k blocks), one for dk/dv (streaming q blocks,
 accumulating over the rep q-heads of each kv head).
 """
 
@@ -24,6 +31,7 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 _NEG_INF = float(-1e30)
 
@@ -40,45 +48,56 @@ def _pick_block(t: int, preferred: int = 512) -> int:
 # ---------------------------------------------------------------------------
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int, scale: float, causal: bool):
-    # q_ref: [block_q, d]; k_ref/v_ref: [t, d]; lse_ref: [1, block_q]
+def _fwd_kernel(
+    q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
+    *, scale: float, causal: bool, num_k: int
+):
+    # q_ref/o_ref: [block_q, d]; k_ref/v_ref: [block_k, d] (one tile per step)
     block_q, d = q_ref.shape
-    t = k_ref.shape[0]
-    qi = pl.program_id(2)
-    q = q_ref[:].astype(jnp.float32) * scale
+    block_k = k_ref.shape[0]
+    qi, ki = pl.program_id(2), pl.program_id(3)
 
-    q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = jnp.full((block_q, 1), _NEG_INF, jnp.float32)
+        l_scr[:] = jnp.zeros((block_q, 1), jnp.float32)
+        acc_scr[:] = jnp.zeros((block_q, d), jnp.float32)
 
-    def body(ki, carry):
-        m_prev, l_prev, acc = carry
-        k_blk = k_ref[pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+    # causal: tiles fully above the diagonal contribute nothing
+    diag_ok = (ki * block_k) <= (qi * block_q + block_q - 1)
+
+    @pl.when(jnp.logical_or(not causal, diag_ok))
+    def _step():
+        q = q_ref[:].astype(jnp.float32) * scale
+        k_blk = k_ref[:].astype(jnp.float32)
+        v_blk = v_ref[:].astype(jnp.float32)
         s = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )  # [block_q, block_k]
         if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
             k_pos = ki * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1
             )
             s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        m_prev, l_prev, acc = m_scr[:], l_scr[:], acc_scr[:]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
         p = jnp.exp(s - m_new)
         corr = jnp.exp(m_prev - m_new)
-        l_new = l_prev * corr + jnp.sum(p, axis=1, keepdims=True)
-        acc = acc * corr + jax.lax.dot_general(
+        m_scr[:] = m_new
+        l_scr[:] = l_prev * corr + jnp.sum(p, axis=1, keepdims=True)
+        acc_scr[:] = acc * corr + jax.lax.dot_general(
             p, v_blk, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
-        return m_new, l_new, acc
 
-    num_k = t // block_k if not causal else (qi * block_q + block_q) // block_k
-    m0 = jnp.full((block_q, 1), _NEG_INF, jnp.float32)
-    l0 = jnp.zeros((block_q, 1), jnp.float32)
-    acc0 = jnp.zeros((block_q, d), jnp.float32)
-    m, l, acc = jax.lax.fori_loop(0, num_k, body, (m0, l0, acc0))
-
-    l_safe = jnp.where(l == 0, 1.0, l)
-    o_ref[:] = (acc / l_safe).astype(o_ref.dtype)
-    lse_ref[:] = (m + jnp.log(l_safe)).reshape(1, block_q)
+    @pl.when(ki == num_k - 1)
+    def _finish():
+        l = l_scr[:]
+        l_safe = jnp.where(l == 0, 1.0, l)
+        o_ref[:] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
+        lse_ref[:] = (m_scr[:] + jnp.log(l_safe)).reshape(1, block_q)
 
 
 def _fwd(q, k, v, *, block_q: int, block_k: int, causal: bool):
@@ -87,24 +106,49 @@ def _fwd(q, k, v, *, block_q: int, block_k: int, causal: bool):
     hkv = k.shape[1]
     rep = hq // hkv
     scale = d**-0.5
+    num_k = t // block_k
 
-    grid = (b, hq, t // block_q)
+    if causal:
+        # clamp skipped above-diagonal steps to the last needed tile: an
+        # unchanged block index re-uses the resident copy (no DMA)
+        def kv_map(bi, hi, qi, ki):
+            last = (qi * block_q + block_q - 1) // block_k
+            return (bi, hi // rep, jnp.minimum(ki, last), 0)
+    else:
+        def kv_map(bi, hi, qi, ki):
+            return (bi, hi // rep, ki, 0)
+
+    grid = (b, hq, t // block_q, num_k)
     out, lse = pl.pallas_call(
-        functools.partial(_fwd_kernel, block_k=block_k, scale=scale, causal=causal),
+        functools.partial(_fwd_kernel, scale=scale, causal=causal, num_k=num_k),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((None, None, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
-            pl.BlockSpec((None, None, t, d), lambda bi, hi, qi: (bi, hi // rep, 0, 0)),
-            pl.BlockSpec((None, None, t, d), lambda bi, hi, qi: (bi, hi // rep, 0, 0)),
+            pl.BlockSpec(
+                (None, None, block_q, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)
+            ),
+            pl.BlockSpec((None, None, block_k, d), kv_map),
+            pl.BlockSpec((None, None, block_k, d), kv_map),
         ],
         out_specs=[
-            pl.BlockSpec((None, None, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
-            pl.BlockSpec((None, None, 1, block_q), lambda bi, hi, qi: (bi, hi, 0, qi)),
+            pl.BlockSpec(
+                (None, None, block_q, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)
+            ),
+            pl.BlockSpec(
+                (None, None, 1, block_q), lambda bi, hi, qi, ki: (bi, hi, 0, qi)
+            ),
         ],
         out_shape=[
             jax.ShapeDtypeStruct(q.shape, q.dtype),
             jax.ShapeDtypeStruct((b, hq, 1, t), jnp.float32),
         ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
+        ),
     )(q, k, v)
     return out, lse
 
@@ -114,24 +158,36 @@ def _fwd(q, k, v, *, block_q: int, block_k: int, causal: bool):
 # ---------------------------------------------------------------------------
 
 
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *, block_k, scale, causal):
-    # q/do/dq: [block_q, d]; k/v: [t, d]; lse/delta: [1, block_q]
+def _dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr,
+    *, scale, causal, num_k
+):
+    # q/do/dq: [block_q, d]; k/v: [block_k, d] per step; lse/delta: [1, block_q]
     block_q, d = q_ref.shape
-    t = k_ref.shape[0]
-    qi = pl.program_id(2)
-    q = q_ref[:].astype(jnp.float32)
-    do = do_ref[:].astype(jnp.float32)
-    lse = lse_ref[:].reshape(block_q, 1)
-    delta = delta_ref[:].reshape(block_q, 1)
-    q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+    block_k = k_ref.shape[0]
+    qi, ki = pl.program_id(2), pl.program_id(3)
 
-    def body(ki, dq):
-        k_blk = k_ref[pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+    @pl.when(ki == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros((block_q, d), jnp.float32)
+
+    diag_ok = (ki * block_k) <= (qi * block_q + block_q - 1)
+
+    @pl.when(jnp.logical_or(not causal, diag_ok))
+    def _step():
+        q = q_ref[:].astype(jnp.float32)
+        do = do_ref[:].astype(jnp.float32)
+        lse = lse_ref[:].reshape(block_q, 1)
+        delta = delta_ref[:].reshape(block_q, 1)
+        k_blk = k_ref[:].astype(jnp.float32)
+        v_blk = v_ref[:].astype(jnp.float32)
         s = scale * jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
         if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
             k_pos = ki * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1
             )
@@ -141,64 +197,69 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *, block
             do, v_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
         ds = p * (dp - delta)
-        return dq + scale * jax.lax.dot_general(
+        dq_scr[:] = dq_scr[:] + scale * jax.lax.dot_general(
             ds, k_blk, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
 
-    num_k = t // block_k if not causal else (qi * block_q + block_q) // block_k
-    dq = jax.lax.fori_loop(0, num_k, body, jnp.zeros((block_q, d), jnp.float32))
-    dq_ref[:] = dq.astype(dq_ref.dtype)
+    @pl.when(ki == num_k - 1)
+    def _finish():
+        dq_ref[:] = dq_scr[:].astype(dq_ref.dtype)
 
 
 def _dkv_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, *, block_q, scale, causal, rep
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+    dk_scr, dv_scr, *, scale, causal, rep, num_q
 ):
-    # grid point: (batch, kv-head, k-block). q/do: [rep, t, d];
-    # k/v/dk/dv: [block_k, d]; lse/delta: [rep, t]
+    # grid point: (batch, kv-head, k-block, rep*q-block). q/do: [1, block_q, d]
+    # per step; k/v/dk/dv: [block_k, d]; lse/delta: [1, block_q]
     block_k, d = k_ref.shape
-    t = q_ref.shape[1]
-    ki = pl.program_id(2)
-    k_blk = k_ref[:].astype(jnp.float32)
-    v_blk = v_ref[:].astype(jnp.float32)
-    k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+    block_q = q_ref.shape[1]
+    ki, step = pl.program_id(2), pl.program_id(3)
+    qj = step % num_q  # q-block index within a head
 
-    def head_body(r, carry):
-        def body(qj, carry2):
-            dk, dv = carry2
-            q_blk = q_ref[r, pl.ds(qj * block_q, block_q), :].astype(jnp.float32)
-            do_blk = do_ref[r, pl.ds(qj * block_q, block_q), :].astype(jnp.float32)
-            lse_blk = lse_ref[r, pl.ds(qj * block_q, block_q)].reshape(block_q, 1)
-            delta_blk = delta_ref[r, pl.ds(qj * block_q, block_q)].reshape(block_q, 1)
-            s = scale * jax.lax.dot_general(
-                q_blk, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-            )
-            if causal:
-                q_pos = qj * block_q + jax.lax.broadcasted_iota(
-                    jnp.int32, (block_q, block_k), 0
-                )
-                s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
-            p = jnp.exp(s - lse_blk)
-            dv = dv + jax.lax.dot_general(
-                p, do_blk, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
-            )
-            dp = jax.lax.dot_general(
-                do_blk, v_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-            )
-            ds = p * (dp - delta_blk)
-            dk = dk + scale * jax.lax.dot_general(
-                ds, q_blk, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
-            )
-            return dk, dv
+    @pl.when(step == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros((block_k, d), jnp.float32)
+        dv_scr[:] = jnp.zeros((block_k, d), jnp.float32)
 
-        # causal: only q blocks at or after this k block contribute
-        q_start = (ki * block_k) // block_q if causal else 0
-        return jax.lax.fori_loop(q_start, t // block_q, body, carry)
+    # causal: only q blocks at or after this k block contribute
+    diag_ok = (qj * block_q + block_q - 1) >= (ki * block_k)
 
-    dk0 = jnp.zeros((block_k, d), jnp.float32)
-    dv0 = jnp.zeros((block_k, d), jnp.float32)
-    dk, dv = jax.lax.fori_loop(0, rep, head_body, (dk0, dv0))
-    dk_ref[:] = dk.astype(dk_ref.dtype)
-    dv_ref[:] = dv.astype(dv_ref.dtype)
+    @pl.when(jnp.logical_or(not causal, diag_ok))
+    def _step():
+        k_blk = k_ref[:].astype(jnp.float32)
+        v_blk = v_ref[:].astype(jnp.float32)
+        q_blk = q_ref[0].astype(jnp.float32)
+        do_blk = do_ref[0].astype(jnp.float32)
+        lse_blk = lse_ref[:].reshape(block_q, 1)
+        delta_blk = delta_ref[:].reshape(block_q, 1)
+        s = scale * jax.lax.dot_general(
+            q_blk, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        if causal:
+            q_pos = qj * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        p = jnp.exp(s - lse_blk)
+        dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
+            p, do_blk, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        dp = jax.lax.dot_general(
+            do_blk, v_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta_blk)
+        dk_scr[:] = dk_scr[:] + scale * jax.lax.dot_general(
+            ds, q_blk, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    @pl.when(step == rep * num_q - 1)
+    def _finish():
+        dk_ref[:] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[:] = dv_scr[:].astype(dv_ref.dtype)
 
 
 def _bwd(block_q, block_k, causal, res, dout):
@@ -207,53 +268,112 @@ def _bwd(block_q, block_k, causal, res, dout):
     hkv = k.shape[1]
     rep = hq // hkv
     scale = d**-0.5
+    num_k = t // block_k
+    num_q = t // block_q
 
     delta = jnp.sum(
         dout.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
     ).reshape(b, hq, 1, t)
 
+    if causal:
+        def kv_map(bi, hi, qi, ki):
+            last = (qi * block_q + block_q - 1) // block_k
+            return (bi, hi // rep, jnp.minimum(ki, last), 0)
+    else:
+        def kv_map(bi, hi, qi, ki):
+            return (bi, hi // rep, ki, 0)
+
     dq = pl.pallas_call(
-        functools.partial(_dq_kernel, block_k=block_k, scale=scale, causal=causal),
-        grid=(b, hq, t // block_q),
+        functools.partial(_dq_kernel, scale=scale, causal=causal, num_k=num_k),
+        grid=(b, hq, num_q, num_k),
         in_specs=[
-            pl.BlockSpec((None, None, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
-            pl.BlockSpec((None, None, t, d), lambda bi, hi, qi: (bi, hi // rep, 0, 0)),
-            pl.BlockSpec((None, None, t, d), lambda bi, hi, qi: (bi, hi // rep, 0, 0)),
-            pl.BlockSpec((None, None, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
-            pl.BlockSpec((None, None, 1, block_q), lambda bi, hi, qi: (bi, hi, 0, qi)),
-            pl.BlockSpec((None, None, 1, block_q), lambda bi, hi, qi: (bi, hi, 0, qi)),
+            pl.BlockSpec(
+                (None, None, block_q, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)
+            ),
+            pl.BlockSpec((None, None, block_k, d), kv_map),
+            pl.BlockSpec((None, None, block_k, d), kv_map),
+            pl.BlockSpec(
+                (None, None, block_q, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)
+            ),
+            pl.BlockSpec(
+                (None, None, 1, block_q), lambda bi, hi, qi, ki: (bi, hi, 0, qi)
+            ),
+            pl.BlockSpec(
+                (None, None, 1, block_q), lambda bi, hi, qi, ki: (bi, hi, 0, qi)
+            ),
         ],
-        out_specs=pl.BlockSpec((None, None, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
+        out_specs=pl.BlockSpec(
+            (None, None, block_q, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)
+        ),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
+        ),
     )(q, k, v, dout, lse, delta)
 
-    # dk/dv: group q by kv head: [b, hkv, rep, t, d]
+    # dk/dv: group q by kv head: [b, hkv, rep, t, d]; the sequential grid
+    # dim walks (rep, q-block) in row-major order, streaming one q tile per
+    # step while dk/dv accumulate in scratch
     q_g = q.reshape(b, hkv, rep, t, d)
     do_g = dout.reshape(b, hkv, rep, t, d)
-    lse_g = lse.reshape(b, hkv, rep, t)
-    delta_g = delta.reshape(b, hkv, rep, t)
+    lse_g = lse.reshape(b, hkv, rep, 1, t)
+    delta_g = delta.reshape(b, hkv, rep, 1, t)
+
+    def _qj(ki, st):
+        qj = st % num_q
+        if causal:  # clamp skipped below-diagonal q tiles (DMA elision)
+            qj = jnp.maximum(qj, (ki * block_k) // block_q)
+        return qj
 
     dk, dv = pl.pallas_call(
         functools.partial(
-            _dkv_kernel, block_q=block_q, scale=scale, causal=causal, rep=rep
+            _dkv_kernel, scale=scale, causal=causal, rep=rep, num_q=num_q
         ),
-        grid=(b, hkv, t // block_k),
+        grid=(b, hkv, num_k, rep * num_q),
         in_specs=[
-            pl.BlockSpec((None, None, rep, t, d), lambda bi, hi, ki: (bi, hi, 0, 0, 0)),
-            pl.BlockSpec((None, None, block_k, d), lambda bi, hi, ki: (bi, hi, ki, 0)),
-            pl.BlockSpec((None, None, block_k, d), lambda bi, hi, ki: (bi, hi, ki, 0)),
-            pl.BlockSpec((None, None, rep, t, d), lambda bi, hi, ki: (bi, hi, 0, 0, 0)),
-            pl.BlockSpec((None, None, rep, t), lambda bi, hi, ki: (bi, hi, 0, 0)),
-            pl.BlockSpec((None, None, rep, t), lambda bi, hi, ki: (bi, hi, 0, 0)),
+            pl.BlockSpec(
+                (None, None, 1, block_q, d),
+                lambda bi, hi, ki, st: (bi, hi, st // num_q, _qj(ki, st), 0),
+            ),
+            pl.BlockSpec(
+                (None, None, block_k, d), lambda bi, hi, ki, st: (bi, hi, ki, 0)
+            ),
+            pl.BlockSpec(
+                (None, None, block_k, d), lambda bi, hi, ki, st: (bi, hi, ki, 0)
+            ),
+            pl.BlockSpec(
+                (None, None, 1, block_q, d),
+                lambda bi, hi, ki, st: (bi, hi, st // num_q, _qj(ki, st), 0),
+            ),
+            pl.BlockSpec(
+                (None, None, 1, 1, block_q),
+                lambda bi, hi, ki, st: (bi, hi, st // num_q, 0, _qj(ki, st)),
+            ),
+            pl.BlockSpec(
+                (None, None, 1, 1, block_q),
+                lambda bi, hi, ki, st: (bi, hi, st // num_q, 0, _qj(ki, st)),
+            ),
         ],
         out_specs=[
-            pl.BlockSpec((None, None, block_k, d), lambda bi, hi, ki: (bi, hi, ki, 0)),
-            pl.BlockSpec((None, None, block_k, d), lambda bi, hi, ki: (bi, hi, ki, 0)),
+            pl.BlockSpec(
+                (None, None, block_k, d), lambda bi, hi, ki, st: (bi, hi, ki, 0)
+            ),
+            pl.BlockSpec(
+                (None, None, block_k, d), lambda bi, hi, ki, st: (bi, hi, ki, 0)
+            ),
         ],
         out_shape=[
             jax.ShapeDtypeStruct(k.shape, k.dtype),
             jax.ShapeDtypeStruct(v.shape, v.dtype),
         ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
+        ),
     )(q_g, k, v, do_g, lse_g, delta_g)
 
     return dq, dk, dv

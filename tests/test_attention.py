@@ -258,3 +258,32 @@ def test_ring_attention_backward_no_repeat_gqa():
         np.testing.assert_allclose(
             np.asarray(b), np.asarray(a), atol=3e-5 * max(scale, 1.0)
         )
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_streaming_multiblock_parity(interpret_pallas, causal):
+    """T=1024 -> 4 streamed k-blocks per q-block: exercises the scratch
+    carry across the sequential grid dimension (fwd + both bwd kernels),
+    both causal (clamped index maps) and full attention."""
+    from opendiloco_tpu.ops.flash_attention import flash_attention
+
+    rng = np.random.default_rng(5)
+    B, T, H, HKV, D = 1, 1024, 4, 2, 32
+    q = jnp.asarray(rng.normal(size=(B, T, H, D)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(B, T, HKV, D)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(B, T, HKV, D)), jnp.float32)
+
+    ref = xla_attention(q, k, v, causal=causal)
+    got = flash_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=2e-5)
+
+    def loss(fn, q, k, v):
+        return jnp.sum(fn(q, k, v, causal=causal) ** 2)
+
+    gr = jax.grad(functools.partial(loss, xla_attention), argnums=(0, 1, 2))(q, k, v)
+    gg = jax.grad(functools.partial(loss, flash_attention), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gr, gg):
+        scale = np.abs(np.asarray(a)).max()
+        np.testing.assert_allclose(
+            np.asarray(b), np.asarray(a), atol=3e-5 * max(scale, 1.0)
+        )
