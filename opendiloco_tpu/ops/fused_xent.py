@@ -34,12 +34,20 @@ def _pick(n: int, pref: int) -> int:
     return 0
 
 
+def _mask_pad(s, j: int, block_v: int, true_v: int):
+    """-inf out vocab-pad columns (tile j of a padded head)."""
+    gcols = j * block_v + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    return jnp.where(gcols < true_v, s, -1e30)
+
+
 # ---------------------------------------------------------------------------
 # forward: grid (token_blocks, vocab_tiles); scratch carries online stats
 # ---------------------------------------------------------------------------
 
 
-def _fwd_kernel(h_ref, w_ref, lbl_ref, nll_ref, lse_ref, m_s, l_s, tgt_s, *, block_v):
+def _fwd_kernel(
+    h_ref, w_ref, lbl_ref, nll_ref, lse_ref, m_s, l_s, tgt_s, *, block_v, true_v
+):
     j = pl.program_id(1)
     nv = pl.num_programs(1)
 
@@ -55,6 +63,8 @@ def _fwd_kernel(h_ref, w_ref, lbl_ref, nll_ref, lse_ref, m_s, l_s, tgt_s, *, blo
         (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
     )  # [block_n, block_v]
+    if true_v % block_v:  # vocab padded up to tile size
+        s = _mask_pad(s, j, block_v, true_v)
 
     m_prev = m_s[:]
     m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
@@ -78,12 +88,12 @@ def _fwd_kernel(h_ref, w_ref, lbl_ref, nll_ref, lse_ref, m_s, l_s, tgt_s, *, blo
         lse_ref[:] = lse.reshape(lse_ref.shape)
 
 
-def _fwd(h, w, labels, block_n, block_v):
+def _fwd(h, w, labels, block_n, block_v, true_v):
     n, d = h.shape
     v = w.shape[1]
     grid = (n // block_n, v // block_v)
     nll, lse = pl.pallas_call(
-        functools.partial(_fwd_kernel, block_v=block_v),
+        functools.partial(_fwd_kernel, block_v=block_v, true_v=true_v),
         grid=grid,
         in_specs=[
             pl.BlockSpec((block_n, d), lambda i, j: (i, 0)),
@@ -114,7 +124,7 @@ def _fwd(h, w, labels, block_n, block_v):
 
 
 def _bwd_kernel(
-    h_ref, w_ref, lbl_ref, lse_ref, g_ref, dh_ref, dw_ref, dh_s, *, block_v
+    h_ref, w_ref, lbl_ref, lse_ref, g_ref, dh_ref, dw_ref, dh_s, *, block_v, true_v
 ):
     i = pl.program_id(0)
     j = pl.program_id(1)
@@ -130,6 +140,8 @@ def _bwd_kernel(
     s = jax.lax.dot_general(
         hf, wf, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
     )
+    if true_v % block_v:  # padded vocab: pad columns contribute p = 0
+        s = _mask_pad(s, j, block_v, true_v)
     p = jnp.exp(s - lse_ref[:].reshape(-1, 1))
 
     lbl = lbl_ref[:].reshape(-1, 1)
@@ -161,12 +173,12 @@ def _bwd_kernel(
         dh_ref[:] = dh_s[:].astype(dh_ref.dtype)
 
 
-def _bwd_impl(h, w, labels, lse, g, block_n, block_v):
+def _bwd_impl(h, w, labels, lse, g, block_n, block_v, true_v):
     n, d = h.shape
     v = w.shape[1]
     grid = (n // block_n, v // block_v)
     dh, dw = pl.pallas_call(
-        functools.partial(_bwd_kernel, block_v=block_v),
+        functools.partial(_bwd_kernel, block_v=block_v, true_v=true_v),
         grid=grid,
         in_specs=[
             pl.BlockSpec((block_n, d), lambda i, j: (i, 0)),
@@ -193,21 +205,21 @@ def _bwd_impl(h, w, labels, lse, g, block_n, block_v):
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def _fused_nll(h, w, labels, block_n, block_v):
-    nll, _ = _fwd(h, w, labels, block_n, block_v)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _fused_nll(h, w, labels, block_n, block_v, true_v):
+    nll, _ = _fwd(h, w, labels, block_n, block_v, true_v)
     return nll
 
 
-def _fused_fwd(h, w, labels, block_n, block_v):
-    nll, lse = _fwd(h, w, labels, block_n, block_v)
+def _fused_fwd(h, w, labels, block_n, block_v, true_v):
+    nll, lse = _fwd(h, w, labels, block_n, block_v, true_v)
     return nll, (h, w, labels, lse)
 
 
-def _fused_bwd(block_n, block_v, res, g):
+def _fused_bwd(block_n, block_v, true_v, res, g):
     h, w, labels, lse = res
     mask = (labels != IGNORE).astype(jnp.float32)
-    dh, dw = _bwd_impl(h, w, labels, lse, g * mask, block_n, block_v)
+    dh, dw = _bwd_impl(h, w, labels, lse, g * mask, block_n, block_v, true_v)
     return dh.astype(h.dtype), dw.astype(w.dtype), None
 
 
@@ -219,7 +231,10 @@ def fused_linear_cross_entropy(
 ) -> jax.Array:
     """Mean nll over non-ignored labels; h [N, D], w [D, V], labels [N].
 
-    Falls back to the materializing path for shapes the kernel can't tile.
+    Vocabs that don't tile (e.g. Llama's 32000) are zero-padded up to the
+    next block_v multiple and masked in-kernel, so the MXU always sees
+    wide tiles instead of degrading to 128. Falls back to the
+    materializing path only when tokens or hidden don't tile.
     """
     n, d = h.shape
     v = w.shape[1]
@@ -227,11 +242,22 @@ def fused_linear_cross_entropy(
     block_v = _pick(v, 2048)
     mask = labels != IGNORE
     count = jnp.maximum(jnp.sum(mask), 1)
-    if block_n == 0 or block_v == 0 or d % 128 != 0:
+    if block_n == 0 or d % 128 != 0:
         logits = (h.astype(jnp.float32) @ w.astype(jnp.float32))
         lp = jax.nn.log_softmax(logits, axis=-1)
         safe = jnp.where(mask, labels, 0)
         nll = -jnp.take_along_axis(lp, safe[:, None], axis=1)[:, 0] * mask
         return jnp.sum(nll) / count
-    nll = _fused_nll(h, w, labels, block_n, block_v)
+    if block_v < 512:
+        # pad the head to the smallest wide tile (least dead columns);
+        # padded logits are masked to -inf in the kernels (a small pad
+        # copy beats 128-wide MXU tiles)
+        block_v = min(
+            (b for b in (512, 1024, 2048)), key=lambda b: -(-v // b) * b
+        )
+        v_pad = -(-v // block_v) * block_v
+        w_in = jnp.pad(w, ((0, 0), (0, v_pad - v)))
+        nll = _fused_nll(h, w_in, labels, block_n, block_v, v)
+    else:
+        nll = _fused_nll(h, w, labels, block_n, block_v, v)
     return jnp.sum(nll) / count
