@@ -148,6 +148,18 @@ def main():
 
     from opendiloco_tpu.models.hf_io import get_model
 
+    # persistent compile cache: repeated bench runs (and watchdog-aborted
+    # retries) skip the 20-40s first compile instead of burning the budget
+    cache_dir = os.environ.get(
+        "OPENDILOCO_TPU_COMPILE_CACHE", "/tmp/odtp-jax-cache"
+    )
+    if cache_dir:
+        try:
+            jax.config.update("jax_compilation_cache_dir", cache_dir)
+            jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        except Exception as e:
+            print(f"# compile cache disabled: {e}", flush=True)
+
     watchdog = _watchdog(540.0)
 
     model = os.environ.get("OPENDILOCO_TPU_BENCH_MODEL", "150m")
