@@ -77,6 +77,15 @@ class DilocoConfig(BaseModel):
     # optional periodic full state averaging (hivemind_diloco.py:634-638)
     average_state_every: int = 0  # 0 = never
 
+    # overlap the outer all-reduce with the next inner epoch (Eager Updates
+    # for Overlapped Communication in DiLoCo, arxiv 2502.12996):
+    #   "none"    - blocking outer step (reference semantics)
+    #   "delayed" - inner training continues; the averaged outer update is
+    #               applied as a parameter delta when communication lands
+    #   "eager"   - additionally applies the update estimated from the LOCAL
+    #               pseudo-gradient immediately, corrected on arrival
+    overlap_comm: Literal["none", "delayed", "eager"] = "none"
+
     @field_validator("initial_peers", mode="before")
     @classmethod
     def _coerce_peers(cls, v: Any) -> Any:

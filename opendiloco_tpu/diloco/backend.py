@@ -61,12 +61,16 @@ class OuterBackend(abc.ABC):
         *,
         timeout: Optional[float] = None,
         tag: str = "grads",
+        epoch: Optional[int] = None,
     ) -> tuple[list[np.ndarray], int]:
         """Average the arrays across the group; returns (averaged, group_size).
 
         Blocks until the group round completes; raises AllReduceError on
         timeout/failure. ``tag`` namespaces concurrent round types (gradient
-        vs state averaging). Wire compression is a backend concern.
+        vs state averaging). ``epoch`` pins the round key explicitly (pass it
+        when calling from a background thread -- reading the gossiped own
+        progress there races with the training thread advancing it). Wire
+        compression is a backend concern.
         """
 
     @abc.abstractmethod

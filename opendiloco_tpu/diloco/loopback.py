@@ -65,7 +65,7 @@ class LoopbackBackend(OuterBackend):
         with self.world.lock:
             return len(self.world.live)
 
-    def all_reduce(self, arrays, *, timeout=None, tag="grads"):
+    def all_reduce(self, arrays, *, timeout=None, tag="grads", epoch=None):
         """Average across live peers. The round completes when every live
         peer has contributed; dropped peers stop blocking the group the
         moment they close(). Lossy codecs are applied to each contribution

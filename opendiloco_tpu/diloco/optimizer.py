@@ -18,6 +18,7 @@ boundary, so the 500-step inner phases never recompile.
 
 from __future__ import annotations
 
+import threading
 import time
 from typing import Any, Optional
 
@@ -75,6 +76,13 @@ class DiLoCoOptimizer:
         self._epoch_t0 = time.monotonic()
         self.last_outer_metrics: dict[str, Any] = {}
 
+        # overlapped-communication state (arxiv 2502.12996): at most one
+        # outer all-reduce in flight while inner training continues
+        self._pending: Optional[dict[str, Any]] = None
+        self._abandoned: Optional[Any] = None  # dropped round still running
+        self._landed_metrics: Optional[dict[str, Any]] = None
+        self._apply_delta = None
+
         backend.serve_state(self._state_for_peers)
 
     # ------------------------------------------------------------------
@@ -82,6 +90,17 @@ class DiLoCoOptimizer:
     # ------------------------------------------------------------------
 
     def _state_for_peers(self) -> dict[str, Any]:
+        if self._pending is not None:
+            # while a round is in flight, epoch is already advanced but the
+            # master excludes that round's update; serve the consistent
+            # pre-round snapshot so an onboarding peer never adopts a
+            # (new epoch, old master) mismatch
+            p = self._pending
+            return {
+                "master": [m.copy() for m in p["master_snap"]],
+                "epoch": p["epoch"],
+                "outer_opt": dict(p["opt_snap"]),
+            }
         return {
             "master": [m.copy() for m in self.master],
             "epoch": self.epoch,
@@ -90,6 +109,7 @@ class DiLoCoOptimizer:
 
     def load_state_from_peers(self, state: dict) -> Optional[dict]:
         """Adopt a peer's master params/epoch; returns updated device state."""
+        self.drop_pending()  # adopting remote state supersedes in-flight comm
         remote = self.backend.fetch_state()
         if remote is None:
             return None
@@ -120,6 +140,8 @@ class DiLoCoOptimizer:
     def step(self, state: dict, batch: dict) -> tuple[dict, dict]:
         """One inner optimizer step; triggers the outer step at the epoch
         boundary. Returns (state, metrics)."""
+        if self._pending is not None:
+            state = self._poll_pending(state, block=False)
         if self.local_step == 0 and self._behind_swarm():
             # discard the stale local phase and adopt the swarm state before
             # burning compute on an epoch the group has moved past
@@ -154,16 +176,245 @@ class DiLoCoOptimizer:
 
         metrics = dict(metrics)
         metrics["epoch"] = self.epoch
+        if self._landed_metrics is not None:  # overlapped round completed
+            metrics.update(self._landed_metrics)
+            self._landed_metrics = None
         if self.local_step >= self.cfg.local_steps:
-            state, outer_metrics = self.outer_step(state)
+            overlap = self.cfg.overlap_comm != "none" and not self._is_state_avg_epoch()
+            if overlap:
+                state, outer_metrics = self._outer_step_overlapped(state)
+            else:
+                state, outer_metrics = self.outer_step(state)
             metrics.update(outer_metrics)
         return state, metrics
+
+    def _is_state_avg_epoch(self) -> bool:
+        """Full-state-averaging epochs run the blocking path (they rewrite
+        the master wholesale; overlapping them buys nothing)."""
+        return (
+            self.cfg.average_state_every > 0
+            and (self.epoch + 1) % self.cfg.average_state_every == 0
+        )
+
+    # ------------------------------------------------------------------
+    # overlapped outer step (Eager Updates for Overlapped Communication
+    # and Computation in DiLoCo, arxiv 2502.12996)
+    # ------------------------------------------------------------------
+
+    def _outer_step_overlapped(self, state: dict) -> tuple[dict, dict]:
+        """Launch the outer all-reduce in the background and keep training.
+
+        Blocking DiLoCo rewrites the device from the boundary params theta_b
+        to the new master M'. Overlapped, the device keeps stepping from
+        theta_b; when the average lands we apply the SAME rewrite as a delta:
+        params += (M' - theta_b). "eager" additionally applies the update
+        estimated from the local pseudo-gradient immediately and corrects
+        with (M'_true - M'_est) on arrival.
+        """
+        assert schema_fingerprint(state["params"]) == self._schema, (
+            "parameter schema changed mid-epoch"
+        )
+        t0 = time.monotonic()
+        if self._pending is not None:  # at most one round in flight
+            state = self._poll_pending(state, block=True)
+        if self._abandoned is not None:
+            # a dropped round may still be running (its reduce can't be
+            # cancelled); let it drain before keying a new round
+            try:
+                self._abandoned.result(timeout=self.cfg.averaging_timeout + 60)
+            except Exception:
+                pass
+            self._abandoned = None
+
+        # overlap the boundary D2H with the straggler wait (same trick as
+        # the blocking path): params are final at the boundary
+        fetch_result: list = []
+
+        def _fetch():
+            fetch_result.append(
+                [
+                    np.asarray(x, dtype=np.float32)
+                    for x in jax.tree.leaves(jax.device_get(state["params"]))
+                ]
+            )
+
+        fetcher = threading.Thread(target=_fetch)
+        fetcher.start()
+        wait_for_peers(
+            self.backend,
+            target_samples=self.target_samples,
+            own_epoch=self.epoch,
+            strategy=self.cfg.all_reduce_strategy,
+            timeout_waiting_for_peers=self.cfg.timeout_waiting_for_peers,
+            log=log,
+        )
+        wait_s = time.monotonic() - t0
+        fetcher.join()
+        boundary = fetch_result[0]
+        pseudo_grad = [native.sub(m, d) for m, d in zip(self.master, boundary)]
+
+        pending: dict[str, Any] = {
+            "master_snap": [m.copy() for m in self.master],
+            "opt_snap": self.outer_opt.state_dict(),
+            "boundary": boundary,
+            "epoch": self.epoch,
+            "t_launch": t0,
+            "future": self._spawn_all_reduce(pseudo_grad, self.epoch),
+        }
+
+        if self.cfg.overlap_comm == "eager":
+            # immediate update from the local pseudo-gradient (own epoch's
+            # contribution stands in for the average until it arrives)
+            est_opt = OuterSGD(
+                lr=self.cfg.outer_lr,
+                momentum=self.cfg.outer_momentum,
+                nesterov=self.cfg.outer_nesterov,
+            )
+            est_opt.load_state_dict(pending["opt_snap"])
+            est_master = [m.copy() for m in pending["master_snap"]]
+            est_opt.step(est_master, pseudo_grad)
+            delta = [e - b for e, b in zip(est_master, boundary)]
+            state = self._apply_delta_to_device(state, delta)
+            self.master = est_master
+            pending["est_master"] = est_master
+
+        self._pending = pending
+        self.epoch += 1
+        self.local_step = 0
+        self.samples_in_epoch = 0
+        self._epoch_t0 = time.monotonic()
+        outer_metrics = {
+            "outer_step_s": time.monotonic() - t0,
+            "outer_wait_s": wait_s,
+            "outer_overlapped": 1,
+        }
+        self.last_outer_metrics = outer_metrics
+        return state, outer_metrics
+
+    def _spawn_all_reduce(self, pseudo_grad: list, epoch: int):
+        """Run backend.all_reduce on a daemon thread (a wedged round must
+        never block interpreter exit) with the round epoch pinned at submit
+        time (the training thread advances self.epoch immediately after)."""
+        import concurrent.futures
+
+        fut: concurrent.futures.Future = concurrent.futures.Future()
+
+        def _run():
+            if not fut.set_running_or_notify_cancel():
+                return  # dropped before the round started
+            try:
+                fut.set_result(
+                    self.backend.all_reduce(
+                        pseudo_grad,
+                        timeout=self.cfg.averaging_timeout,
+                        epoch=epoch,
+                    )
+                )
+            except BaseException as e:  # surfaced via fut.result()
+                fut.set_exception(e)
+
+        threading.Thread(
+            target=_run, name="odtp-outer-comm", daemon=True
+        ).start()
+        return fut
+
+    def _poll_pending(self, state: dict, *, block: bool) -> dict:
+        """Resolve an in-flight outer all-reduce if it completed (or wait
+        for it when ``block``); applies the (corrected) outer update as a
+        device delta."""
+        pending = self._pending
+        if pending is None:
+            return state
+        fut = pending["future"]
+        if not block and not fut.done():
+            return state
+        self._pending = None
+        avg, group_size = fut.result(
+            timeout=None if not block else self.cfg.averaging_timeout + 60
+        )
+        self._check_group_size(group_size)
+
+        master = [m.copy() for m in pending["master_snap"]]
+        opt = OuterSGD(
+            lr=self.cfg.outer_lr,
+            momentum=self.cfg.outer_momentum,
+            nesterov=self.cfg.outer_nesterov,
+        )
+        opt.load_state_dict(pending["opt_snap"])
+        opt.step(master, avg)
+        self.outer_opt = opt
+
+        if "est_master" in pending:  # eager: correct the estimated update
+            delta = [t - e for t, e in zip(master, pending["est_master"])]
+        else:  # delayed: the deferred boundary rewrite
+            delta = [t - b for t, b in zip(master, pending["boundary"])]
+        state = self._apply_delta_to_device(state, delta)
+        self.master = master
+        landed_s = time.monotonic() - pending["t_launch"]
+        # surface the landing in the next metrics row (dashboards would
+        # otherwise never see overlapped round size/latency)
+        self._landed_metrics = {
+            "outer_allreduce_s": landed_s,
+            "num_peers": group_size,
+        }
+        self.last_outer_metrics = dict(self._landed_metrics)
+        log.info(
+            "outer step %d (overlapped): all-reduce over %d peers landed "
+            "after %.3fs",
+            pending["epoch"],
+            group_size,
+            landed_s,
+        )
+        return state
+
+    def _check_group_size(self, group_size: int) -> None:
+        if group_size < self.max_num_peers:
+            msg = f"Lost a diloco worker: {group_size} < {self.max_num_peers}"
+            if self.cfg.fail_rank_drop:
+                raise PeerDropError(msg)
+            log.warning(msg)
+        self.max_num_peers = max(self.max_num_peers, group_size)
+
+    def drop_pending(self) -> None:
+        """Abandon an in-flight round (its result will never be applied).
+        A running reduce can't be cancelled; it is tracked so the next
+        launch drains it before reusing the round key."""
+        if self._pending is not None:
+            fut = self._pending["future"]
+            if not fut.cancel():
+                self._abandoned = fut
+            self._pending = None
+
+    def flush(self, state: dict) -> dict:
+        """Resolve any in-flight outer communication (call before
+        checkpointing or shutdown so the master reflects every launched
+        round)."""
+        return self._poll_pending(state, block=True)
+
+    def _apply_delta_to_device(self, state: dict, delta_flat: list) -> dict:
+        if self._apply_delta is None:
+            sh = self.trainer.state_shardings["params"]
+            self._apply_delta = jax.jit(
+                lambda p, d: jax.tree.map(lambda a, b: a + b, p, d),
+                donate_argnums=(0,),
+                in_shardings=(sh, sh),
+                out_shardings=sh,
+            )
+        delta = jax.device_put(
+            jax.tree.unflatten(self.treedef, delta_flat),
+            self.trainer.state_shardings["params"],
+        )
+        state = dict(state)
+        state["params"] = self._apply_delta(state["params"], delta)
+        return state
 
     # ------------------------------------------------------------------
     # outer step (reference: _update_global_epoch, hivemind_diloco.py:570-679)
     # ------------------------------------------------------------------
 
     def outer_step(self, state: dict) -> tuple[dict, dict]:
+        if self._pending is not None:  # a blocking round supersedes overlap
+            state = self._poll_pending(state, block=True)
         # parameter layout must be stable across the epoch (schema-hash
         # assertion, hivemind_diloco.py:560-568,575) -- a changed pytree
         # here means silent desync, not a recoverable condition
@@ -185,8 +436,6 @@ class DiLoCoOptimizer:
                 ]
             )
 
-        import threading
-
         fetcher = threading.Thread(target=_fetch)
         fetcher.start()
         wait_for_peers(
@@ -206,7 +455,7 @@ class DiLoCoOptimizer:
 
         t1 = time.monotonic()
         averaged, group_size = self.backend.all_reduce(
-            pseudo_grad, timeout=self.cfg.averaging_timeout
+            pseudo_grad, timeout=self.cfg.averaging_timeout, epoch=self.epoch
         )
         allreduce_s = time.monotonic() - t1
         log.info(
@@ -215,23 +464,14 @@ class DiLoCoOptimizer:
             group_size,
             allreduce_s,
         )
-
-        if group_size < self.max_num_peers:
-            msg = f"Lost a diloco worker: {group_size} < {self.max_num_peers}"
-            if self.cfg.fail_rank_drop:
-                raise PeerDropError(msg)
-            log.warning(msg)
-        self.max_num_peers = max(self.max_num_peers, group_size)
+        self._check_group_size(group_size)
 
         self.outer_opt.step(self.master, averaged)
 
         # optional periodic full state averaging (hivemind
         # average_state_every, hivemind_diloco.py:634-638): corrects any
         # drift the lossy pseudo-gradient compression accumulates
-        if (
-            self.cfg.average_state_every > 0
-            and (self.epoch + 1) % self.cfg.average_state_every == 0
-        ):
+        if self._is_state_avg_epoch():
             averaged_state, n = self.backend.all_reduce(
                 self.master, timeout=self.cfg.averaging_timeout, tag="state"
             )
@@ -265,6 +505,11 @@ class DiLoCoOptimizer:
     # ------------------------------------------------------------------
 
     def state_dict(self) -> dict:
+        if self._pending is not None:
+            log.warning(
+                "state_dict() with an outer round in flight; call "
+                "flush(state) first for a master that includes it"
+            )
         return {
             "master": [m.copy() for m in self.master],
             "outer_opt": self.outer_opt.state_dict(),

@@ -568,15 +568,16 @@ class TcpBackend(OuterBackend):
             out.append(self._own_progress)
         return out
 
-    def all_reduce(self, arrays, *, timeout=None, tag: str = "grads"):
+    def all_reduce(self, arrays, *, timeout=None, tag: str = "grads", epoch=None):
         """Rounds are keyed by (tag, own epoch) so all in-sync peers agree on
         the key without coordination; retries after a failed round re-join
         the same key (the rendezvous opens a fresh matchmaking window) and
         the group fingerprint keeps stale traffic out of the new round."""
         timeout = timeout or 300.0
         deadline = time.monotonic() + timeout
-        ep = self._own_progress.epoch if self._own_progress else 0
-        round_key = f"{tag}-epoch-{ep}"
+        if epoch is None:
+            epoch = self._own_progress.epoch if self._own_progress else 0
+        round_key = f"{tag}-epoch-{epoch}"
         last_err: Optional[Exception] = None
         for attempt in range(3):
             if time.monotonic() >= deadline:

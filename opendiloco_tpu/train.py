@@ -288,6 +288,10 @@ def train(config: Config, backend: Optional[OuterBackend] = None) -> dict:
             if config.ckpt.interval and real_step % config.ckpt.interval == 0:
                 flush(pending)
                 pending = None
+                if diloco_opt is not None:
+                    # land any in-flight overlapped outer round so the saved
+                    # master reflects every launched all-reduce
+                    state = diloco_opt.flush(state)
                 ckpt_lib.save_checkpoint(
                     config.ckpt.path,
                     real_step,
@@ -303,10 +307,17 @@ def train(config: Config, backend: Optional[OuterBackend] = None) -> dict:
         if pending is not None:
             flush(pending)
             pending = None
+        if diloco_opt is not None:
+            state = diloco_opt.flush(state)
     except PeerDropError:
         log.error("a DiLoCo worker dropped and fail_rank_drop is set; exiting")
         raise
     finally:
+        if diloco_opt is not None:
+            # abnormal exits must not leave an outer round holding the
+            # backend open (the comm thread is daemonized, but drop it so
+            # backend.close() below isn't racing a live reduce)
+            diloco_opt.drop_pending()
         if profiling:
             # a window extending past total_steps must still flush the trace;
             # never let a trace-serialization failure mask the real error or

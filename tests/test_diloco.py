@@ -498,3 +498,132 @@ def test_no_recompilation_across_outer_step(tiny_cfg):
     for ids, labels in data[2:]:
         state, _ = opt.step(state, trainer.shard_batch(ids, labels, accum=1))
     assert trainer._train_step._cache_size() == n_compiles == 1
+
+
+# ---------------------------------------------------------------------------
+# overlapped outer communication (arxiv 2502.12996)
+# ---------------------------------------------------------------------------
+
+
+def run_diloco_overlap(tiny_cfg, n_steps, mode, outer_lr=1.0, momentum=0.0,
+                       backend=None, world=None):
+    trainer = make_trainer(tiny_cfg)
+    state = trainer.init_state(jax.random.key(7))
+    if backend is None:
+        world = LoopbackWorld(1)
+        (backend,) = world.make_backends()
+    cfg = DilocoConfig(
+        outer_lr=outer_lr,
+        outer_momentum=momentum,
+        outer_nesterov=False,
+        local_steps=4,
+        backend="loopback",
+        overlap_comm=mode,
+    )
+    opt = DiLoCoOptimizer(trainer, backend, cfg, state, batch_size=8)
+    losses = []
+    for ids, labels in batches(0, tiny_cfg.vocab_size, n_steps):
+        batch = trainer.shard_batch(ids, labels, accum=1)
+        state, m = opt.step(state, batch)
+        losses.append(float(m["loss"]))
+    state = opt.flush(state)
+    return np.array(losses), jax.device_get(state["params"]), opt
+
+
+@pytest.mark.parametrize("mode", ["delayed", "eager"])
+def test_overlap_identity_equals_plain_training(tiny_cfg, mode):
+    """Single worker, outer_lr=1, momentum=0: the outer update is exactly
+    the boundary rewrite theta_b -> theta_b, so both overlap modes must
+    reproduce plain training bit-for-bit (the delta and the correction are
+    both exactly zero)."""
+    ref_losses, ref_params = run_plain(tiny_cfg, 8)
+    got_losses, got_params, opt = run_diloco_overlap(tiny_cfg, 8, mode)
+    assert opt.epoch == 2
+    np.testing.assert_allclose(got_losses, ref_losses, rtol=1e-5, atol=1e-6)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6),
+        got_params,
+        ref_params,
+    )
+
+
+@pytest.mark.parametrize("mode", ["delayed", "eager"])
+def test_overlap_two_workers_masters_converge(tiny_cfg, mode):
+    """Two overlapped workers end (after flush) with identical masters."""
+    world = LoopbackWorld(2)
+    backends = world.make_backends()
+    results = [None] * 2
+    errors = []
+
+    def worker(rank):
+        try:
+            trainer = make_trainer(tiny_cfg)
+            state = trainer.init_state(jax.random.key(7))
+            cfg = DilocoConfig(
+                local_steps=4,
+                outer_nesterov=True,
+                backend="loopback",
+                overlap_comm=mode,
+                timeout_waiting_for_peers=30.0,
+                averaging_timeout=60.0,
+            )
+            opt = DiLoCoOptimizer(trainer, backends[rank], cfg, state, batch_size=8)
+            for ids, labels in batches(1000 + rank, tiny_cfg.vocab_size, 8):
+                batch = trainer.shard_batch(ids, labels, accum=1)
+                state, m = opt.step(state, batch)
+            state = opt.flush(state)
+            results[rank] = [m.copy() for m in opt.master]
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(r,)) for r in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=300)
+    assert not errors, errors
+    assert all(r is not None for r in results)
+    for a, b in zip(results[0], results[1]):
+        np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-7)
+        assert np.all(np.isfinite(a))
+
+
+def test_overlap_inner_steps_continue_during_comm(tiny_cfg):
+    """With a slow all-reduce, the boundary step returns immediately and
+    inner training continues while communication is in flight."""
+    import time as _time
+
+    world = LoopbackWorld(1)
+    (backend,) = world.make_backends()
+    orig = backend.all_reduce
+
+    def slow_all_reduce(arrays, **kw):
+        _time.sleep(1.0)
+        return orig(arrays, **kw)
+
+    backend.all_reduce = slow_all_reduce
+    trainer = make_trainer(tiny_cfg)
+    state = trainer.init_state(jax.random.key(7))
+    cfg = DilocoConfig(
+        local_steps=2, backend="loopback", overlap_comm="delayed",
+        outer_lr=0.7, outer_momentum=0.9,
+    )
+    opt = DiLoCoOptimizer(trainer, backend, cfg, state, batch_size=8)
+    data = list(batches(2, tiny_cfg.vocab_size, 4))
+
+    for ids, labels in data[:2]:
+        state, m = opt.step(state, trainer.shard_batch(ids, labels, accum=1))
+    assert m.get("outer_overlapped") == 1
+    assert opt._pending is not None  # comm still in flight (1s sleep)
+    t0 = _time.monotonic()
+    state, _ = opt.step(state, trainer.shard_batch(*data[2], accum=1))
+    assert _time.monotonic() - t0 < 0.9  # did not block on the slow comm
+    state = opt.flush(state)
+    assert opt._pending is None
+    # the flushed master reflects the outer update (lr != 1 -> master moved)
+    ref = jax.device_get(trainer.init_state(jax.random.key(7))["params"])
+    moved = any(
+        not np.allclose(a, b)
+        for a, b in zip(opt.master, [np.asarray(x) for x in jax.tree.leaves(ref)])
+    )
+    assert moved
