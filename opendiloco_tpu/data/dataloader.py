@@ -297,9 +297,16 @@ def get_dataloader(
 ) -> DataLoader:
     """Reference-shaped factory (train_fsdp.py:132-168)."""
     if fake_data:
-        # a different seed stream acts as the held-out split
+        import jax
+
+        # a different seed stream acts as the held-out split; multihost
+        # processes must generate distinct shards of the global batch
         offset = 0 if split == "train" else 10_000_019
-        ds = FakeTokenizedDataset(seq_length, vocab_size, seed=seed + world_rank + offset)
+        ds = FakeTokenizedDataset(
+            seq_length,
+            vocab_size,
+            seed=seed + world_rank + offset + 100_003 * jax.process_index(),
+        )
     elif streaming:
         import jax
 

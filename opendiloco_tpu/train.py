@@ -115,12 +115,33 @@ def train(config: Config, backend: Optional[OuterBackend] = None) -> dict:
             f"per_device_train_batch_size*dp = {global_micro}"
         )
 
+    # under multihost every process loads only its shard of the global batch
+    # (the data is already split by process_index; shard_batch assembles the
+    # global array from per-process rows)
+    nproc = jax.process_count()
+    if config.total_batch_size % nproc:
+        raise ValueError(
+            f"total_batch_size {config.total_batch_size} not divisible by "
+            f"process_count {nproc}"
+        )
+    local_batch_size = config.total_batch_size // nproc
+    if local_batch_size % accum:
+        raise ValueError(
+            f"per-process batch {local_batch_size} not divisible by the "
+            f"accumulation factor {accum} (= total_batch_size / "
+            f"(per_device_train_batch_size * dp)); adjust batch sizes"
+        )
+    if config.eval_interval and (global_micro % nproc):
+        raise ValueError(
+            f"eval batch per_device_train_batch_size*dp = {global_micro} "
+            f"not divisible by process_count {nproc}"
+        )
     loader = get_dataloader(
         fake_data=config.fake_data,
         dataset_name_or_paths=config.dataset_name_or_paths,
         tokenizer_name=config.tokenizer_name,
         seq_length=config.seq_length,
-        batch_size=config.total_batch_size,
+        batch_size=local_batch_size,
         vocab_size=model_cfg.vocab_size,
         world_rank=world_rank,
         galaxy_size=config.diloco.galaxy_size if config.diloco else 1,
@@ -176,7 +197,7 @@ def train(config: Config, backend: Optional[OuterBackend] = None) -> dict:
             dataset_name_or_paths=config.dataset_name_or_paths,
             tokenizer_name=config.tokenizer_name,
             seq_length=config.seq_length,
-            batch_size=config.per_device_train_batch_size * dp,
+            batch_size=global_micro // nproc,
             vocab_size=model_cfg.vocab_size,
             world_rank=world_rank,
             galaxy_size=config.diloco.galaxy_size if config.diloco else 1,

@@ -333,15 +333,29 @@ class InnerTrainer:
 
     # -- host API ---------------------------------------------------------
 
+    def _to_global(self, a, sharding, batch_axis: int):
+        """Host array -> global device array. Single-process: the array IS
+        the global batch. Multihost: each process passes its LOCAL rows
+        (the dataloader shards by process) and the global array is
+        assembled from per-process shards."""
+        if jax.process_count() == 1:
+            return jax.device_put(a, sharding)
+        global_shape = list(a.shape)
+        global_shape[batch_axis] *= jax.process_count()
+        return jax.make_array_from_process_local_data(
+            sharding, a, tuple(global_shape)
+        )
+
     def shard_batch(self, input_ids: np.ndarray, labels: np.ndarray, accum: int) -> dict:
-        """[global_bs, T] host arrays -> [accum, mb, T] device arrays."""
+        """[local_bs, T] host arrays -> [accum, mb, T] device arrays
+        (local_bs = global batch / process_count under multihost)."""
         gbs, seq = input_ids.shape
         assert gbs % accum == 0, (gbs, accum)
         shaped = lambda a: a.reshape(accum, gbs // accum, seq)
         sharding = self.plan.sharding(self.plan.batch_spec(3, accum=True))
         return {
-            "input_ids": jax.device_put(shaped(input_ids), sharding),
-            "labels": jax.device_put(shaped(labels), sharding),
+            "input_ids": self._to_global(shaped(input_ids), sharding, 1),
+            "labels": self._to_global(shaped(labels), sharding, 1),
         }
 
     def train_step(self, state: dict, batch: dict):
@@ -350,16 +364,16 @@ class InnerTrainer:
     def eval_loss(self, params: dict, input_ids: np.ndarray, labels: np.ndarray) -> float:
         sharding = self.plan.sharding(self.plan.batch_spec(2))
         batch = {
-            "input_ids": jax.device_put(input_ids, sharding),
-            "labels": jax.device_put(labels, sharding),
+            "input_ids": self._to_global(input_ids, sharding, 0),
+            "labels": self._to_global(labels, sharding, 0),
         }
         return float(self._eval_step(params, batch))
 
     def probe_norms(self, params: dict, input_ids: np.ndarray) -> dict:
         sharding = self.plan.sharding(self.plan.batch_spec(2))
         batch = {
-            "input_ids": jax.device_put(input_ids, sharding),
-            "labels": jax.device_put(np.zeros_like(input_ids), sharding),
+            "input_ids": self._to_global(input_ids, sharding, 0),
+            "labels": self._to_global(np.zeros_like(input_ids), sharding, 0),
         }
         aux = jax.device_get(self._probe_step(params, batch))
         out = {
