@@ -85,11 +85,17 @@ class RendezvousServer:
     def _thread_main(self) -> None:
         asyncio.run(self._serve_forever())
 
-    async def _serve_forever(self) -> None:
+    async def _serve_forever(self, announce: bool = False) -> None:
         self._loop = asyncio.get_running_loop()
         self._server = await asyncio.start_server(self._handle, self.host, self.port, limit=STREAM_LIMIT)
         self.port = self._server.sockets[0].getsockname()[1]
         log.info("rendezvous %s listening on %s:%d", self.identity, self.host, self.port)
+        if announce:
+            # the BOUND port (with --port 0 the requested one is useless)
+            print(
+                f"rendezvous daemon: initial_peers = {self.host}:{self.port}",
+                flush=True,
+            )
         self._started.set()
         async with self._server:
             try:
@@ -249,8 +255,7 @@ def main(argv: Optional[list[str]] = None) -> None:
                 f.write(identity)
 
     server = RendezvousServer(args.host, args.port, identity)
-    print(f"rendezvous daemon: initial_peers = {args.host}:{args.port}", flush=True)
-    asyncio.run(server._serve_forever())
+    asyncio.run(server._serve_forever(announce=True))
 
 
 if __name__ == "__main__":
