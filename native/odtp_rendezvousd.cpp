@@ -153,8 +153,9 @@ struct Peer {
 
 struct Round {
     double deadline = 0;
+    int cap = 0;  // 0 = one global group; k = partition into groups <= k
     std::set<std::string> joiners;
-    std::vector<int> waiter_fds;
+    std::vector<std::pair<int, std::string>> waiters;  // (fd, peer_id)
 };
 
 struct Conn {
@@ -204,12 +205,7 @@ void queue_reply(int fd, const std::string& type, const std::string& meta) {
     g_conns[fd].outbuf += frame(type, meta);
 }
 
-void close_round(const std::string& key) {
-    auto it = g_rounds.find(key);
-    if (it == g_rounds.end()) return;
-    // group = sorted joiner infos
-    std::vector<std::string> ids(it->second.joiners.begin(), it->second.joiners.end());
-    std::sort(ids.begin(), ids.end());
+std::string group_json(const std::vector<std::string>& ids) {
     std::string group = "[";
     bool first = true;
     for (auto& id : ids) {
@@ -219,12 +215,42 @@ void close_round(const std::string& key) {
         group += p->second.to_json();
         first = false;
     }
-    group += "]";
-    for (int fd : it->second.waiter_fds) {
+    return group + "]";
+}
+
+void close_round(const std::string& key) {
+    auto it = g_rounds.find(key);
+    if (it == g_rounds.end()) return;
+    Round& rnd = it->second;
+    std::vector<std::string> ids(rnd.joiners.begin(), rnd.joiners.end());
+    std::sort(ids.begin(), ids.end());
+
+    // peer_id -> that peer's group JSON (global group, or its <=cap chunk)
+    std::map<std::string, std::string> per_peer;
+    if (rnd.cap > 0) {
+        // deterministic per-round shuffle so pairings vary epoch to epoch
+        std::seed_seq seed(key.begin(), key.end());
+        std::mt19937 rng(seed);
+        std::shuffle(ids.begin(), ids.end(), rng);
+        for (size_t i = 0; i < ids.size(); i += (size_t)rnd.cap) {
+            size_t hi = std::min(ids.size(), i + (size_t)rnd.cap);
+            std::vector<std::string> chunk(ids.begin() + i, ids.begin() + hi);
+            std::sort(chunk.begin(), chunk.end());
+            std::string gj = group_json(chunk);
+            for (auto& id : chunk) per_peer[id] = gj;
+        }
+    } else {
+        std::string gj = group_json(ids);
+        for (auto& id : ids) per_peer[id] = gj;
+    }
+
+    for (auto& [fd, pid] : rnd.waiters) {
         auto c = g_conns.find(fd);
         if (c != g_conns.end()) {
             c->second.waiting_round = false;
-            c->second.outbuf += frame("ok", "{\"group\":" + group + "}");
+            auto g = per_peer.find(pid);
+            std::string gj = g != per_peer.end() ? g->second : "[]";
+            c->second.outbuf += frame("ok", "{\"group\":" + gj + "}");
         }
     }
     g_rounds.erase(it);
@@ -301,9 +327,14 @@ void handle(int fd, const std::string& header) {
         if (pit != g_peers.end()) pit->second.last_seen = now_s();
 
         auto& rnd = g_rounds[key];  // creates on first join
-        if (rnd.deadline == 0) rnd.deadline = now_s() + window;
+        if (rnd.deadline == 0) {
+            rnd.deadline = now_s() + window;
+            double cap = 0;
+            get_number(meta, "group_cap", &cap);
+            rnd.cap = (int)cap;
+        }
         if (g_peers.count(id)) rnd.joiners.insert(id);
-        rnd.waiter_fds.push_back(fd);
+        rnd.waiters.emplace_back(fd, id);
         g_conns[fd].waiting_round = true;
 
         expire_peers();
@@ -442,9 +473,11 @@ int main(int argc, char** argv) {
         for (int fd : to_close) {
             // a parked waiter that hung up leaves its round
             for (auto& [k, r] : g_rounds) {
-                r.waiter_fds.erase(
-                    std::remove(r.waiter_fds.begin(), r.waiter_fds.end(), fd),
-                    r.waiter_fds.end());
+                r.waiters.erase(
+                    std::remove_if(
+                        r.waiters.begin(), r.waiters.end(),
+                        [fd](const auto& w) { return w.first == fd; }),
+                    r.waiters.end());
             }
             g_conns.erase(fd);
             close(fd);

@@ -11,7 +11,7 @@ from __future__ import annotations
 import sys
 from typing import Any, Literal, Optional
 
-from pydantic import BaseModel, field_validator
+from pydantic import BaseModel, field_validator, model_validator
 from pydantic import ConfigDict
 
 
@@ -77,6 +77,14 @@ class DilocoConfig(BaseModel):
     # optional periodic full state averaging (hivemind_diloco.py:634-638)
     average_state_every: int = 0  # 0 = never
 
+    # outer averaging topology:
+    #   "allreduce" - every epoch averages over the whole galaxy (reference)
+    #   "gossip"    - NoLoCo-style (arxiv 2506.10911): each worker averages
+    #                 (master, pseudo_grad) with ONE partner per epoch; the
+    #                 rendezvous re-pairs every round, so disagreement mixes
+    #                 away over rounds with no global synchronization point
+    outer_mode: Literal["allreduce", "gossip"] = "allreduce"
+
     # overlap the outer all-reduce with the next inner epoch (Eager Updates
     # for Overlapped Communication in DiLoCo, arxiv 2502.12996):
     #   "none"    - blocking outer step (reference semantics)
@@ -85,6 +93,26 @@ class DilocoConfig(BaseModel):
     #   "eager"   - additionally applies the update estimated from the LOCAL
     #               pseudo-gradient immediately, corrected on arrival
     overlap_comm: Literal["none", "delayed", "eager"] = "none"
+
+    @model_validator(mode="after")
+    def _gossip_constraints(self):
+        if self.outer_mode == "gossip" and self.overlap_comm != "none":
+            raise ValueError(
+                "outer_mode='gossip' does not compose with overlap_comm yet; "
+                "gossip rounds already avoid the global synchronization stall"
+            )
+        if self.outer_mode == "gossip" and self.compression not in (
+            "none",
+            "fp16",
+            "scaled-fp16",
+        ):
+            raise ValueError(
+                "outer_mode='gossip' sends the master weights over the wire "
+                "every epoch; 8-bit codecs are tuned for pseudo-gradient "
+                "magnitudes and would accumulate unbounded master error -- "
+                "use none/fp16/scaled-fp16"
+            )
+        return self
 
     @field_validator("initial_peers", mode="before")
     @classmethod

@@ -62,6 +62,7 @@ class OuterBackend(abc.ABC):
         timeout: Optional[float] = None,
         tag: str = "grads",
         epoch: Optional[int] = None,
+        group_cap: int = 0,
     ) -> tuple[list[np.ndarray], int]:
         """Average the arrays across the group; returns (averaged, group_size).
 
@@ -69,8 +70,9 @@ class OuterBackend(abc.ABC):
         timeout/failure. ``tag`` namespaces concurrent round types (gradient
         vs state averaging). ``epoch`` pins the round key explicitly (pass it
         when calling from a background thread -- reading the gossiped own
-        progress there races with the training thread advancing it). Wire
-        compression is a backend concern.
+        progress there races with the training thread advancing it).
+        ``group_cap`` > 0 partitions joiners into groups of at most that
+        size (gossip mode). Wire compression is a backend concern.
         """
 
     @abc.abstractmethod

@@ -45,6 +45,8 @@ class LoopbackWorld:
         self._result: Optional[list[np.ndarray]] = None
         self._result_group = 0
         self._result_round = -1
+        # gossip round state: round_key -> {"_partition": [...], chunk: {...}}
+        self._gossip: dict = {}
 
     def make_backends(self) -> list["LoopbackBackend"]:
         return [LoopbackBackend(self, f"peer-{i}") for i in range(self.n_peers)]
@@ -65,11 +67,14 @@ class LoopbackBackend(OuterBackend):
         with self.world.lock:
             return len(self.world.live)
 
-    def all_reduce(self, arrays, *, timeout=None, tag="grads", epoch=None):
+    def all_reduce(self, arrays, *, timeout=None, tag="grads", epoch=None, group_cap=0):
         """Average across live peers. The round completes when every live
         peer has contributed; dropped peers stop blocking the group the
         moment they close(). Lossy codecs are applied to each contribution
-        to model wire compression faithfully."""
+        to model wire compression faithfully. ``group_cap`` partitions the
+        live peers into deterministic per-round groups (gossip mode)."""
+        if group_cap:
+            return self._group_reduce(arrays, tag, epoch, group_cap, timeout)
         w = self.world
         codec = w.codec
         compressed = [
@@ -106,6 +111,62 @@ class LoopbackBackend(OuterBackend):
             result = [a.copy() for a in w._result]
             group = w._result_group
         return result, group
+
+    def _group_reduce(self, arrays, tag, epoch, cap, timeout):
+        """Partition live peers into per-round groups of <= cap and average
+        within the group only (mirrors the rendezvous daemon's capped
+        matchmaking). The FIRST arriver freezes the partition for the round
+        so later joiners and membership churn can't split the groups."""
+        import random
+
+        w = self.world
+        codec = w.codec
+        key = f"{tag}-epoch-{epoch}"
+        compressed = [codec.decode(*_enc(codec, a)) for a in arrays]
+        deadline = time.monotonic() + (timeout or 3600.0)
+        with w.cond:
+            round_state = w._gossip.setdefault(key, {})
+            if "_partition" not in round_state:
+                members = sorted(w.live)
+                random.Random(key).shuffle(members)
+                round_state["_partition"] = [
+                    tuple(sorted(members[i : i + cap]))
+                    for i in range(0, len(members), cap)
+                ]
+            group = next(
+                (g for g in round_state["_partition"] if self._peer_id in g), None
+            )
+            if group is None:
+                # the partition was frozen before we were live: behave like
+                # the TCP client's "group does not contain self" retry path
+                raise AllReduceError(f"{self._peer_id}: not in gossip partition")
+            slot = round_state.setdefault(group, {"contrib": {}, "done": set()})
+            slot["contrib"][self._peer_id] = compressed
+            w.cond.notify_all()
+            while True:
+                live_members = [
+                    m for m in group if m in w.live or m in slot["contrib"]
+                ]
+                if set(slot["contrib"]) >= set(live_members):
+                    contribs = [slot["contrib"][m] for m in live_members]
+                    n = len(contribs)
+                    result = [
+                        np.sum([c[i] for c in contribs], axis=0) / n
+                        for i in range(len(arrays))
+                    ]
+                    slot["done"].add(self._peer_id)
+                    if slot["done"] >= set(live_members):
+                        round_state.pop(group, None)
+                        if not any(
+                            isinstance(k, tuple) for k in round_state
+                        ):
+                            w._gossip.pop(key, None)
+                    return [a.copy() for a in result], n
+                if time.monotonic() >= deadline:
+                    slot["contrib"].pop(self._peer_id, None)
+                    w.cond.notify_all()
+                    raise AllReduceError(f"{self._peer_id}: gossip round timed out")
+                w.cond.wait(timeout=0.1)
 
     def report_progress(self, progress: PeerProgress) -> None:
         with self.world.lock:

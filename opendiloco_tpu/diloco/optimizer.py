@@ -454,17 +454,37 @@ class DiLoCoOptimizer:
         pseudo_grad = [native.sub(m, d) for m, d in zip(self.master, device_flat)]
 
         t1 = time.monotonic()
-        averaged, group_size = self.backend.all_reduce(
-            pseudo_grad, timeout=self.cfg.averaging_timeout, epoch=self.epoch
-        )
+        if self.cfg.outer_mode == "gossip":
+            # NoLoCo-style (arxiv 2506.10911): average (master, pseudo_grad)
+            # with ONE re-paired partner per epoch -- state mixing keeps the
+            # per-worker masters from drifting apart while no round ever
+            # waits on the whole galaxy
+            k = len(self.master)
+            avg, group_size = self.backend.all_reduce(
+                self.master + pseudo_grad,
+                timeout=self.cfg.averaging_timeout,
+                tag="gossip",
+                epoch=self.epoch,
+                group_cap=2,
+            )
+            self.master = [np.asarray(a, np.float32).copy() for a in avg[:k]]
+            averaged = avg[k:]
+            # pair size says nothing about the swarm: peer-drop detection
+            # (incl. fail_rank_drop) runs on the live-peer count instead
+            self._check_group_size(self.backend.num_peers())
+        else:
+            averaged, group_size = self.backend.all_reduce(
+                pseudo_grad, timeout=self.cfg.averaging_timeout, epoch=self.epoch
+            )
+            self._check_group_size(group_size)
         allreduce_s = time.monotonic() - t1
         log.info(
-            "outer step %d: all-reduce over %d peers took %.3fs",
+            "outer step %d: %s over %d peers took %.3fs",
             self.epoch,
+            "gossip exchange" if self.cfg.outer_mode == "gossip" else "all-reduce",
             group_size,
             allreduce_s,
         )
-        self._check_group_size(group_size)
 
         self.outer_opt.step(self.master, averaged)
 

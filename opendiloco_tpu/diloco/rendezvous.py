@@ -50,14 +50,21 @@ class PeerInfo:
 class _GroupRound:
     """Matchmaking window for one (epoch) all-reduce round."""
 
-    def __init__(self, key: str, window: float):
+    def __init__(self, key: str, window: float, cap: int = 0):
         self.key = key
         self.window = window
+        self.cap = cap  # 0 = one global group; k = partition into groups <= k
         self.joiners: dict[str, PeerInfo] = {}
         self.event = asyncio.Event()
         self.opened = time.monotonic()
         self.closed = False
         self.group: list[dict] = []
+        self.groups: dict[str, list[dict]] = {}  # per-peer when capped
+
+    def group_for(self, peer_id: str) -> list[dict]:
+        if self.cap:
+            return self.groups.get(peer_id, [])
+        return self.group
 
 
 class RendezvousServer:
@@ -205,7 +212,7 @@ class RendezvousServer:
 
         rnd = self.rounds.get(key)
         if rnd is None or rnd.closed:
-            rnd = _GroupRound(key, window)
+            rnd = _GroupRound(key, window, cap=int(meta.get("group_cap") or 0))
             self.rounds[key] = rnd
             asyncio.create_task(self._close_round_later(rnd))
         if pid in self.peers:
@@ -214,7 +221,7 @@ class RendezvousServer:
             self._close_round(rnd)
 
         await rnd.event.wait()
-        await send_frame(writer, "ok", {"group": rnd.group})
+        await send_frame(writer, "ok", {"group": rnd.group_for(pid)})
 
     async def _close_round_later(self, rnd: _GroupRound) -> None:
         await asyncio.sleep(rnd.window)
@@ -226,6 +233,19 @@ class RendezvousServer:
         rnd.group = sorted(
             (p.to_json() for p in rnd.joiners.values()), key=lambda p: p["peer_id"]
         )
+        if rnd.cap:
+            # partition into groups of <= cap; the shuffle is seeded by the
+            # round key so pairings vary epoch to epoch (gossip mixing)
+            import random
+
+            order = list(rnd.group)
+            random.Random(rnd.key).shuffle(order)
+            for i in range(0, len(order), rnd.cap):
+                chunk = sorted(
+                    order[i : i + rnd.cap], key=lambda p: p["peer_id"]
+                )
+                for p in chunk:
+                    rnd.groups[p["peer_id"]] = chunk
         self.rounds.pop(rnd.key, None)
         rnd.event.set()
 

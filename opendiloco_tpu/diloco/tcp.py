@@ -568,11 +568,15 @@ class TcpBackend(OuterBackend):
             out.append(self._own_progress)
         return out
 
-    def all_reduce(self, arrays, *, timeout=None, tag: str = "grads", epoch=None):
+    def all_reduce(
+        self, arrays, *, timeout=None, tag: str = "grads", epoch=None, group_cap=0
+    ):
         """Rounds are keyed by (tag, own epoch) so all in-sync peers agree on
         the key without coordination; retries after a failed round re-join
         the same key (the rendezvous opens a fresh matchmaking window) and
-        the group fingerprint keeps stale traffic out of the new round."""
+        the group fingerprint keeps stale traffic out of the new round.
+        ``group_cap`` > 0 asks the rendezvous to partition joiners into
+        groups of at most that size (gossip mode)."""
         timeout = timeout or 300.0
         deadline = time.monotonic() + timeout
         if epoch is None:
@@ -584,7 +588,9 @@ class TcpBackend(OuterBackend):
                 break
             try:
                 return self._run(
-                    self._all_reduce_round(arrays, round_key, deadline),
+                    self._all_reduce_round(
+                        arrays, round_key, deadline, group_cap=group_cap
+                    ),
                     timeout=max(1.0, deadline - time.monotonic()) + 10,
                 )
             except (asyncio.TimeoutError, AllReduceError, OSError) as e:
@@ -596,7 +602,9 @@ class TcpBackend(OuterBackend):
                 )
         raise AllReduceError(f"all-reduce failed: {last_err}")
 
-    async def _all_reduce_round(self, arrays: list[np.ndarray], join_key: str, deadline: float):
+    async def _all_reduce_round(
+        self, arrays: list[np.ndarray], join_key: str, deadline: float, group_cap=0
+    ):
         timings: dict[str, float] = {}
         t_mm = time.monotonic()
         # 1. matchmake
@@ -606,20 +614,23 @@ class TcpBackend(OuterBackend):
                 "peer_id": self._peer_id,
                 "round": join_key,
                 "matchmaking_time": self.matchmaking_time,
+                "group_cap": group_cap,
             },
             timeout=max(self.matchmaking_time * 4, self.rpc_timeout),
         )
         group = meta["group"]
         n = len(group)
-        if n <= 1:
-            return [a.copy() for a in arrays], 1
         my_idx = next(
             (i for i, p in enumerate(group) if p["peer_id"] == self._peer_id), None
         )
         if my_idx is None:
-            # stale registry excluded us (e.g. TTL expiry); re-announce and retry
+            # stale registry excluded us (e.g. TTL expiry) -- this includes
+            # an EMPTY group, which must NOT pass as a solo round: that
+            # would silently desync the master. Re-announce and retry.
             self._push_progress()
             raise AllReduceError(f"matchmade group {group} does not contain self")
+        if n == 1:
+            return [a.copy() for a in arrays], 1
         # fingerprint the membership: retried rounds (same join_key) must not
         # consume stale mailbox traffic from a differently-shaped group
         fp = hashlib.sha1(

@@ -5,10 +5,12 @@ OUT=${1:-/tmp/bench_on_recovery.json}
 while true; do
   if timeout 90 python -c "import jax; print(float(jax.numpy.ones((2,2)).sum()))" >/dev/null 2>&1; then
     echo "$(date -u +%FT%TZ) tunnel alive; running bench" >> "$OUT.log"
-    timeout 600 python bench.py >> "$OUT" 2>>"$OUT.log"
+    timeout 600 python bench.py > "$OUT.cur" 2>>"$OUT.log"
     RC=$?
+    cat "$OUT.cur" >> "$OUT"
     echo "$(date -u +%FT%TZ) bench rc=$RC" >> "$OUT.log"
-    if [ $RC -ne 0 ] || ! grep -q '"value": [1-9]' "$OUT"; then
+    # judge THIS run's output only (the aggregate file keeps history)
+    if [ $RC -ne 0 ] || ! grep -q '"value": [1-9]' "$OUT.cur"; then
       sleep 120  # flaky remote compile / transient outage: keep trying
       continue
     fi

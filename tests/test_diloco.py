@@ -627,3 +627,74 @@ def test_overlap_inner_steps_continue_during_comm(tiny_cfg):
         for a, b in zip(opt.master, [np.asarray(x) for x in jax.tree.leaves(ref)])
     )
     assert moved
+
+
+# ---------------------------------------------------------------------------
+# gossip outer mode (NoLoCo-style, arxiv 2506.10911)
+# ---------------------------------------------------------------------------
+
+
+def run_gossip_workers(tiny_cfg, n_workers, n_steps, local_steps=4):
+    world = LoopbackWorld(n_workers)
+    backends = world.make_backends()
+    results = [None] * n_workers
+    errors = []
+
+    def worker(rank):
+        try:
+            trainer = make_trainer(tiny_cfg)
+            state = trainer.init_state(jax.random.key(7))
+            cfg = DilocoConfig(
+                local_steps=local_steps,
+                outer_nesterov=True,
+                backend="loopback",
+                outer_mode="gossip",
+                timeout_waiting_for_peers=30.0,
+                averaging_timeout=60.0,
+            )
+            opt = DiLoCoOptimizer(trainer, backends[rank], cfg, state, batch_size=8)
+            for ids, labels in batches(1000 + rank, tiny_cfg.vocab_size, n_steps):
+                batch = trainer.shard_batch(ids, labels, accum=1)
+                state, m = opt.step(state, batch)
+                assert np.isfinite(float(m["loss"]))
+            results[rank] = ([mm.copy() for mm in opt.master], opt)
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(r,)) for r in range(n_workers)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=300)
+    assert not errors, errors
+    assert all(r is not None for r in results)
+    return results
+
+
+def test_gossip_two_workers_pair_is_full_sync(tiny_cfg):
+    """With exactly two workers, each epoch's pair IS the whole swarm, so
+    gossip keeps the masters identical across workers (state mixing)."""
+    results = run_gossip_workers(tiny_cfg, 2, n_steps=8)
+    (m0, opt0), (m1, opt1) = results
+    assert opt0.epoch == opt1.epoch == 2
+    for a, b in zip(m0, m1):
+        np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-7)
+
+
+def test_gossip_four_workers_mix_and_learn(tiny_cfg):
+    """Four workers, pairwise rounds only: everyone finishes, every round
+    is a pair (never a global barrier), and state mixing keeps masters
+    finite and in the same neighborhood."""
+    results = run_gossip_workers(tiny_cfg, 4, n_steps=8)
+    masters = [m for m, _ in results]
+    for m, opt in results:
+        assert opt.epoch == 2
+        assert opt.last_outer_metrics["num_peers"] <= 2  # pair rounds only
+        assert all(np.all(np.isfinite(x)) for x in m)
+    # mixing bound: max pairwise master distance is small relative to scale
+    flat = [np.concatenate([x.ravel() for x in m]) for m in masters]
+    scale = max(np.abs(f).max() for f in flat)
+    spread = max(
+        np.abs(a - b).max() for i, a in enumerate(flat) for b in flat[i + 1:]
+    )
+    assert spread < 0.5 * scale

@@ -294,3 +294,40 @@ def test_bulk_plane_disabled_falls_back_to_rpc(monkeypatch):
         for b in backends:
             b.close()
         server.stop()
+
+
+def test_group_cap_partitions_into_pairs(rendezvous):
+    """group_cap=2 matchmaking: four peers form two disjoint pairs (both
+    daemon implementations), and each pair averages only its own inputs."""
+    backends = make_backends(rendezvous, 4, matchmaking_time=2.0)
+    try:
+        data = [[np.full(16, float(i + 1), np.float32)] for i in range(4)]
+        results = [None] * 4
+        errors = []
+
+        def run(i):
+            try:
+                results[i] = backends[i].all_reduce(
+                    data[i][:], timeout=60.0, epoch=0, group_cap=2
+                )
+            except Exception as e:
+                errors.append((i, e))
+
+        threads = [threading.Thread(target=run, args=(i,)) for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=90)
+        assert not errors, errors
+        partners = {}
+        for i, (out, group) in enumerate(results):
+            assert group == 2
+            # reconstruct the partner from the pair mean
+            partner_val = out[0][0] * 2 - (i + 1)
+            partners[i + 1] = round(float(partner_val))
+        # pairing is symmetric and covers everyone exactly once
+        assert all(partners[partners[v]] == v for v in partners)
+        assert sorted(partners) == [1, 2, 3, 4]
+    finally:
+        for b in backends:
+            b.close()
