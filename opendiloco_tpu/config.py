@@ -167,6 +167,7 @@ class Config(BaseModel):
     fsdp_size: Optional[int] = None
     tp_size: int = 1
     sp_size: int = 1  # sequence/context parallel (ring attention)
+    pp_size: int = 1  # pipeline stages (GPipe schedule over the layer stack)
 
     # observability
     project: str = "opendiloco_tpu"

@@ -193,6 +193,9 @@ def forward(
     return_hidden: bool = False,
     ring_mesh=None,
     ring_axis: str = "sp",
+    pp_mesh=None,
+    pp_axis: str = "pp",
+    pp_microbatches: Optional[int] = None,
 ):
     """input_ids [B, T] int32 -> logits [B, T, V] float32.
 
@@ -225,10 +228,28 @@ def forward(
 
     h = jnp.take(cparams["embed_tokens"], input_ids, axis=0)
 
-    block = lambda h, layer: _decoder_block(cfg, attn_fn, h, layer, positions)
-    if remat:
-        block = jax.checkpoint(block)
-    h, attn_norms = jax.lax.scan(block, h, cparams["layers"])
+    if pp_mesh is not None:
+        # decoder stack staged over the pp mesh axis (parallel/pipeline.py);
+        # activation probes are not threaded through the pipeline
+        from opendiloco_tpu.parallel.pipeline import pipeline_hidden
+
+        h = pipeline_hidden(
+            cparams,
+            h,
+            positions,
+            cfg,
+            pp_mesh,
+            microbatches=pp_microbatches or pp_mesh.shape[pp_axis],
+            attn_impl=attn_impl,
+            remat=remat,
+            axis=pp_axis,
+        )
+        attn_norms = jnp.zeros((cfg.num_hidden_layers,), jnp.float32)
+    else:
+        block = lambda h, layer: _decoder_block(cfg, attn_fn, h, layer, positions)
+        if remat:
+            block = jax.checkpoint(block)
+        h, attn_norms = jax.lax.scan(block, h, cparams["layers"])
 
     h = _rms_norm(h, cparams["final_norm"], cfg.rms_norm_eps)
     head = (

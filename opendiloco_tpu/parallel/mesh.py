@@ -50,6 +50,7 @@ class MeshPlan:
     fsdp_axis: Optional[str]  # axis params/opt-state shard over (or None)
     tp_axis: Optional[str]
     sp_axis: Optional[str]
+    pp_axis: Optional[str] = None  # pipeline stages (stacked-layer dim)
 
     @property
     def data_parallel_size(self) -> int:
@@ -74,20 +75,27 @@ def build_mesh(
     fsdp_size: Optional[int] = None,
     tp_size: int = 1,
     sp_size: int = 1,
+    pp_size: int = 1,
 ) -> MeshPlan:
-    """Build the (dp, fsdp, sp, tp) mesh for a sharding strategy.
+    """Build the (pp, dp, fsdp, sp, tp) mesh for a sharding strategy.
 
     With hybrid strategies the dp axis is the slow/outer (DCN) dimension and
     fsdp the fast/inner (ICI) dimension, matching the reference's
-    ("global", "local") mesh order (train_fsdp.py:230-237).
+    ("global", "local") mesh order (train_fsdp.py:230-237). pp (pipeline
+    stages) is the outermost axis: stage hand-offs are point-to-point and
+    tolerate the slowest links.
     """
     if strategy not in SHARDING_STRATEGIES:
         raise ValueError(f"unknown sharding strategy {strategy!r}")
     if devices is None:
         devices = jax.devices()
     n = len(devices)
-    if n % (tp_size * sp_size) != 0:
-        raise ValueError(f"{n} devices not divisible by tp*sp={tp_size * sp_size}")
+    if n % (tp_size * sp_size * pp_size) != 0:
+        raise ValueError(
+            f"{n} devices not divisible by tp*sp*pp="
+            f"{tp_size * sp_size * pp_size}"
+        )
+    n = n // pp_size
     n_data = n // (tp_size * sp_size)
 
     hybrid = strategy in ("HYBRID_SHARD", "HYBRID_SHARD_ZERO2")
@@ -108,8 +116,10 @@ def build_mesh(
             f"mesh {dp_size}x{fsdp_size}x{sp_size}x{tp_size} != {n} devices"
         )
 
-    dev_array = np.asarray(devices).reshape(dp_size, fsdp_size, sp_size, tp_size)
-    mesh = Mesh(dev_array, ("dp", "fsdp", "sp", "tp"))
+    dev_array = np.asarray(devices).reshape(
+        pp_size, dp_size, fsdp_size, sp_size, tp_size
+    )
+    mesh = Mesh(dev_array, ("pp", "dp", "fsdp", "sp", "tp"))
 
     # ZeRO-2/3 are still data-parallel: the batch splits over dp AND fsdp.
     batch_axes = ("dp", "fsdp")
@@ -120,6 +130,7 @@ def build_mesh(
         fsdp_axis="fsdp" if strategy in _PARAM_SHARDED | _OPTSTATE_SHARDED else None,
         tp_axis="tp" if tp_size > 1 else None,
         sp_axis="sp" if sp_size > 1 else None,
+        pp_axis="pp" if pp_size > 1 else None,
     )
 
 

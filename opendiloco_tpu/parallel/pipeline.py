@@ -1,0 +1,121 @@
+"""Pipeline parallelism over the "pp" mesh axis (GPipe schedule).
+
+The reference has no pipeline parallelism (SURVEY §2.4: "No"); this is a
+beyond-parity axis for models whose layer stack outgrows one chip group.
+TPU-native formulation: the scan-over-layers parameter stack [L, ...] is
+sharded over "pp" so each stage owns L/pp contiguous layers, and a
+shard_map runs the classic fill-drain schedule -- at tick t stage r
+processes microbatch (t - r), then hands its activation to stage r+1 via
+``jax.lax.ppermute``. The whole schedule is a ``lax.scan`` inside jit, so
+the backward pass is the reverse pipeline by autodiff (ppermute transposes
+to the reverse permutation; no hand-written VJP needed).
+
+Embedding, final norm, and the lm head stay OUTSIDE the pipeline region
+(they are replicated over pp and cheap); only the decoder stack is staged.
+The final hidden states are reassembled on the last stage and replicated
+with a masked psum.
+
+Memory is GPipe-shaped: all in-flight microbatch activations live until
+their backward tick; per-tick blocks are rematerialized (jax.checkpoint).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from opendiloco_tpu.models.llama import LlamaConfig, _decoder_block
+from opendiloco_tpu.ops.attention import xla_attention
+
+
+def pipeline_hidden(
+    cparams: dict,
+    h0: jax.Array,
+    positions: jax.Array,
+    cfg: LlamaConfig,
+    mesh,
+    *,
+    microbatches: int,
+    attn_impl: str = "xla",
+    remat: bool = True,
+    axis: str = "pp",
+) -> jax.Array:
+    """Run the decoder stack as a pp-staged pipeline.
+
+    cparams["layers"]: stacked [L, ...] pytree (sharded over ``axis`` at the
+    jit level); h0: embedded inputs [B, T, D]; returns final hidden [B, T, D]
+    (pre-final-norm). B must divide by ``microbatches``.
+    """
+    B, T, D = h0.shape
+    M = microbatches
+    if B % M:
+        raise ValueError(f"batch {B} not divisible by {M} microbatches")
+    if attn_impl == "pallas":
+        from opendiloco_tpu.ops.flash_attention import flash_attention
+
+        attn_fn = lambda q, k, v: flash_attention(q, k, v, causal=True)
+    elif attn_impl == "xla":
+        attn_fn = lambda q, k, v: xla_attention(q, k, v, causal=True)
+    else:
+        raise ValueError(
+            f"attn_impl {attn_impl!r} is not supported inside the pipeline "
+            "(ring attention nests its own shard_map)"
+        )
+
+    hs = h0.reshape(M, B // M, T, D)
+    mb_positions = positions.reshape(M, B // M, T)
+
+    P = jax.sharding.PartitionSpec
+    layer_specs = jax.tree.map(lambda _: P(axis), cparams["layers"])
+
+    @functools.partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(layer_specs, P(), P()),
+        out_specs=P(),
+        axis_names={axis},
+    )
+    def _pipeline(layers_local, hs, mb_positions):
+        r = jax.lax.axis_index(axis)
+        n = jax.lax.axis_size(axis)
+        perm = [(i, i + 1) for i in range(n - 1)]  # stage r -> r+1, no wrap
+
+        def stage(x, pos):
+            block = lambda h, layer: _decoder_block(cfg, attn_fn, h, layer, pos)
+            if remat:
+                block = jax.checkpoint(block)
+            y, _ = jax.lax.scan(block, x, layers_local)
+            return y
+
+        def tick(carry, t):
+            cur, outs = carry
+            mb = jnp.clip(t - r, 0, M - 1)  # this stage's microbatch index
+            # stage 0 feeds fresh microbatches; later stages consume the
+            # activation handed over at the previous tick
+            x = jnp.where(r == 0, hs[jnp.clip(t, 0, M - 1)], cur)
+            y = stage(x, mb_positions[mb])
+            out_idx = t - (n - 1)
+            take = (r == n - 1) & (out_idx >= 0)
+            slot = jnp.clip(out_idx, 0, M - 1)
+            outs = outs.at[slot].set(
+                jnp.where(take, y, outs[slot]), indices_are_sorted=True
+            )
+            nxt = jax.lax.ppermute(y, axis, perm)
+            return (nxt, outs), None
+
+        zeros = jnp.zeros_like(hs[0])
+        outs0 = jnp.zeros_like(hs)
+        cur0, outs0 = jax.lax.pcast((zeros, outs0), axis, to="varying")
+        (cur, outs), _ = jax.lax.scan(
+            tick, (cur0, outs0), jnp.arange(M + n - 1)
+        )
+        # only the last stage holds real outputs; replicate them
+        outs = jax.lax.psum(
+            jnp.where(r == n - 1, outs, jnp.zeros_like(outs)), axis
+        )
+        return outs
+
+    outs = _pipeline(cparams["layers"], hs, mb_positions)
+    return outs.reshape(B, T, D)

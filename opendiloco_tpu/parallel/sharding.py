@@ -41,6 +41,15 @@ _LAYOUT: dict[str, tuple[Optional[int], int]] = {
 }
 
 
+def _pp_stackable(plan: MeshPlan, shape: tuple[int, ...], stacked: bool) -> bool:
+    """Can the stacked layer dim shard over the pp axis for this leaf?"""
+    return bool(
+        stacked
+        and plan.pp_axis
+        and shape[0] % plan.mesh.shape[plan.pp_axis] == 0
+    )
+
+
 def _leaf_spec(
     name: str,
     shape: tuple[int, ...],
@@ -53,6 +62,8 @@ def _leaf_spec(
     ndim = len(shape)
     axes: list[Optional[str]] = [None] * ndim
     offset = 1 if stacked else 0
+    if _pp_stackable(plan, shape, stacked):
+        axes[0] = plan.pp_axis  # pipeline stages own layer-dim slices
 
     if plan.tp_axis and tp_dim is not None:
         d = tp_dim + offset
@@ -92,6 +103,8 @@ def param_specs(cfg: LlamaConfig, plan: MeshPlan, *, for_params: bool = True) ->
         name = path[-1].key
         stacked = any(getattr(p, "key", None) == "layers" for p in path[:-1])
         if len(leaf.shape) <= (1 + (1 if stacked else 0)):
+            if _pp_stackable(plan, leaf.shape, stacked):
+                return P(plan.pp_axis)  # norm vectors still split by stage
             return P()  # norm vectors: replicate
         return _leaf_spec(
             name, leaf.shape, stacked, shard_params=shard, plan=plan

@@ -87,6 +87,7 @@ def train(config: Config, backend: Optional[OuterBackend] = None) -> dict:
         fsdp_size=config.fsdp_size,
         tp_size=config.tp_size,
         sp_size=config.sp_size,
+        pp_size=config.pp_size,
     )
     tc = TrainerConfig(
         lr=config.lr,

@@ -42,6 +42,7 @@ class TrainerConfig:
     # fused lm-head + cross-entropy Pallas kernel (ops/fused_xent.py):
     # avoids materializing [tokens, vocab] float32 logits in HBM
     fused_loss: bool = False
+    pp_microbatches: Optional[int] = None  # pipeline microbatches (None = pp size)
     # fp16 dynamic loss scaling (torch GradScaler parity, train_fsdp.py:228,
     # 383-405; bf16 needs none -- the reference itself recommends bf16)
     init_loss_scale: float = 2.0**15
@@ -95,6 +96,18 @@ class InnerTrainer:
         self.model_cfg = model_cfg
         self.tc = tc
         self.plan = plan
+        if plan.pp_axis:
+            pp_n = plan.mesh.shape[plan.pp_axis]
+            if model_cfg.num_hidden_layers % pp_n:
+                raise ValueError(
+                    f"{model_cfg.num_hidden_layers} layers cannot stage over "
+                    f"pp={pp_n} (must divide evenly)"
+                )
+            if tc.fused_loss:
+                raise ValueError(
+                    "fused_loss is not supported with pipeline parallelism "
+                    "yet (the pp path materializes logits); drop one of them"
+                )
         self.optimizer = make_inner_optimizer(tc)
         self.schedule = make_schedule(tc)
 
@@ -211,6 +224,8 @@ class InnerTrainer:
     # -- steps ------------------------------------------------------------
 
     def _loss_fn(self, params: dict, input_ids: jax.Array, labels: jax.Array):
+        if self.plan.pp_axis:
+            return self._pp_loss(params, input_ids, labels)
         if self.tc.fused_loss:
             from opendiloco_tpu.ops.fused_xent import fused_linear_cross_entropy
 
@@ -240,6 +255,22 @@ class InnerTrainer:
             remat=self.tc.remat,
             ring_mesh=self.plan.mesh,
             ring_axis=self.plan.sp_axis or "sp",
+        )
+        return causal_lm_loss(logits, labels)
+
+    def _pp_loss(self, params: dict, input_ids: jax.Array, labels: jax.Array):
+        """Pipeline-parallel loss: decoder stack staged over the pp axis
+        (parallel/pipeline.py); embed / final norm / head run replicated."""
+        logits = forward(
+            params,
+            input_ids,
+            self.model_cfg,
+            compute_dtype=self.tc.compute_dtype,
+            attn_impl=self.tc.attn_impl,
+            remat=self.tc.remat,
+            pp_mesh=self.plan.mesh,
+            pp_axis=self.plan.pp_axis,
+            pp_microbatches=self.tc.pp_microbatches,
         )
         return causal_lm_loss(logits, labels)
 
