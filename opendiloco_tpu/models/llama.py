@@ -240,7 +240,7 @@ def forward(
             cfg,
             pp_mesh,
             microbatches=pp_microbatches or pp_mesh.shape[pp_axis],
-            attn_impl=attn_impl,
+            attn_fn=attn_fn,
             remat=remat,
             axis=pp_axis,
         )

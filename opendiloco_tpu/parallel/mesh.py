@@ -100,6 +100,12 @@ def build_mesh(
 
     hybrid = strategy in ("HYBRID_SHARD", "HYBRID_SHARD_ZERO2")
     if hybrid:
+        if dp_size and dp_size > n_data:
+            raise ValueError(
+                f"dp_size {dp_size} exceeds the {n_data} data devices left "
+                f"after pp={pp_size} x sp={sp_size} x tp={tp_size} "
+                f"({n * pp_size} devices total)"
+            )
         if fsdp_size is None:
             fsdp_size = dp_size and n_data // dp_size
         if fsdp_size is None:
@@ -113,7 +119,8 @@ def build_mesh(
 
     if dp_size * fsdp_size * tp_size * sp_size != n:
         raise ValueError(
-            f"mesh {dp_size}x{fsdp_size}x{sp_size}x{tp_size} != {n} devices"
+            f"mesh pp={pp_size} dp={dp_size} fsdp={fsdp_size} sp={sp_size} "
+            f"tp={tp_size} does not cover {n * pp_size} devices"
         )
 
     dev_array = np.asarray(devices).reshape(

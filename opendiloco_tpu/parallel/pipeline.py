@@ -38,7 +38,7 @@ def pipeline_hidden(
     mesh,
     *,
     microbatches: int,
-    attn_impl: str = "xla",
+    attn_fn=None,
     remat: bool = True,
     axis: str = "pp",
 ) -> jax.Array:
@@ -46,23 +46,17 @@ def pipeline_hidden(
 
     cparams["layers"]: stacked [L, ...] pytree (sharded over ``axis`` at the
     jit level); h0: embedded inputs [B, T, D]; returns final hidden [B, T, D]
-    (pre-final-norm). B must divide by ``microbatches``.
+    (pre-final-norm). B must divide by ``microbatches``. ``attn_fn`` is the
+    per-block attention callable built by ``llama.forward`` (ring attention
+    is invalid here -- it nests its own shard_map; the trainer rejects the
+    combination at construction).
     """
     B, T, D = h0.shape
     M = microbatches
     if B % M:
         raise ValueError(f"batch {B} not divisible by {M} microbatches")
-    if attn_impl == "pallas":
-        from opendiloco_tpu.ops.flash_attention import flash_attention
-
-        attn_fn = lambda q, k, v: flash_attention(q, k, v, causal=True)
-    elif attn_impl == "xla":
+    if attn_fn is None:
         attn_fn = lambda q, k, v: xla_attention(q, k, v, causal=True)
-    else:
-        raise ValueError(
-            f"attn_impl {attn_impl!r} is not supported inside the pipeline "
-            "(ring attention nests its own shard_map)"
-        )
 
     hs = h0.reshape(M, B // M, T, D)
     mb_positions = positions.reshape(M, B // M, T)

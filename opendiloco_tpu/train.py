@@ -276,6 +276,7 @@ def train(config: Config, backend: Optional[OuterBackend] = None) -> dict:
                 batch = trainer.shard_batch(
                     host_batch["input_ids"], host_batch["labels"], accum
                 )
+            data_wait_s = time.perf_counter() - t0  # ~0 when prefetch keeps up
             if diloco_opt is not None:
                 state, metrics = diloco_opt.step(state, batch)
             else:
@@ -287,7 +288,7 @@ def train(config: Config, backend: Optional[OuterBackend] = None) -> dict:
                 flush(pending)
             real_step = step + 1
             dt = time.perf_counter() - t0
-            extras: dict = {}
+            extras: dict = {"data_wait_s": round(data_wait_s, 6)}
             if (
                 config.log_activations_steps
                 and real_step % config.log_activations_steps == 0

@@ -108,6 +108,12 @@ class InnerTrainer:
                     "fused_loss is not supported with pipeline parallelism "
                     "yet (the pp path materializes logits); drop one of them"
                 )
+            if tc.attn_impl == "ring":
+                raise ValueError(
+                    "ring attention cannot run inside pipeline stages (it "
+                    "nests its own shard_map); use attn_impl xla/pallas "
+                    "with pp, or sp without pp"
+                )
         self.optimizer = make_inner_optimizer(tc)
         self.schedule = make_schedule(tc)
 
