@@ -168,6 +168,7 @@ class Config(BaseModel):
     tp_size: int = 1
     sp_size: int = 1  # sequence/context parallel (ring attention)
     pp_size: int = 1  # pipeline stages (GPipe schedule over the layer stack)
+    ep_size: int = 1  # expert parallel (MoE expert dim over the ep axis)
 
     # observability
     project: str = "opendiloco_tpu"

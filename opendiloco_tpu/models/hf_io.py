@@ -60,12 +60,22 @@ def load_config(path_model: str) -> LlamaConfig:
     return LlamaConfig.from_json(path)
 
 
+def _reject_moe(cfg: LlamaConfig, op: str) -> None:
+    if cfg.num_experts:
+        raise ValueError(
+            f"cannot {op} MoE weights as HF llama safetensors (the llama "
+            "architecture has no routed experts); use the framework "
+            "checkpointer (opendiloco_tpu.ckpt) for MoE models"
+        )
+
+
 def load_params(model_dir: str, cfg: Optional[LlamaConfig] = None) -> dict:
     """Read an HF llama ``model.safetensors`` into our stacked pytree."""
     from safetensors import safe_open
 
     if cfg is None:
         cfg = load_config(model_dir)
+    _reject_moe(cfg, "load")
     st_path = os.path.join(model_dir, "model.safetensors")
     tensors: dict[str, np.ndarray] = {}
     with safe_open(st_path, framework="numpy") as f:
@@ -103,6 +113,7 @@ def save_params(params: dict, cfg: LlamaConfig, model_dir: str) -> None:
     """Write our pytree as an HF-named ``model.safetensors`` + config.json."""
     from safetensors.numpy import save_file
 
+    _reject_moe(cfg, "save")
     os.makedirs(model_dir, exist_ok=True)
     out: dict[str, np.ndarray] = {}
     np_params = jax.tree.map(lambda x: np.asarray(x, dtype=np.float32), params)

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import math
 from typing import Any, Optional
 
 import jax
@@ -43,6 +44,12 @@ class LlamaConfig:
     rope_theta: float = 10_000.0
     tie_word_embeddings: bool = False
     initializer_range: float = 0.02
+    # Mixture-of-Experts (beyond the reference's dense-only zoo): 0 = dense
+    # FFN; > 0 = Switch-style top-1 routed experts in every layer, sharded
+    # over the "ep" mesh axis
+    num_experts: int = 0
+    expert_capacity_factor: float = 1.25
+    router_aux_coef: float = 0.01
 
     @property
     def kv_heads(self) -> int:
@@ -93,6 +100,21 @@ def shapes(cfg: LlamaConfig) -> dict:
     def s(*shape):
         return jax.ShapeDtypeStruct(shape, f32)
 
+    E = cfg.num_experts
+    ffn = (
+        {
+            "router": s(L, D, E),
+            "gate_proj": s(L, E, D, F),
+            "up_proj": s(L, E, D, F),
+            "down_proj": s(L, E, F, D),
+        }
+        if E
+        else {
+            "gate_proj": s(L, D, F),
+            "up_proj": s(L, D, F),
+            "down_proj": s(L, F, D),
+        }
+    )
     tree = {
         "embed_tokens": s(V, D),
         "layers": {
@@ -102,9 +124,7 @@ def shapes(cfg: LlamaConfig) -> dict:
             "k_proj": s(L, D, Nkv * Dh),
             "v_proj": s(L, D, Nkv * Dh),
             "o_proj": s(L, Nh * Dh, D),
-            "gate_proj": s(L, D, F),
-            "up_proj": s(L, D, F),
-            "down_proj": s(L, F, D),
+            **ffn,
         },
         "final_norm": s(D),
     }
@@ -151,16 +171,61 @@ def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
     return rot.astype(x.dtype)
 
 
+def _switch_ffn(
+    cfg: LlamaConfig, x: jax.Array, layer: dict
+) -> tuple[jax.Array, jax.Array]:
+    """Switch-Transformer top-1 routed expert FFN -> (out, aux_loss).
+
+    Dispatch/combine are dense einsums over a [tokens, experts, capacity]
+    one-hot, so sharding the expert dim over the "ep" mesh axis is a pure
+    PartitionSpec concern -- pjit slices the expert matmuls per device, no
+    hand-written all-to-all. Over-capacity tokens pass through the residual
+    only (standard Switch semantics)."""
+    B, T, D = x.shape
+    E = cfg.num_experts
+    N = B * T
+    cap = max(1, math.ceil(N / E * cfg.expert_capacity_factor))
+    xf = x.reshape(N, D)
+
+    logits = (xf @ layer["router"]).astype(jnp.float32)  # [N, E]
+    probs = jax.nn.softmax(logits, axis=-1)
+    expert = jnp.argmax(probs, axis=-1)  # [N]
+    gate = jnp.take_along_axis(probs, expert[:, None], axis=1)[:, 0]
+    onehot = jax.nn.one_hot(expert, E, dtype=jnp.float32)  # [N, E]
+
+    # load-balance aux (Switch eq. 4): density * router-probability mass
+    density = jnp.mean(onehot, axis=0)
+    density_proxy = jnp.mean(probs, axis=0)
+    aux = E * jnp.sum(density * density_proxy)
+
+    pos = jnp.cumsum(onehot, axis=0) * onehot - 1.0  # slot within expert
+    # one_hot is already all-zero for pos = -1 (not routed here) and for
+    # pos >= cap (over capacity), so it doubles as the keep mask
+    dispatch = onehot[:, :, None] * jax.nn.one_hot(
+        pos.astype(jnp.int32), cap, dtype=jnp.float32
+    )  # [N, E, C]
+
+    d = dispatch.astype(x.dtype)
+    expert_in = jnp.einsum("nec,nd->ecd", d, xf)  # [E, C, D]
+    h1 = jax.nn.silu(
+        jnp.einsum("ecd,edf->ecf", expert_in, layer["gate_proj"])
+    ) * jnp.einsum("ecd,edf->ecf", expert_in, layer["up_proj"])
+    out_e = jnp.einsum("ecf,efd->ecd", h1, layer["down_proj"])
+    combine = d * gate.astype(x.dtype)[:, None, None]
+    y = jnp.einsum("nec,ecd->nd", combine, out_e)
+    return y.reshape(B, T, D), aux
+
+
 def _decoder_block(
     cfg: LlamaConfig,
     attn_fn,
     h: jax.Array,
     layer: dict,
     positions: jax.Array,
-) -> tuple[jax.Array, jax.Array]:
-    """Returns (hidden, attn-output L2 norm). The norm is the activation
-    probe the reference attaches via forward hooks on ``self_attn``
-    (utils.py:43-67, train_fsdp.py:65)."""
+) -> tuple[jax.Array, tuple[jax.Array, jax.Array]]:
+    """Returns (hidden, (attn-output L2 norm, moe aux loss)). The norm is
+    the activation probe the reference attaches via forward hooks on
+    ``self_attn`` (utils.py:43-67, train_fsdp.py:65)."""
     B, T, D = h.shape
     Nh, Nkv, Dh = cfg.num_attention_heads, cfg.kv_heads, cfg.head_dim
 
@@ -176,8 +241,14 @@ def _decoder_block(
     h = h + attn_out
 
     x = _rms_norm(h, layer["post_attn_norm"], cfg.rms_norm_eps)
-    gated = jax.nn.silu(x @ layer["gate_proj"]) * (x @ layer["up_proj"])
-    return h + gated @ layer["down_proj"], attn_norm
+    if cfg.num_experts:
+        ffn, aux = _switch_ffn(cfg, x, layer)
+    else:
+        ffn = (
+            jax.nn.silu(x @ layer["gate_proj"]) * (x @ layer["up_proj"])
+        ) @ layer["down_proj"]
+        aux = jnp.float32(0.0)
+    return h + ffn, (attn_norm, aux)
 
 
 def forward(
@@ -196,6 +267,7 @@ def forward(
     pp_mesh=None,
     pp_axis: str = "pp",
     pp_microbatches: Optional[int] = None,
+    return_moe_aux: bool = False,
 ):
     """input_ids [B, T] int32 -> logits [B, T, V] float32.
 
@@ -245,11 +317,13 @@ def forward(
             axis=pp_axis,
         )
         attn_norms = jnp.zeros((cfg.num_hidden_layers,), jnp.float32)
+        moe_aux = jnp.float32(0.0)
     else:
         block = lambda h, layer: _decoder_block(cfg, attn_fn, h, layer, positions)
         if remat:
             block = jax.checkpoint(block)
-        h, attn_norms = jax.lax.scan(block, h, cparams["layers"])
+        h, (attn_norms, layer_auxs) = jax.lax.scan(block, h, cparams["layers"])
+        moe_aux = jnp.mean(layer_auxs)
 
     h = _rms_norm(h, cparams["final_norm"], cfg.rms_norm_eps)
     head = (
@@ -264,8 +338,11 @@ def forward(
         aux = {
             "attn_out_norm": attn_norms,
             "lm_head_norm": jnp.sqrt(jnp.sum(logits**2)),
+            "moe_aux": moe_aux,
         }
         return logits, aux
+    if return_moe_aux:
+        return logits, moe_aux
     return logits
 
 

@@ -51,6 +51,7 @@ class MeshPlan:
     tp_axis: Optional[str]
     sp_axis: Optional[str]
     pp_axis: Optional[str] = None  # pipeline stages (stacked-layer dim)
+    ep_axis: Optional[str] = None  # expert parallel (MoE expert dim)
 
     @property
     def data_parallel_size(self) -> int:
@@ -76,8 +77,9 @@ def build_mesh(
     tp_size: int = 1,
     sp_size: int = 1,
     pp_size: int = 1,
+    ep_size: int = 1,
 ) -> MeshPlan:
-    """Build the (pp, dp, fsdp, sp, tp) mesh for a sharding strategy.
+    """Build the (pp, dp, fsdp, ep, sp, tp) mesh for a sharding strategy.
 
     With hybrid strategies the dp axis is the slow/outer (DCN) dimension and
     fsdp the fast/inner (ICI) dimension, matching the reference's
@@ -90,21 +92,21 @@ def build_mesh(
     if devices is None:
         devices = jax.devices()
     n = len(devices)
-    if n % (tp_size * sp_size * pp_size) != 0:
+    if n % (tp_size * sp_size * pp_size * ep_size) != 0:
         raise ValueError(
-            f"{n} devices not divisible by tp*sp*pp="
-            f"{tp_size * sp_size * pp_size}"
+            f"{n} devices not divisible by tp*sp*pp*ep="
+            f"{tp_size * sp_size * pp_size * ep_size}"
         )
     n = n // pp_size
-    n_data = n // (tp_size * sp_size)
+    n_data = n // (tp_size * sp_size * ep_size)
 
     hybrid = strategy in ("HYBRID_SHARD", "HYBRID_SHARD_ZERO2")
     if hybrid:
         if dp_size and dp_size > n_data:
             raise ValueError(
                 f"dp_size {dp_size} exceeds the {n_data} data devices left "
-                f"after pp={pp_size} x sp={sp_size} x tp={tp_size} "
-                f"({n * pp_size} devices total)"
+                f"after pp={pp_size} x ep={ep_size} x sp={sp_size} x "
+                f"tp={tp_size} ({n * pp_size} devices total)"
             )
         if fsdp_size is None:
             fsdp_size = dp_size and n_data // dp_size
@@ -117,16 +119,16 @@ def build_mesh(
     else:  # FULL_SHARD / SHARD_GRAD_OP: single flat axis
         dp_size, fsdp_size = 1, n_data
 
-    if dp_size * fsdp_size * tp_size * sp_size != n:
+    if dp_size * fsdp_size * tp_size * sp_size * ep_size != n:
         raise ValueError(
-            f"mesh pp={pp_size} dp={dp_size} fsdp={fsdp_size} sp={sp_size} "
-            f"tp={tp_size} does not cover {n * pp_size} devices"
+            f"mesh pp={pp_size} dp={dp_size} fsdp={fsdp_size} ep={ep_size} "
+            f"sp={sp_size} tp={tp_size} does not cover {n * pp_size} devices"
         )
 
     dev_array = np.asarray(devices).reshape(
-        pp_size, dp_size, fsdp_size, sp_size, tp_size
+        pp_size, dp_size, fsdp_size, ep_size, sp_size, tp_size
     )
-    mesh = Mesh(dev_array, ("pp", "dp", "fsdp", "sp", "tp"))
+    mesh = Mesh(dev_array, ("pp", "dp", "fsdp", "ep", "sp", "tp"))
 
     # ZeRO-2/3 are still data-parallel: the batch splits over dp AND fsdp.
     batch_axes = ("dp", "fsdp")
@@ -138,6 +140,7 @@ def build_mesh(
         tp_axis="tp" if tp_size > 1 else None,
         sp_axis="sp" if sp_size > 1 else None,
         pp_axis="pp" if pp_size > 1 else None,
+        ep_axis="ep" if ep_size > 1 else None,
     )
 
 

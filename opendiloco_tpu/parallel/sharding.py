@@ -24,7 +24,8 @@ from opendiloco_tpu.models.llama import LlamaConfig, shapes
 from opendiloco_tpu.parallel.mesh import MeshPlan, params_sharded, optstate_sharded
 
 # per-leaf: (tp dim index, preferred fsdp dim index) -- indices into the
-# UNSTACKED shape (layer leaves get +1 when the leading L axis is present).
+# UNSTACKED shape (layer leaves get +1 when the leading L axis is present;
+# expert-stacked FFN leaves get a further +1 after their expert dim).
 _LAYOUT: dict[str, tuple[Optional[int], int]] = {
     "embed_tokens": (0, 1),  # [V, D]: tp on vocab, fsdp on D
     "lm_head": (1, 0),  # [D, V]
@@ -35,10 +36,14 @@ _LAYOUT: dict[str, tuple[Optional[int], int]] = {
     "k_proj": (1, 0),
     "v_proj": (1, 0),
     "o_proj": (0, 1),  # [Nh*Dh, D]
-    "gate_proj": (1, 0),  # [D, F]
+    "gate_proj": (1, 0),  # [D, F] (or [E, D, F] under MoE)
     "up_proj": (1, 0),
-    "down_proj": (0, 1),  # [F, D]
+    "down_proj": (0, 1),  # [F, D] (or [E, F, D])
+    "router": (None, 0),  # [D, E]: small, fsdp on D
 }
+
+# FFN leaves that gain a leading expert dim when num_experts > 0
+_EXPERT_LEAVES = {"gate_proj", "up_proj", "down_proj"}
 
 
 def _pp_stackable(plan: MeshPlan, shape: tuple[int, ...], stacked: bool) -> bool:
@@ -64,6 +69,12 @@ def _leaf_spec(
     offset = 1 if stacked else 0
     if _pp_stackable(plan, shape, stacked):
         axes[0] = plan.pp_axis  # pipeline stages own layer-dim slices
+
+    # expert-stacked FFN leaf ([L, E, ...]): expert dim shards over ep
+    if name in _EXPERT_LEAVES and ndim == offset + 3:
+        if plan.ep_axis and shape[offset] % plan.mesh.shape[plan.ep_axis] == 0:
+            axes[offset] = plan.ep_axis
+        offset += 1  # tp/fsdp indices apply past the expert dim
 
     if plan.tp_axis and tp_dim is not None:
         d = tp_dim + offset

@@ -88,6 +88,7 @@ def train(config: Config, backend: Optional[OuterBackend] = None) -> dict:
         tp_size=config.tp_size,
         sp_size=config.sp_size,
         pp_size=config.pp_size,
+        ep_size=config.ep_size,
     )
     tc = TrainerConfig(
         lr=config.lr,

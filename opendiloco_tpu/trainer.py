@@ -114,6 +114,30 @@ class InnerTrainer:
                     "nests its own shard_map); use attn_impl xla/pallas "
                     "with pp, or sp without pp"
                 )
+            if model_cfg.num_experts:
+                raise ValueError(
+                    "MoE models are not supported with pipeline parallelism "
+                    "yet (the router aux loss is not threaded through the "
+                    "pipeline)"
+                )
+        if model_cfg.num_experts and tc.fused_loss:
+            raise ValueError(
+                "fused_loss does not thread the MoE router aux loss yet; "
+                "drop one of them"
+            )
+        if plan.ep_axis:
+            ep_n = plan.mesh.shape[plan.ep_axis]
+            if model_cfg.num_experts == 0:
+                raise ValueError(
+                    f"--ep-size {ep_n} with a dense model silently replicates "
+                    "work across the ep axis; use an MoE config (num_experts "
+                    "> 0) or drop ep_size"
+                )
+            if model_cfg.num_experts % ep_n:
+                raise ValueError(
+                    f"{model_cfg.num_experts} experts cannot shard over "
+                    f"ep={ep_n} (must divide evenly)"
+                )
         self.optimizer = make_inner_optimizer(tc)
         self.schedule = make_schedule(tc)
 
@@ -252,7 +276,8 @@ class InnerTrainer:
                 head,
                 labels[:, 1:].reshape(-1),
             )
-        logits = forward(
+        moe = bool(self.model_cfg.num_experts)
+        out = forward(
             params,
             input_ids,
             self.model_cfg,
@@ -261,8 +286,14 @@ class InnerTrainer:
             remat=self.tc.remat,
             ring_mesh=self.plan.mesh,
             ring_axis=self.plan.sp_axis or "sp",
+            return_moe_aux=moe,
         )
-        return causal_lm_loss(logits, labels)
+        if moe:
+            logits, moe_aux = out
+            return causal_lm_loss(logits, labels) + (
+                self.model_cfg.router_aux_coef * moe_aux
+            )
+        return causal_lm_loss(out, labels)
 
     def _pp_loss(self, params: dict, input_ids: jax.Array, labels: jax.Array):
         """Pipeline-parallel loss: decoder stack staged over the pp axis

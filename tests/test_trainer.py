@@ -177,11 +177,11 @@ def test_mesh_validation_errors():
 def test_mesh_shapes_per_strategy():
     from opendiloco_tpu.parallel.mesh import build_mesh
 
-    assert build_mesh("NO_SHARD").mesh.shape == {"pp": 1, "dp": 8, "fsdp": 1, "sp": 1, "tp": 1}
-    assert build_mesh("FULL_SHARD").mesh.shape == {"pp": 1, "dp": 1, "fsdp": 8, "sp": 1, "tp": 1}
+    assert build_mesh("NO_SHARD").mesh.shape == {"pp": 1, "dp": 8, "fsdp": 1, "ep": 1, "sp": 1, "tp": 1}
+    assert build_mesh("FULL_SHARD").mesh.shape == {"pp": 1, "dp": 1, "fsdp": 8, "ep": 1, "sp": 1, "tp": 1}
     plan = build_mesh("HYBRID_SHARD", fsdp_size=4)
-    assert plan.mesh.shape == {"pp": 1, "dp": 2, "fsdp": 4, "sp": 1, "tp": 1}
+    assert plan.mesh.shape == {"pp": 1, "dp": 2, "fsdp": 4, "ep": 1, "sp": 1, "tp": 1}
     assert plan.data_parallel_size == 8
     plan = build_mesh("NO_SHARD", sp_size=2, tp_size=2)
-    assert plan.mesh.shape == {"pp": 1, "dp": 2, "fsdp": 1, "sp": 2, "tp": 2}
+    assert plan.mesh.shape == {"pp": 1, "dp": 2, "fsdp": 1, "ep": 1, "sp": 2, "tp": 2}
     assert plan.data_parallel_size == 2
