@@ -165,7 +165,11 @@ def main():
     model = os.environ.get("OPENDILOCO_TPU_BENCH_MODEL", "150m")
     cfg, _ = get_model(model)
     seq, per_dev_bs, accum = 1024, 16, 1
-    if model != "150m":  # smoke/debug runs on small models
+    if model == "1b":
+        # fp32 params + adam ~= 12GB on a 16GB chip: small micro-batch,
+        # accumulate to keep the MXU fed
+        per_dev_bs, accum = 4, 4
+    elif model != "150m":  # smoke/debug runs on small models
         seq, per_dev_bs = 256, 8
     n_chips = len(jax.devices())
     bs = per_dev_bs * n_chips
