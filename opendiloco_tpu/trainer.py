@@ -103,11 +103,6 @@ class InnerTrainer:
                     f"{model_cfg.num_hidden_layers} layers cannot stage over "
                     f"pp={pp_n} (must divide evenly)"
                 )
-            if tc.fused_loss:
-                raise ValueError(
-                    "fused_loss is not supported with pipeline parallelism "
-                    "yet (the pp path materializes logits); drop one of them"
-                )
             if tc.attn_impl == "ring":
                 raise ValueError(
                     "ring attention cannot run inside pipeline stages (it "
@@ -253,12 +248,21 @@ class InnerTrainer:
 
     # -- steps ------------------------------------------------------------
 
+    @staticmethod
+    def _fused_lm_loss(hidden: jax.Array, head: jax.Array, labels: jax.Array):
+        """Shifted fused lm-head+xent over final hidden states (the single
+        shift/reshape site for both the plain and pipeline paths)."""
+        from opendiloco_tpu.ops.fused_xent import fused_linear_cross_entropy
+
+        d = hidden.shape[-1]
+        return fused_linear_cross_entropy(
+            hidden[:, :-1].reshape(-1, d), head, labels[:, 1:].reshape(-1)
+        )
+
     def _loss_fn(self, params: dict, input_ids: jax.Array, labels: jax.Array):
         if self.plan.pp_axis:
             return self._pp_loss(params, input_ids, labels)
         if self.tc.fused_loss:
-            from opendiloco_tpu.ops.fused_xent import fused_linear_cross_entropy
-
             hidden, head = forward(
                 params,
                 input_ids,
@@ -270,12 +274,7 @@ class InnerTrainer:
                 ring_mesh=self.plan.mesh,
                 ring_axis=self.plan.sp_axis or "sp",
             )
-            b, t, d = hidden.shape
-            return fused_linear_cross_entropy(
-                hidden[:, :-1].reshape(-1, d),
-                head,
-                labels[:, 1:].reshape(-1),
-            )
+            return self._fused_lm_loss(hidden, head, labels)
         moe = bool(self.model_cfg.num_experts)
         out = forward(
             params,
@@ -297,11 +296,10 @@ class InnerTrainer:
 
     def _pp_loss(self, params: dict, input_ids: jax.Array, labels: jax.Array):
         """Pipeline-parallel loss: decoder stack staged over the pp axis
-        (parallel/pipeline.py); embed / final norm / head run replicated."""
-        logits = forward(
-            params,
-            input_ids,
-            self.model_cfg,
+        (parallel/pipeline.py); embed / final norm / head run replicated.
+        fused_loss composes: the pipeline hands back hidden states, so the
+        fused lm-head+xent kernel applies unchanged."""
+        pp_kwargs = dict(
             compute_dtype=self.tc.compute_dtype,
             attn_impl=self.tc.attn_impl,
             remat=self.tc.remat,
@@ -309,6 +307,12 @@ class InnerTrainer:
             pp_axis=self.plan.pp_axis,
             pp_microbatches=self.tc.pp_microbatches,
         )
+        if self.tc.fused_loss:
+            hidden, head = forward(
+                params, input_ids, self.model_cfg, return_hidden=True, **pp_kwargs
+            )
+            return self._fused_lm_loss(hidden, head, labels)
+        logits = forward(params, input_ids, self.model_cfg, **pp_kwargs)
         return causal_lm_loss(logits, labels)
 
     def _train_step_impl(self, state: dict, batch: dict):

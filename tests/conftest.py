@@ -42,3 +42,24 @@ def tiny_cfg():
         num_key_value_heads=2,
         max_position_embeddings=128,
     )
+
+
+import pytest as _pytest
+
+
+@_pytest.fixture
+def interpret_pallas_fused(monkeypatch):
+    """Interpret-mode pallas for the fused-xent module (shared by attention
+    and pipeline tests)."""
+    import jax.experimental.pallas as pl
+
+    from opendiloco_tpu.ops import fused_xent
+
+    orig = pl.pallas_call
+
+    def patched(*args, **kwargs):
+        kwargs["interpret"] = True
+        return orig(*args, **kwargs)
+
+    monkeypatch.setattr(fused_xent.pl, "pallas_call", patched)
+    return patched

@@ -22,23 +22,6 @@ def qkv():
 
 
 @pytest.fixture
-def interpret_pallas_fused(monkeypatch):
-    """Interpret-mode pallas for the fused-xent module."""
-    import jax.experimental.pallas as pl
-
-    orig = pl.pallas_call
-
-    def patched(*args, **kwargs):
-        kwargs["interpret"] = True
-        return orig(*args, **kwargs)
-
-    from opendiloco_tpu.ops import fused_xent
-
-    monkeypatch.setattr(fused_xent.pl, "pallas_call", patched)
-    return patched
-
-
-@pytest.fixture
 def interpret_pallas(monkeypatch):
     """Run pallas kernels in interpreter mode (no TPU in CI)."""
     import jax.experimental.pallas as pl

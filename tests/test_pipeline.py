@@ -112,11 +112,26 @@ def test_pp_requires_divisible_layers(pp_cfg):
         InnerTrainer(cfg, tc, plan)
 
 
-def test_pp_rejects_fused_loss(pp_cfg):
-    plan = build_mesh("NO_SHARD", pp_size=2)
-    tc = TrainerConfig(
-        precision="fp32", remat=False, total_steps=10, warmup_steps=2,
-        fused_loss=True,
+def test_pp_composes_with_fused_loss(interpret_pallas_fused):
+    """fused lm-head+xent over pipeline-produced hidden states matches the
+    materializing pp loss, with the Pallas kernel actually running
+    (interpret mode): hidden 128 and 256 shifted tokens tile the kernel."""
+    cfg = LlamaConfig(
+        vocab_size=256, hidden_size=128, intermediate_size=256,
+        num_hidden_layers=4, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64,
     )
-    with pytest.raises(ValueError, match="fused_loss"):
-        InnerTrainer(pp_cfg, tc, plan)
+    plan = build_mesh("NO_SHARD", pp_size=2)
+    losses = {}
+    for fused in (False, True):
+        tc = TrainerConfig(
+            lr=1e-3, warmup_steps=2, total_steps=10, precision="fp32",
+            remat=False, fused_loss=fused,
+        )
+        trainer = InnerTrainer(cfg, tc, plan)
+        state = trainer.init_state(jax.random.key(0))
+        ids = _data(n=8, t=33)  # 8 * 32 shifted tokens = 256: block_n tiles
+        batch = trainer.shard_batch(ids, ids.copy(), accum=1)
+        _, m = trainer.train_step(state, batch)
+        losses[fused] = float(m["loss"])
+    np.testing.assert_allclose(losses[True], losses[False], rtol=1e-5)
