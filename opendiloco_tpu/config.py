@@ -9,7 +9,7 @@ dependency-free argv parser.
 from __future__ import annotations
 
 import sys
-from typing import Any, Literal, Optional
+from typing import Any, Literal, Optional, Union
 
 from pydantic import BaseModel, field_validator, model_validator
 from pydantic import ConfigDict
@@ -132,7 +132,11 @@ class Config(BaseModel):
     # model
     path_model: str = "configs/config_150m.json"
     attn_implementation: Literal["xla", "pallas", "ring"] = "xla"
-    remat: bool = True
+    # rematerialization policy: false/"none" (save everything), true/"full"
+    # (reference-style per-layer checkpointing), or "dots" (save MXU outputs,
+    # recompute elementwise -- near-full memory savings without the extra
+    # matmul forward)
+    remat: Union[bool, Literal["none", "full", "dots"]] = True
     fused_loss: bool = False  # fused lm-head+xent Pallas kernel
 
     # data

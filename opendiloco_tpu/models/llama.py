@@ -20,7 +20,7 @@ from __future__ import annotations
 import dataclasses
 import json
 import math
-from typing import Any, Optional
+from typing import Any, Literal, Optional, Union
 
 import jax
 import jax.numpy as jnp
@@ -151,6 +151,33 @@ def init_params(rng: jax.Array, cfg: LlamaConfig) -> dict:
     return jax.tree.unflatten(treedef, out)
 
 
+# rematerialization policy accepted everywhere a `remat` argument appears:
+# False/"none" saves all activations; True/"full" checkpoints per layer;
+# "dots" saves MXU outputs and recomputes the elementwise chain
+RematPolicy = Union[bool, Literal["none", "full", "dots"]]
+
+
+def _maybe_remat(block, remat: RematPolicy):
+    """Apply the rematerialization policy to a per-layer block function.
+
+    remat=False/"none": save all activations (no recompute -- fastest when
+    they fit); True/"full": save only layer boundaries (reference-style full
+    checkpointing); "dots": save matmul/MXU outputs and recompute the cheap
+    elementwise chain (norms, rope, silu) -- recovers most of full remat's
+    memory while skipping the extra forward through the matmuls, which is
+    where ~all the FLOPs are."""
+    if remat in (False, None, "none"):
+        return block
+    if remat in (True, "full"):
+        return jax.checkpoint(block)
+    if remat == "dots":
+        return jax.checkpoint(
+            block,
+            policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+        )
+    raise ValueError(f"unknown remat policy {remat!r}")
+
+
 def _rms_norm(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
     # variance in float32 for stability (HF llama semantics)
     xf = x.astype(jnp.float32)
@@ -159,16 +186,30 @@ def _rms_norm(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
     return (xf * weight.astype(jnp.float32)).astype(x.dtype)
 
 
-def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
-    """Rotary embedding over [B, T, H, D] with HF half-rotation layout."""
-    d = x.shape[-1]
+def _rope_tables(
+    positions: jax.Array, d: int, theta: float
+) -> tuple[jax.Array, jax.Array]:
+    """(cos, sin) [B, T, 1, D/2] float32 for the given positions.
+
+    Hoisted out of the layer scan: the tables are shared by every layer's
+    q and k, so the cos/sin transcendentals run once per step instead of
+    2*num_layers times."""
     inv_freq = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
     angles = positions[..., None].astype(jnp.float32) * inv_freq  # [B, T, D/2]
-    cos = jnp.cos(angles)[:, :, None, :]
-    sin = jnp.sin(angles)[:, :, None, :]
+    return jnp.cos(angles)[:, :, None, :], jnp.sin(angles)[:, :, None, :]
+
+
+def _rope_apply(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
+    """Rotate [B, T, H, D] by precomputed tables (HF half-rotation layout)."""
     x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
     rot = jnp.concatenate((x1 * cos - x2 * sin, x2 * cos + x1 * sin), axis=-1)
     return rot.astype(x.dtype)
+
+
+def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """Rotary embedding over [B, T, H, D] with HF half-rotation layout."""
+    cos, sin = _rope_tables(positions, x.shape[-1], theta)
+    return _rope_apply(x, cos, sin)
 
 
 def _switch_ffn(
@@ -222,19 +263,23 @@ def _decoder_block(
     h: jax.Array,
     layer: dict,
     positions: jax.Array,
+    rope: Optional[tuple[jax.Array, jax.Array]] = None,
 ) -> tuple[jax.Array, tuple[jax.Array, jax.Array]]:
     """Returns (hidden, (attn-output L2 norm, moe aux loss)). The norm is
     the activation probe the reference attaches via forward hooks on
     ``self_attn`` (utils.py:43-67, train_fsdp.py:65)."""
     B, T, D = h.shape
     Nh, Nkv, Dh = cfg.num_attention_heads, cfg.kv_heads, cfg.head_dim
+    if rope is None:
+        rope = _rope_tables(positions, cfg.head_dim, cfg.rope_theta)
+    cos, sin = rope
 
     x = _rms_norm(h, layer["input_norm"], cfg.rms_norm_eps)
     q = (x @ layer["q_proj"]).reshape(B, T, Nh, Dh)
     k = (x @ layer["k_proj"]).reshape(B, T, Nkv, Dh)
     v = (x @ layer["v_proj"]).reshape(B, T, Nkv, Dh)
-    q = _rope(q, positions, cfg.rope_theta)
-    k = _rope(k, positions, cfg.rope_theta)
+    q = _rope_apply(q, cos, sin)
+    k = _rope_apply(k, cos, sin)
     attn = attn_fn(q, k, v)
     attn_out = attn.reshape(B, T, Nh * Dh) @ layer["o_proj"]
     attn_norm = jnp.sqrt(jnp.sum(attn_out.astype(jnp.float32) ** 2))
@@ -258,7 +303,7 @@ def forward(
     *,
     compute_dtype: jnp.dtype = jnp.bfloat16,
     attn_impl: str = "xla",
-    remat: bool = True,
+    remat: RematPolicy = True,
     positions: Optional[jax.Array] = None,
     return_aux: bool = False,
     return_hidden: bool = False,
@@ -319,9 +364,11 @@ def forward(
         attn_norms = jnp.zeros((cfg.num_hidden_layers,), jnp.float32)
         moe_aux = jnp.float32(0.0)
     else:
-        block = lambda h, layer: _decoder_block(cfg, attn_fn, h, layer, positions)
-        if remat:
-            block = jax.checkpoint(block)
+        rope = _rope_tables(positions, cfg.head_dim, cfg.rope_theta)
+        block = lambda h, layer: _decoder_block(
+            cfg, attn_fn, h, layer, positions, rope
+        )
+        block = _maybe_remat(block, remat)
         h, (attn_norms, layer_auxs) = jax.lax.scan(block, h, cparams["layers"])
         moe_aux = jnp.mean(layer_auxs)
 

@@ -27,6 +27,7 @@ accumulating over the rep q-heads of each kv head).
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -37,8 +38,8 @@ _NEG_INF = float(-1e30)
 
 
 def _pick_block(t: int, preferred: int = 512) -> int:
-    for b in (preferred, 256, 128):
-        if t % b == 0:
+    for b in (preferred, 512, 256, 128):
+        if b <= preferred and t % b == 0:
             return b
     return 0  # caller falls back to XLA attention
 
@@ -68,10 +69,12 @@ def _fwd_kernel(
 
     @pl.when(jnp.logical_or(not causal, diag_ok))
     def _step():
-        q = q_ref[:].astype(jnp.float32) * scale
-        k_blk = k_ref[:].astype(jnp.float32)
-        v_blk = v_ref[:].astype(jnp.float32)
-        s = jax.lax.dot_general(
+        # matmul inputs stay in bf16 (f32 inputs run the MXU at ~1/8 rate on
+        # v5e); accumulation and softmax statistics are f32
+        q = q_ref[:]
+        k_blk = k_ref[:]
+        v_blk = v_ref[:]
+        s = scale * jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )  # [block_q, block_k]
         if causal:
@@ -89,7 +92,10 @@ def _fwd_kernel(
         m_scr[:] = m_new
         l_scr[:] = l_prev * corr + jnp.sum(p, axis=1, keepdims=True)
         acc_scr[:] = acc * corr + jax.lax.dot_general(
-            p, v_blk, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            p.astype(v_blk.dtype),
+            v_blk,
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
 
     @pl.when(ki == num_k - 1)
@@ -175,12 +181,12 @@ def _dq_kernel(
 
     @pl.when(jnp.logical_or(not causal, diag_ok))
     def _step():
-        q = q_ref[:].astype(jnp.float32)
-        do = do_ref[:].astype(jnp.float32)
+        q = q_ref[:]
+        do = do_ref[:]
         lse = lse_ref[:].reshape(block_q, 1)
         delta = delta_ref[:].reshape(block_q, 1)
-        k_blk = k_ref[:].astype(jnp.float32)
-        v_blk = v_ref[:].astype(jnp.float32)
+        k_blk = k_ref[:]
+        v_blk = v_ref[:]
         s = scale * jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
@@ -196,7 +202,7 @@ def _dq_kernel(
         dp = jax.lax.dot_general(
             do, v_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
-        ds = p * (dp - delta)
+        ds = (p * (dp - delta)).astype(k_blk.dtype)
         dq_scr[:] = dq_scr[:] + scale * jax.lax.dot_general(
             ds, k_blk, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
@@ -227,10 +233,10 @@ def _dkv_kernel(
 
     @pl.when(jnp.logical_or(not causal, diag_ok))
     def _step():
-        k_blk = k_ref[:].astype(jnp.float32)
-        v_blk = v_ref[:].astype(jnp.float32)
-        q_blk = q_ref[0].astype(jnp.float32)
-        do_blk = do_ref[0].astype(jnp.float32)
+        k_blk = k_ref[:]
+        v_blk = v_ref[:]
+        q_blk = q_ref[0]
+        do_blk = do_ref[0]
         lse_blk = lse_ref[:].reshape(block_q, 1)
         delta_blk = delta_ref[:].reshape(block_q, 1)
         s = scale * jax.lax.dot_general(
@@ -245,13 +251,14 @@ def _dkv_kernel(
             )
             s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
         p = jnp.exp(s - lse_blk)
+        pb = p.astype(do_blk.dtype)
         dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
-            p, do_blk, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            pb, do_blk, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
         dp = jax.lax.dot_general(
             do_blk, v_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
-        ds = p * (dp - delta_blk)
+        ds = (p * (dp - delta_blk)).astype(q_blk.dtype)
         dk_scr[:] = dk_scr[:] + scale * jax.lax.dot_general(
             ds, q_blk, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
@@ -399,13 +406,37 @@ _flash.defvjp(_flash_fwd, _bwd)
 
 
 def flash_attention(
-    q: jax.Array, k: jax.Array, v: jax.Array, *, causal: bool = True
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    block_q: int = 1024,
+    block_k: int = 1024,
 ) -> jax.Array:
     """[B, T, H, D] attention via the Pallas kernel; falls back to XLA for
-    shapes the kernel doesn't tile (T not a multiple of 128)."""
+    shapes the kernel doesn't tile (T not a multiple of 128).
+
+    Blocks default large (1024x1024, on-chip-swept): per-grid-step fixed cost
+    dominates at small tiles on TPU, and VMEM per step is only O(block*d) +
+    the [bq, bk] f32 score tile, so these fit VMEM comfortably."""
     b, t, hq, d = q.shape
-    block_q = _pick_block(t)
-    block_k = _pick_block(t, 256)
+    env = os.environ.get("OPENDILOCO_TPU_FLASH_BLOCKS")  # tuning: "bq,bk"
+    if env:
+        try:
+            eq, ek = (int(x) for x in env.split(","))
+        except ValueError:
+            raise ValueError(
+                f"OPENDILOCO_TPU_FLASH_BLOCKS={env!r}: expected 'block_q,block_k'"
+            ) from None
+        if eq % 128 or ek % 128:
+            raise ValueError(
+                f"OPENDILOCO_TPU_FLASH_BLOCKS={env!r}: blocks must be "
+                "multiples of 128 (TPU lane tiling)"
+            )
+        block_q, block_k = eq, ek
+    block_q = _pick_block(t, block_q)
+    block_k = _pick_block(t, block_k)
     if block_q == 0 or block_k == 0 or d % 8 != 0:
         from opendiloco_tpu.ops.attention import xla_attention
 

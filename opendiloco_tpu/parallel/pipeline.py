@@ -26,7 +26,13 @@ import functools
 import jax
 import jax.numpy as jnp
 
-from opendiloco_tpu.models.llama import LlamaConfig, _decoder_block
+from opendiloco_tpu.models.llama import (
+    LlamaConfig,
+    _decoder_block,
+    _maybe_remat,
+    _rope_tables,
+    RematPolicy,
+)
 from opendiloco_tpu.ops.attention import xla_attention
 
 
@@ -39,7 +45,7 @@ def pipeline_hidden(
     *,
     microbatches: int,
     attn_fn=None,
-    remat: bool = True,
+    remat: RematPolicy = True,
     axis: str = "pp",
 ) -> jax.Array:
     """Run the decoder stack as a pp-staged pipeline.
@@ -77,9 +83,11 @@ def pipeline_hidden(
         perm = [(i, i + 1) for i in range(n - 1)]  # stage r -> r+1, no wrap
 
         def stage(x, pos):
-            block = lambda h, layer: _decoder_block(cfg, attn_fn, h, layer, pos)
-            if remat:
-                block = jax.checkpoint(block)
+            rope = _rope_tables(pos, cfg.head_dim, cfg.rope_theta)
+            block = lambda h, layer: _decoder_block(
+                cfg, attn_fn, h, layer, pos, rope
+            )
+            block = _maybe_remat(block, remat)
             y, _ = jax.lax.scan(block, x, layers_local)
             return y
 

@@ -20,7 +20,13 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
-from opendiloco_tpu.models.llama import LlamaConfig, causal_lm_loss, forward, init_params
+from opendiloco_tpu.models.llama import (
+    LlamaConfig,
+    RematPolicy,
+    causal_lm_loss,
+    forward,
+    init_params,
+)
 from opendiloco_tpu.parallel.mesh import MeshPlan
 from opendiloco_tpu.parallel.sharding import optstate_specs, param_specs
 
@@ -38,7 +44,7 @@ class TrainerConfig:
     max_grad_norm: float = 1.0
     precision: str = "bf16-mixed"
     attn_impl: str = "xla"
-    remat: bool = True
+    remat: RematPolicy = True
     # fused lm-head + cross-entropy Pallas kernel (ops/fused_xent.py):
     # avoids materializing [tokens, vocab] float32 logits in HBM
     fused_loss: bool = False
