@@ -171,9 +171,18 @@ def _maybe_remat(block, remat: RematPolicy):
     if remat in (True, "full"):
         return jax.checkpoint(block)
     if remat == "dots":
+        # also save the flash-attention outputs (tagged in
+        # ops/flash_attention._flash_fwd): they are custom-calls, not dots,
+        # so the dots policy alone would rerun the whole forward kernel
+        # during backward just to rebuild its residuals
         return jax.checkpoint(
             block,
-            policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+            policy=jax.checkpoint_policies.save_from_both_policies(
+                jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+                jax.checkpoint_policies.save_only_these_names(
+                    "attn_out", "attn_lse"
+                ),
+            ),
         )
     raise ValueError(f"unknown remat policy {remat!r}")
 

@@ -31,6 +31,7 @@ import os
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -399,6 +400,11 @@ def _flash(q, k, v, block_q, block_k, causal):
 
 def _flash_fwd(q, k, v, block_q, block_k, causal):
     out, lse = _fwd(q, k, v, block_q=block_q, block_k=block_k, causal=causal)
+    # tag the kernel outputs so selective remat policies (llama._maybe_remat
+    # "dots") can save them -- without these names the backward pass reruns
+    # the whole forward kernel just to rebuild its residuals
+    out = checkpoint_name(out, "attn_out")
+    lse = checkpoint_name(lse, "attn_lse")
     return out, (q, k, v, out, lse)
 
 

@@ -91,6 +91,9 @@ def _fwd_kernel(
 
 
 def _fwd(h, w, labels, block_n, block_v, true_v):
+    # per-token vectors travel as [1, N] rows with (1, block_n) blocks: 1-D
+    # operands get a global XLA tiling tied to one block size, which breaks
+    # when forward and backward kernels pick different token blocks
     n, d = h.shape
     v = w.shape[1]
     grid = (n // block_n, v // block_v)
@@ -100,43 +103,35 @@ def _fwd(h, w, labels, block_n, block_v, true_v):
         in_specs=[
             pl.BlockSpec((block_n, d), lambda i, j: (i, 0)),
             pl.BlockSpec((d, block_v), lambda i, j: (0, j)),
-            pl.BlockSpec((block_n,), lambda i, j: (i,)),
+            pl.BlockSpec((1, block_n), lambda i, j: (0, i)),
         ],
         out_specs=[
-            pl.BlockSpec((block_n,), lambda i, j: (i,)),
-            pl.BlockSpec((block_n,), lambda i, j: (i,)),
+            pl.BlockSpec((1, block_n), lambda i, j: (0, i)),
+            pl.BlockSpec((1, block_n), lambda i, j: (0, i)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((n,), jnp.float32),
-            jax.ShapeDtypeStruct((n,), jnp.float32),
+            jax.ShapeDtypeStruct((1, n), jnp.float32),
+            jax.ShapeDtypeStruct((1, n), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_n, 1), jnp.float32),
             pltpu.VMEM((block_n, 1), jnp.float32),
             pltpu.VMEM((block_n, 1), jnp.float32),
         ],
-    )(h, w, labels)
-    return nll, lse
+    )(h, w, labels.reshape(1, n))
+    return nll.reshape(n), lse.reshape(n)
 
 
 # ---------------------------------------------------------------------------
-# backward: recompute tiles; dh accumulates over vocab tiles (scratch),
-# dw accumulates over token blocks (output revisiting)
+# backward: two kernels with transposed grids -- dh accumulates over vocab
+# tiles (scratch, vocab innermost), dw accumulates over token blocks
+# (scratch, tokens innermost); each recomputes its dlog tile from lse
 # ---------------------------------------------------------------------------
 
 
-def _bwd_kernel(
-    h_ref, w_ref, lbl_ref, lse_ref, g_ref, dh_ref, dw_ref, dh_s, *, block_v, true_v
-):
-    i = pl.program_id(0)
-    j = pl.program_id(1)
-    ni = pl.num_programs(0)
-    nv = pl.num_programs(1)
-
-    @pl.when(j == 0)
-    def _():
-        dh_s[:] = jnp.zeros_like(dh_s)
-
+def _recompute_dlog(h_ref, w_ref, lbl_ref, lse_ref, g_ref, j, *, block_v, true_v):
+    """Rebuild the softmax-xent gradient tile dlog = g * (p - onehot)
+    (bf16, [block_n, block_v]) from the forward residual lse."""
     hb = h_ref[:]
     wb = w_ref[:]
     s = jax.lax.dot_general(
@@ -152,53 +147,98 @@ def _bwd_kernel(
     onehot = (cols == local).astype(jnp.float32)
 
     g = g_ref[:].reshape(-1, 1)  # upstream per-token grad, 0 where ignored
-    dlog = (g * (p - onehot)).astype(hb.dtype)  # [block_n, block_v]
+    return (g * (p - onehot)).astype(hb.dtype)
 
+
+def _dh_kernel(
+    h_ref, w_ref, lbl_ref, lse_ref, g_ref, dh_ref, dh_s, *, block_v, true_v
+):
+    # grid (token_blocks, vocab_tiles): vocab innermost, dh accumulates in
+    # scratch over the consecutive j steps and flushes once per token block
+    j = pl.program_id(1)
+    nv = pl.num_programs(1)
+
+    @pl.when(j == 0)
+    def _():
+        dh_s[:] = jnp.zeros_like(dh_s)
+
+    dlog = _recompute_dlog(
+        h_ref, w_ref, lbl_ref, lse_ref, g_ref, j, block_v=block_v, true_v=true_v
+    )
     dh_s[:] = dh_s[:] + jax.lax.dot_general(
-        dlog, wb, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        dlog, w_ref[:], (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
     )
-
-    dw_update = jax.lax.dot_general(
-        hb, dlog, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
-    )
-
-    @pl.when(i == 0)
-    def _():
-        dw_ref[:] = dw_update.astype(dw_ref.dtype)
-
-    @pl.when(i > 0)
-    def _():
-        dw_ref[:] = dw_ref[:] + dw_update.astype(dw_ref.dtype)
 
     @pl.when(j == nv - 1)
     def _():
         dh_ref[:] = dh_s[:].astype(dh_ref.dtype)
 
 
+def _dw_kernel(
+    h_ref, w_ref, lbl_ref, lse_ref, g_ref, dw_ref, dw_s, *, block_v, true_v
+):
+    # grid (vocab_tiles, token_blocks): tokens innermost, dw accumulates in
+    # scratch over the consecutive i steps and flushes once per vocab tile.
+    # (A single kernel accumulating dw into its output across token blocks
+    # would revisit each dw tile on NON-consecutive grid steps, which Pallas
+    # output-revisiting does not support -- the write-back clobbers.)
+    j = pl.program_id(0)
+    i = pl.program_id(1)
+    ni = pl.num_programs(1)
+
+    @pl.when(i == 0)
+    def _():
+        dw_s[:] = jnp.zeros_like(dw_s)
+
+    dlog = _recompute_dlog(
+        h_ref, w_ref, lbl_ref, lse_ref, g_ref, j, block_v=block_v, true_v=true_v
+    )
+    dw_s[:] = dw_s[:] + jax.lax.dot_general(
+        h_ref[:], dlog, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+
+    @pl.when(i == ni - 1)
+    def _():
+        dw_ref[:] = dw_s[:].astype(dw_ref.dtype)
+
+
 def _bwd_impl(h, w, labels, lse, g, block_n, block_v, true_v):
     n, d = h.shape
     v = w.shape[1]
-    grid = (n // block_n, v // block_v)
-    dh, dw = pl.pallas_call(
-        functools.partial(_bwd_kernel, block_v=block_v, true_v=true_v),
-        grid=grid,
+    ni, nv = n // block_n, v // block_v
+    args = (h, w, labels.reshape(1, n), lse.reshape(1, n), g.reshape(1, n))
+    vec_spec_i = pl.BlockSpec((1, block_n), lambda i, j: (0, i))
+    vec_spec_j = pl.BlockSpec((1, block_n), lambda j, i: (0, i))
+    dh = pl.pallas_call(
+        functools.partial(_dh_kernel, block_v=block_v, true_v=true_v),
+        grid=(ni, nv),
         in_specs=[
             pl.BlockSpec((block_n, d), lambda i, j: (i, 0)),
             pl.BlockSpec((d, block_v), lambda i, j: (0, j)),
-            pl.BlockSpec((block_n,), lambda i, j: (i,)),
-            pl.BlockSpec((block_n,), lambda i, j: (i,)),
-            pl.BlockSpec((block_n,), lambda i, j: (i,)),
+            vec_spec_i,
+            vec_spec_i,
+            vec_spec_i,
         ],
-        out_specs=[
-            pl.BlockSpec((block_n, d), lambda i, j: (i, 0)),
-            pl.BlockSpec((d, block_v), lambda i, j: (0, j)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((n, d), jnp.float32),
-            jax.ShapeDtypeStruct((d, v), jnp.float32),
-        ],
+        # dh in the input dtype (cast happens in-kernel); an f32 output
+        # would double its VMEM block for no benefit
+        out_specs=pl.BlockSpec((block_n, d), lambda i, j: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((n, d), h.dtype),
         scratch_shapes=[pltpu.VMEM((block_n, d), jnp.float32)],
-    )(h, w, labels, lse, g)
+    )(*args)
+    dw = pl.pallas_call(
+        functools.partial(_dw_kernel, block_v=block_v, true_v=true_v),
+        grid=(nv, ni),
+        in_specs=[
+            pl.BlockSpec((block_n, d), lambda j, i: (i, 0)),
+            pl.BlockSpec((d, block_v), lambda j, i: (0, j)),
+            vec_spec_j,
+            vec_spec_j,
+            vec_spec_j,
+        ],
+        out_specs=pl.BlockSpec((d, block_v), lambda j, i: (0, j)),
+        out_shape=jax.ShapeDtypeStruct((d, v), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((d, block_v), jnp.float32)],
+    )(*args)
     return dh, dw
 
 
@@ -221,7 +261,12 @@ def _fused_fwd(h, w, labels, block_n, block_v, true_v):
 def _fused_bwd(block_n, block_v, true_v, res, g):
     h, w, labels, lse = res
     mask = (labels != IGNORE).astype(jnp.float32)
-    dh, dw = _bwd_impl(h, w, labels, lse, g * mask, block_n, block_v, true_v)
+    # the backward kernels carry [block_n, d] / [d, block_v] f32 scratch
+    # plus f32 score/prob tiles; block_n=1024 exceeds the 16MB scoped-vmem
+    # budget, so cap the token block (n is a multiple of 512 whenever
+    # block_n >= 512 was picked)
+    bn = min(block_n, 512)
+    dh, dw = _bwd_impl(h, w, labels, lse, g * mask, bn, block_v, true_v)
     return dh.astype(h.dtype), dw.astype(w.dtype), None
 
 
@@ -234,22 +279,33 @@ def fused_linear_cross_entropy(
     """Mean nll over non-ignored labels; h [N, D], w [D, V], labels [N].
 
     Vocabs that don't tile (e.g. Llama's 32000) are zero-padded up to the
-    next block_v multiple and masked in-kernel, so the MXU always sees
-    wide tiles instead of degrading to 128. Falls back to the
-    materializing path only when tokens or hidden don't tile.
+    next block_v multiple and masked in-kernel, so the MXU always sees wide
+    tiles instead of degrading to 128; token counts that don't tile (the
+    causal shift gives B*(T-1) rows) are row-padded with IGNORE labels.
+    Falls back to the materializing path only when hidden % 128 != 0.
     """
     n, d = h.shape
     v = w.shape[1]
-    block_n = _pick(n, 1024)
-    block_v = _pick(v, 2048)
     mask = labels != IGNORE
     count = jnp.maximum(jnp.sum(mask), 1)
-    if block_n == 0 or d % 128 != 0:
+    if d % 128 != 0:
         logits = (h.astype(jnp.float32) @ w.astype(jnp.float32))
         lp = jax.nn.log_softmax(logits, axis=-1)
         safe = jnp.where(mask, labels, 0)
         nll = -jnp.take_along_axis(lp, safe[:, None], axis=1)[:, 0] * mask
         return jnp.sum(nll) / count
+    block_n = _pick(n, 1024)
+    if block_n == 0:
+        # token count doesn't tile (e.g. the causal shift gives B*(T-1));
+        # pad rows up to the next 128 multiple with IGNORE labels -- they
+        # contribute 0 to nll (masked) and 0 to dh/dw (upstream grad is
+        # masked before the kernel)
+        n_pad = -(-n // 128) * 128
+        h = jnp.pad(h, ((0, n_pad - n), (0, 0)))
+        labels = jnp.pad(labels, (0, n_pad - n), constant_values=IGNORE)
+        n = n_pad
+        block_n = _pick(n, 1024)  # nonzero: n is a multiple of 128
+    block_v = _pick(v, 2048)
     if block_v < 512:
         # pad the head to the smallest wide tile (least dead columns);
         # padded logits are masked to -inf in the kernels (a small pad

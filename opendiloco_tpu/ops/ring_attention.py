@@ -32,6 +32,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 
 _NEG_INF = float(-1e30)
 
@@ -148,6 +149,10 @@ def ring_attention(
 
 def _ring_fwd(q, k, v, axis_name, causal):
     out, lse = _ring_forward(q, k, v, axis_name, causal)
+    # tag residuals so selective remat ("dots") saves them -- otherwise the
+    # backward pass replays the whole ring forward, ppermutes included
+    out = checkpoint_name(out, "attn_out")
+    lse = checkpoint_name(lse, "attn_lse")
     return out, (q, k, v, out, lse)
 
 

@@ -304,3 +304,41 @@ def test_fused_xent_padded_vocab_parity(interpret_pallas_fused):
         np.testing.assert_allclose(
             np.asarray(b), np.asarray(a), atol=2e-6 * max(scale, 1.0)
         )
+
+
+@pytest.mark.parametrize("n", [1024, 240])
+def test_fused_xent_multiblock_and_row_pad_parity(interpret_pallas_fused, n):
+    """Regression oracle for two backward-pass hazards: (a) dW accumulation
+    across MULTIPLE token blocks (n=1024 -> >=2 blocks in the dw kernel;
+    a single-kernel output-revisiting design silently dropped contributions
+    because the revisits are non-consecutive), and (b) token counts that
+    don't tile (n=240: the causal shift makes B*(T-1) rows) which must be
+    padded with IGNORE labels, not silently fall back."""
+    from opendiloco_tpu.ops.fused_xent import fused_linear_cross_entropy
+
+    rng = np.random.default_rng(7)
+    D, V = 128, 512
+    h = jnp.asarray(rng.normal(size=(n, D)), jnp.float32)
+    w = jnp.asarray(rng.normal(size=(D, V)) * 0.02, jnp.float32)
+    labels = jnp.asarray(rng.integers(0, V, n), jnp.int32)
+
+    def ref_loss(h, w, labels):
+        mask = labels != -100
+        logits = h.astype(jnp.float32) @ w.astype(jnp.float32)
+        lp = jax.nn.log_softmax(logits, axis=-1)
+        safe = jnp.where(mask, labels, 0)
+        nll = -jnp.take_along_axis(lp, safe[:, None], axis=1)[:, 0] * mask
+        return jnp.sum(nll) / jnp.maximum(jnp.sum(mask), 1)
+
+    np.testing.assert_allclose(
+        float(fused_linear_cross_entropy(h, w, labels)),
+        float(ref_loss(h, w, labels)),
+        rtol=1e-6,
+    )
+    gr = jax.grad(ref_loss, argnums=(0, 1))(h, w, labels)
+    gg = jax.grad(fused_linear_cross_entropy, argnums=(0, 1))(h, w, labels)
+    for a, b in zip(gr, gg):
+        scale = np.abs(np.asarray(a)).max()
+        np.testing.assert_allclose(
+            np.asarray(b), np.asarray(a), atol=2e-6 * max(scale, 1.0)
+        )
