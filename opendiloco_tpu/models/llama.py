@@ -209,10 +209,16 @@ def _rope_tables(
 
 
 def _rope_apply(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
-    """Rotate [B, T, H, D] by precomputed tables (HF half-rotation layout)."""
-    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
-    rot = jnp.concatenate((x1 * cos - x2 * sin, x2 * cos + x1 * sin), axis=-1)
-    return rot.astype(x.dtype)
+    """Rotate [B, T, H, D] by precomputed tables (HF half-rotation layout).
+
+    Rotation happens in x's dtype (HF llama applies rope in the input dtype
+    too): the tables are f32 but cos/sin magnitudes are <= 1, so bf16
+    rotation loses no more precision than the bf16 q/k it feeds -- and the
+    [B, T, H, D] elementwise chain stays off the f32 HBM budget."""
+    c = cos.astype(x.dtype)
+    s = sin.astype(x.dtype)
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    return jnp.concatenate((x1 * c - x2 * s, x2 * c + x1 * s), axis=-1)
 
 
 def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
