@@ -43,11 +43,13 @@ class TrainerConfig:
     total_steps: int = 88_000
     max_grad_norm: float = 1.0
     precision: str = "bf16-mixed"
-    attn_impl: str = "xla"
+    # "auto" resolves at trainer build: pallas on TPU meshes, xla elsewhere
+    attn_impl: str = "auto"
     remat: RematPolicy = True
     # fused lm-head + cross-entropy Pallas kernel (ops/fused_xent.py):
-    # avoids materializing [tokens, vocab] float32 logits in HBM
-    fused_loss: bool = False
+    # avoids materializing [tokens, vocab] float32 logits in HBM.
+    # None = auto (TPU dense models on, otherwise off)
+    fused_loss: Optional[bool] = None
     pp_microbatches: Optional[int] = None  # pipeline microbatches (None = pp size)
     # fp16 dynamic loss scaling (torch GradScaler parity, train_fsdp.py:228,
     # 383-405; bf16 needs none -- the reference itself recommends bf16)
@@ -92,6 +94,42 @@ def make_inner_optimizer(tc: TrainerConfig) -> optax.GradientTransformation:
     )
 
 
+def _resolve_perf_defaults(
+    tc: TrainerConfig, model_cfg: LlamaConfig, plan: MeshPlan
+) -> TrainerConfig:
+    """Resolve attn_impl="auto" / fused_loss=None to concrete choices.
+
+    On TPU meshes the Pallas kernels won the on-chip sweep (v5e, llama-150m
+    seq 1024: flash attention +20% tokens/sec over XLA attention, fused
+    lm-head+xent a further gain on top) and become the defaults; every other
+    backend (the CPU test mesh included) keeps the portable XLA paths.
+    Explicit user choices pass through untouched.
+    """
+    if tc.attn_impl != "auto" and tc.fused_loss is not None:
+        return tc
+    dev = plan.mesh.devices.flat[0]
+    on_tpu = "tpu" in getattr(dev, "device_kind", "").lower()
+    changes: dict = {}
+    if tc.attn_impl == "auto":
+        changes["attn_impl"] = "pallas" if on_tpu else "xla"
+    if tc.fused_loss is None:
+        # auto-on only where the sweep measured a win: pallas attention on a
+        # non-sequence-parallel mesh (xla+fused measured slower than xla
+        # alone). MoE keeps the standard loss (the fused kernel does not
+        # thread the router aux loss -- the explicit-True path raises for
+        # this combo); sequence-parallel meshes keep it too: the fused
+        # kernel is not sequence-sharded and would gather the full
+        # [B*T, d] activations per device
+        attn = changes.get("attn_impl", tc.attn_impl)
+        changes["fused_loss"] = (
+            on_tpu
+            and not model_cfg.num_experts
+            and attn == "pallas"
+            and getattr(plan, "sp_axis", None) is None
+        )
+    return dataclasses.replace(tc, **changes)
+
+
 class InnerTrainer:
     """Owns the optimizer, shardings, and the compiled train/eval steps.
 
@@ -99,6 +137,7 @@ class InnerTrainer:
     """
 
     def __init__(self, model_cfg: LlamaConfig, tc: TrainerConfig, plan: MeshPlan):
+        tc = _resolve_perf_defaults(tc, model_cfg, plan)
         self.model_cfg = model_cfg
         self.tc = tc
         self.plan = plan

@@ -185,3 +185,60 @@ def test_mesh_shapes_per_strategy():
     plan = build_mesh("NO_SHARD", sp_size=2, tp_size=2)
     assert plan.mesh.shape == {"pp": 1, "dp": 2, "fsdp": 1, "ep": 1, "sp": 2, "tp": 2}
     assert plan.data_parallel_size == 2
+
+
+def test_auto_perf_defaults_resolve_to_xla_off_tpu(tiny_cfg):
+    # "auto"/None must resolve against the mesh's device kind: on the CPU
+    # test mesh that means the portable XLA attention and no fused loss
+    # (on TPU meshes the same defaults pick pallas + fused; sweep-measured)
+    import dataclasses
+
+    trainer = InnerTrainer(tiny_cfg, TrainerConfig(), build_mesh("NO_SHARD"))
+    assert trainer.tc.attn_impl == "xla"
+    assert trainer.tc.fused_loss is False
+
+    # explicit choices pass through untouched
+    tc = TrainerConfig(attn_impl="xla", fused_loss=True)
+    trainer = InnerTrainer(tiny_cfg, tc, build_mesh("NO_SHARD"))
+    assert trainer.tc.fused_loss is True
+
+    # auto never turns fused_loss on for MoE (kernel lacks router aux loss)
+    moe_cfg = dataclasses.replace(tiny_cfg, num_experts=2)
+    trainer = InnerTrainer(moe_cfg, TrainerConfig(), build_mesh("NO_SHARD"))
+    assert trainer.tc.fused_loss is False
+
+
+def test_auto_perf_defaults_on_tpu_device_kind(tiny_cfg):
+    # drive the resolver with a faked TPU device kind: dense models get
+    # pallas + fused; ring attention and MoE keep the standard loss
+    import dataclasses
+    from types import SimpleNamespace
+
+    from opendiloco_tpu.trainer import _resolve_perf_defaults
+
+    real_plan = build_mesh("NO_SHARD")
+    dev = SimpleNamespace(device_kind="TPU v5 lite")
+    devices = SimpleNamespace(flat=[dev])
+    plan = SimpleNamespace(mesh=SimpleNamespace(devices=devices), sp_axis=None)
+
+    tc = _resolve_perf_defaults(TrainerConfig(), tiny_cfg, plan)
+    assert tc.attn_impl == "pallas" and tc.fused_loss is True
+
+    tc = _resolve_perf_defaults(TrainerConfig(attn_impl="ring"), tiny_cfg, plan)
+    assert tc.fused_loss is False
+
+    # explicit xla attention: fused measured slower than unfused there
+    tc = _resolve_perf_defaults(TrainerConfig(attn_impl="xla"), tiny_cfg, plan)
+    assert tc.fused_loss is False
+
+    # sequence-parallel mesh: fused kernel is not sequence-sharded
+    sp_plan = SimpleNamespace(mesh=plan.mesh, sp_axis="sp")
+    tc = _resolve_perf_defaults(TrainerConfig(), tiny_cfg, sp_plan)
+    assert tc.attn_impl == "pallas" and tc.fused_loss is False
+
+    moe_cfg = dataclasses.replace(tiny_cfg, num_experts=2)
+    tc = _resolve_perf_defaults(TrainerConfig(), moe_cfg, plan)
+    assert tc.attn_impl == "pallas" and tc.fused_loss is False
+
+    # a real plan's mesh exposes the same .devices.flat[0] protocol
+    assert hasattr(real_plan.mesh.devices.flat[0], "device_kind")
