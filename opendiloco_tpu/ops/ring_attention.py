@@ -55,12 +55,17 @@ def _grouped(q: jax.Array, hkv: int) -> jax.Array:
 
 
 def _scores(qg: jax.Array, k: jax.Array, q_pos, k_pos, *, causal) -> jax.Array:
-    """Masked attention logits [B, Hkv, G, Tq, Tk] (float32)."""
+    """Masked attention logits [B, Hkv, G, Tq, Tk] (float32).
+
+    Matmul operands stay in the input dtype (bf16 in production -- f32
+    inputs run the v5e MXU at a fraction of bf16 rate, same discipline as
+    the flash kernel); accumulation is f32 via preferred_element_type.
+    """
     d = qg.shape[-1]
     s = jnp.einsum(
         "bqhgd,bkhd->bhgqk",
         qg,
-        k.astype(jnp.float32),
+        k,
         preferred_element_type=jnp.float32,
     ) * (d**-0.5)
     if causal:
@@ -82,8 +87,8 @@ def _block_attn(qg, k, v, q_pos, k_pos, m, l, acc, *, causal):
     l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
     acc_new = acc * corr + jnp.einsum(
         "bhgqk,bkhd->bhgqd",
-        p,
-        v.astype(jnp.float32),
+        p.astype(v.dtype),
+        v,
         preferred_element_type=jnp.float32,
     )
     return m_new, l_new, acc_new
@@ -93,7 +98,7 @@ def _ring_forward(q, k, v, axis_name, causal):
     """-> (out [B, Tl, Hq, D], lse [B, Hkv, G, Tq, 1] float32)."""
     b, tl, hq, d = q.shape
     hkv = k.shape[2]
-    qg = _grouped(q.astype(jnp.float32), hkv)
+    qg = _grouped(q, hkv)
 
     idx = jax.lax.axis_index(axis_name)
     n = jax.lax.axis_size(axis_name)
@@ -164,11 +169,14 @@ def _ring_bwd(axis_name, causal, res, dout):
     hkv = k.shape[2]
     scale = d**-0.5
 
-    qg = _grouped(q.astype(jnp.float32), hkv)
-    dog = _grouped(dout.astype(jnp.float32), hkv)
-    outg = _grouped(out.astype(jnp.float32), hkv)
-    # D_i = rowsum(dO * O): [B, Hkv, G, Tq, 1]
-    D = jnp.sum(dog * outg, axis=-1).transpose(0, 2, 3, 1)[..., None]
+    qg = _grouped(q, hkv)
+    dog = _grouped(dout, hkv)
+    # D_i = rowsum(dO * O): [B, Hkv, G, Tq, 1] -- elementwise, keep f32
+    D = jnp.sum(
+        _grouped(dout.astype(jnp.float32), hkv)
+        * _grouped(out.astype(jnp.float32), hkv),
+        axis=-1,
+    ).transpose(0, 2, 3, 1)[..., None]
 
     idx = jax.lax.axis_index(axis_name)
     n = jax.lax.axis_size(axis_name)
@@ -182,23 +190,29 @@ def _ring_bwd(axis_name, causal, res, dout):
         s = _scores(qg, k_cur, q_pos, k_pos, causal=causal)
         p = jnp.exp(s - lse)  # masked entries underflow to exactly 0
         dv_cur = dv_cur + jnp.einsum(
-            "bhgqk,bqhgd->bkhd", p, dog, preferred_element_type=jnp.float32
+            "bhgqk,bqhgd->bkhd",
+            p.astype(dout.dtype),
+            dog,
+            preferred_element_type=jnp.float32,
         )
         dp = jnp.einsum(
             "bqhgd,bkhd->bhgqk",
             dog,
-            v_cur.astype(jnp.float32),
+            v_cur,
             preferred_element_type=jnp.float32,
         )
         ds = p * (dp - D)
         dq = dq + scale * jnp.einsum(
             "bhgqk,bkhd->bqhgd",
-            ds,
-            k_cur.astype(jnp.float32),
+            ds.astype(k_cur.dtype),
+            k_cur,
             preferred_element_type=jnp.float32,
         )
         dk_cur = dk_cur + scale * jnp.einsum(
-            "bhgqk,bqhgd->bkhd", ds, qg, preferred_element_type=jnp.float32
+            "bhgqk,bqhgd->bkhd",
+            ds.astype(qg.dtype),
+            qg,
+            preferred_element_type=jnp.float32,
         )
         rotated = [
             jax.lax.ppermute(x, axis_name, perm)

@@ -342,3 +342,55 @@ def test_fused_xent_multiblock_and_row_pad_parity(interpret_pallas_fused, n):
         np.testing.assert_allclose(
             np.asarray(b), np.asarray(a), atol=2e-6 * max(scale, 1.0)
         )
+
+
+def test_ring_attention_bf16_inputs(qkv):
+    """bf16 q/k/v (the production mixed-precision path) keep matmul operands
+    bf16 for the MXU while online-softmax stats stay f32; result must track
+    the xla bf16 attention within bf16 tolerance."""
+    from opendiloco_tpu.ops import ring_attention as ra
+
+    q, k, v = (x.astype(jnp.bfloat16) for x in qkv)
+    devices = np.asarray(jax.devices()[:4]).reshape(1, 1, 4, 1)
+    mesh = jax.sharding.Mesh(devices, ("dp", "fsdp", "sp", "tp"))
+    ra.configure_ring(mesh, "sp")
+    try:
+        ref = xla_attention(q, k, v, causal=True)
+        got = jax.jit(ra.ring_attention_auto)(q, k, v)
+        assert got.dtype == jnp.bfloat16
+        np.testing.assert_allclose(
+            np.asarray(got, np.float32), np.asarray(ref, np.float32), atol=2e-2
+        )
+    finally:
+        ra.configure_ring(None)
+
+
+def test_ring_attention_bf16_grads(qkv):
+    """Gradient parity on the production bf16 path: the backward ring
+    recurrence recomputes scores from bf16 operands and casts p/ds for the
+    MXU; gradients must track the xla bf16 backward within bf16 tolerance."""
+    from opendiloco_tpu.ops import ring_attention as ra
+
+    q, k, v = (x.astype(jnp.bfloat16) for x in qkv)
+    devices = np.asarray(jax.devices()[:4]).reshape(1, 1, 4, 1)
+    mesh = jax.sharding.Mesh(devices, ("dp", "fsdp", "sp", "tp"))
+    ra.configure_ring(mesh, "sp")
+    try:
+
+        def loss_ring(q, k, v):
+            return jnp.sum(ra.ring_attention_auto(q, k, v).astype(jnp.float32) ** 2)
+
+        def loss_ref(q, k, v):
+            return jnp.sum(
+                xla_attention(q, k, v, causal=True).astype(jnp.float32) ** 2
+            )
+
+        gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        gg = jax.jit(jax.grad(loss_ring, argnums=(0, 1, 2)))(q, k, v)
+        for a, b in zip(gr, gg):
+            a = np.asarray(a, np.float32)
+            b = np.asarray(b, np.float32)
+            scale = np.abs(a).max()
+            np.testing.assert_allclose(b, a, atol=4e-2 * max(scale, 1.0))
+    finally:
+        ra.configure_ring(None)
