@@ -107,8 +107,12 @@ def _fwd_kernel(
         lse_ref[:] = (m_scr[:] + jnp.log(l_safe)).reshape(1, block_q)
 
 
-def _fwd(q, k, v, *, block_q: int, block_k: int, causal: bool):
-    """q: [B, Hq, T, D]; k/v: [B, Hkv, T, D] -> (out [B, Hq, T, D], lse [B, Hq, 1, T])."""
+def _fwd(q, k, v, *, block_q: int, block_k: int, causal: bool, vma=None):
+    """q: [B, Hq, T, D]; k/v: [B, Hkv, T, D] -> (out [B, Hq, T, D], lse [B, Hq, 1, T]).
+
+    ``vma``: varying-manual-axes annotation for the outputs, required when
+    called inside a shard_map manual region (the ring-attention chunks).
+    """
     b, hq, t, d = q.shape
     hkv = k.shape[1]
     rep = hq // hkv
@@ -145,8 +149,8 @@ def _fwd(q, k, v, *, block_q: int, block_k: int, causal: bool):
             ),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct(q.shape, q.dtype),
-            jax.ShapeDtypeStruct((b, hq, 1, t), jnp.float32),
+            jax.ShapeDtypeStruct(q.shape, q.dtype, vma=vma),
+            jax.ShapeDtypeStruct((b, hq, 1, t), jnp.float32, vma=vma),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, 1), jnp.float32),
@@ -270,18 +274,35 @@ def _dkv_kernel(
         dv_ref[:] = dv_scr[:].astype(dv_ref.dtype)
 
 
+def _delta(dout, out):
+    """delta = rowsum(dO * O), f32: [B, Hq, T, D] -> [B, Hq, 1, T]."""
+    b, hq, t, _ = out.shape
+    return jnp.sum(
+        dout.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
+    ).reshape(b, hq, 1, t)
+
+
 def _bwd(block_q, block_k, causal, res, dout):
     q, k, v, out, lse = res
+    return _bwd_impl(
+        q, k, v, dout, lse, _delta(dout, out),
+        block_q=block_q, block_k=block_k, causal=causal,
+    )
+
+
+def _bwd_impl(
+    q, k, v, dout, lse, delta, *, block_q, block_k, causal, grad_dtype=None,
+    vma=None,
+):
+    """Backward kernels with delta precomputed. ``grad_dtype`` overrides the
+    output dtype and ``vma`` annotates varying manual axes (both used by the
+    ring-attention chunk path, which accumulates f32 inside shard_map)."""
     b, hq, t, d = q.shape
     hkv = k.shape[1]
     rep = hq // hkv
     scale = d**-0.5
     num_k = t // block_k
     num_q = t // block_q
-
-    delta = jnp.sum(
-        dout.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
-    ).reshape(b, hq, 1, t)
 
     if causal:
         def kv_map(bi, hi, qi, ki):
@@ -313,7 +334,7 @@ def _bwd(block_q, block_k, causal, res, dout):
         out_specs=pl.BlockSpec(
             (None, None, block_q, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)
         ),
-        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        out_shape=jax.ShapeDtypeStruct(q.shape, grad_dtype or q.dtype, vma=vma),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
@@ -372,8 +393,8 @@ def _bwd(block_q, block_k, causal, res, dout):
             ),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct(k.shape, k.dtype),
-            jax.ShapeDtypeStruct(v.shape, v.dtype),
+            jax.ShapeDtypeStruct(k.shape, grad_dtype or k.dtype, vma=vma),
+            jax.ShapeDtypeStruct(v.shape, grad_dtype or v.dtype, vma=vma),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_k, d), jnp.float32),

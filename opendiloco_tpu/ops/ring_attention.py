@@ -28,6 +28,7 @@ triangle-masked.
 from __future__ import annotations
 
 import functools
+import os
 from typing import Optional
 
 import jax
@@ -235,6 +236,166 @@ def _ring_bwd(axis_name, causal, res, dout):
 ring_attention.defvjp(_ring_fwd, _ring_bwd)
 
 
+# ---------------------------------------------------------------------------
+# flash-chunk ring: per-rotation block math runs the Pallas flash kernels
+# ---------------------------------------------------------------------------
+#
+# Same ring schedule as above, but each rotation step processes its K/V chunk
+# with the flash-attention Pallas kernels (ops/flash_attention.py) instead of
+# XLA einsums: the [Tl, Tl] score matrix never reaches HBM and the per-chunk
+# softmax runs fused in VMEM. Per-chunk (out, lse) pairs merge with the
+# standard log-sum-exp recurrence, which is exactly the online-softmax merge
+# the einsum path carries, so results are identical up to rounding. The
+# rotation schedule is causal-aware: step 0 is the diagonal chunk (causal
+# flash), later steps run the unmasked kernel only when the held chunk is
+# from an earlier ring position (lax.cond skips future chunks).
+
+
+def _ring_flash_forward(q, k, v, axis_name, block):
+    """q [B,Tl,Hq,D], k/v [B,Tl,Hkv,D] -> (out [B,Tl,Hq,D], lse [B,Hq,1,Tl])."""
+    from opendiloco_tpu.ops.flash_attention import _fwd
+
+    qT, kT, vT = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
+    vma = frozenset({axis_name})
+
+    idx = jax.lax.axis_index(axis_name)
+    n = jax.lax.axis_size(axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    # step 0: own (diagonal) chunk, standard causal flash -- guarantees a
+    # finite lse for every query row before any merge
+    o, lse = _fwd(qT, kT, vT, block_q=block, block_k=block, causal=True, vma=vma)
+    o = o.astype(jnp.float32)
+
+    def step(carry, i):
+        k_c, v_c, o, lse = carry
+        k_c = jax.lax.ppermute(k_c, axis_name, perm)
+        v_c = jax.lax.ppermute(v_c, axis_name, perm)
+        src = (idx - i) % n  # ring position of the chunk we now hold
+
+        def live(ops):
+            kk, vv = ops
+            oi, lsei = _fwd(
+                qT, kk, vv, block_q=block, block_k=block, causal=False, vma=vma
+            )
+            return oi.astype(jnp.float32), lsei
+
+        def dead(ops):
+            # future chunk: contributes nothing (lse=-inf merges to a no-op)
+            return jnp.zeros_like(o), jnp.full_like(lse, _NEG_INF)
+
+        oi, lsei = jax.lax.cond(src < idx, live, dead, (k_c, v_c))
+        lse_new = jnp.logaddexp(lse, lsei)
+        # weights are [B,Hq,1,Tl]; swap to [B,Hq,Tl,1] to scale the outputs
+        w = jnp.swapaxes(jnp.exp(lse - lse_new), -1, -2)
+        wi = jnp.swapaxes(jnp.exp(lsei - lse_new), -1, -2)
+        o = o * w + oi * wi
+        return (k_c, v_c, o, lse_new), None
+
+    (_, _, o, lse), _ = jax.lax.scan(step, (kT, vT, o, lse), jnp.arange(1, n))
+    out = o.transpose(0, 2, 1, 3).astype(q.dtype)
+    return out, lse
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def ring_flash_attention(q, k, v, axis_name, block):
+    """Causal ring attention with Pallas flash per-chunk kernels.
+
+    Must run inside shard_map with the sequence dim sharded on axis_name;
+    Tl must tile by ``block`` (the caller gates on this).
+    """
+    out, _ = _ring_flash_forward(q, k, v, axis_name, block)
+    return out
+
+
+def _ring_flash_fwd(q, k, v, axis_name, block):
+    out, lse = _ring_flash_forward(q, k, v, axis_name, block)
+    out = checkpoint_name(out, "attn_out")
+    lse = checkpoint_name(lse, "attn_lse")
+    return out, (q, k, v, out, lse)
+
+
+def _ring_flash_bwd(axis_name, block, res, dout):
+    """Flash backward per chunk with the global lse; dK/dV accumulators
+    (f32) rotate with their chunks, one extra rotation brings them home."""
+    from opendiloco_tpu.ops.flash_attention import _bwd_impl, _delta
+
+    q, k, v, out, lse = res
+    qT, kT, vT, oT, doT = (
+        x.transpose(0, 2, 1, 3) for x in (q, k, v, out, dout)
+    )
+    delta = _delta(doT, oT)
+
+    idx = jax.lax.axis_index(axis_name)
+    n = jax.lax.axis_size(axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    kwargs = dict(
+        block_q=block,
+        block_k=block,
+        grad_dtype=jnp.float32,
+        vma=frozenset({axis_name}),
+    )
+    dq, dk, dv = _bwd_impl(qT, kT, vT, doT, lse, delta, causal=True, **kwargs)
+
+    def step(carry, i):
+        k_c, v_c, dk, dv, dq = carry
+        k_c, v_c, dk, dv = (
+            jax.lax.ppermute(x, axis_name, perm) for x in (k_c, v_c, dk, dv)
+        )
+        src = (idx - i) % n
+
+        def live(ops):
+            kk, vv = ops
+            return _bwd_impl(qT, kk, vv, doT, lse, delta, causal=False, **kwargs)
+
+        def dead(ops):
+            return jnp.zeros_like(dq), jnp.zeros_like(dk), jnp.zeros_like(dv)
+
+        dqi, dki, dvi = jax.lax.cond(src < idx, live, dead, (k_c, v_c))
+        return (k_c, v_c, dk + dki, dv + dvi, dq + dqi), None
+
+    (_, _, dk, dv, dq), _ = jax.lax.scan(
+        step, (kT, vT, dk, dv, dq), jnp.arange(1, n)
+    )
+    # n-1 in-scan rotations + this one = full revolution: grads are home
+    dk = jax.lax.ppermute(dk, axis_name, perm)
+    dv = jax.lax.ppermute(dv, axis_name, perm)
+    dq = dq.transpose(0, 2, 1, 3).astype(q.dtype)
+    dk = dk.transpose(0, 2, 1, 3).astype(k.dtype)
+    dv = dv.transpose(0, 2, 1, 3).astype(v.dtype)
+    return dq, dk, dv
+
+
+ring_flash_attention.defvjp(_ring_flash_fwd, _ring_flash_bwd)
+
+
+def _flash_chunk_block(mesh, axis: str, q, causal: bool) -> int:
+    """Block size for the flash-chunk ring path, or 0 for the einsum path.
+
+    Flash chunks need: causal attention, a TPU mesh (or the
+    OPENDILOCO_TPU_RING_FLASH=1 override for interpret-mode tests), a local
+    chunk length that tiles by 128, and a lane-aligned head dim.
+    """
+    if not causal:
+        return 0
+    env = os.environ.get("OPENDILOCO_TPU_RING_FLASH", "").strip().lower()
+    if env in ("0", "false", "no", "off"):
+        return 0
+    if env not in ("1", "true", "yes", "on"):
+        # unset (or unrecognized): the Pallas path is TPU-only
+        dev = mesh.devices.flat[0]
+        if "tpu" not in getattr(dev, "device_kind", "").lower():
+            return 0
+    from opendiloco_tpu.ops.flash_attention import _pick_block
+
+    n = mesh.shape[axis]
+    tl = q.shape[1] // n
+    if q.shape[-1] % 8:
+        return 0
+    return _pick_block(tl, 1024)
+
+
 def ring_attention_auto(
     q: jax.Array, k: jax.Array, v: jax.Array, *, mesh=None, axis: Optional[str] = None
 ) -> jax.Array:
@@ -253,9 +414,14 @@ def ring_attention_auto(
         )
     P = jax.sharding.PartitionSpec
     spec = P(None, axis, None, None)
-    fn = jax.shard_map(
+    block = _flash_chunk_block(mesh, axis, q, causal=True)
+    if block:
+        body = lambda q, k, v: ring_flash_attention(q, k, v, axis, block)
+    else:
         # positional args: custom_vjp nondiff_argnums are position-based
-        lambda q, k, v: ring_attention(q, k, v, axis, True),
+        body = lambda q, k, v: ring_attention(q, k, v, axis, True)
+    fn = jax.shard_map(
+        body,
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
