@@ -6,11 +6,14 @@ The reference publishes no in-tree numbers (BASELINE.md); the driver-specified
 north-star is >=40% inner-loop MFU on llama-150m (BASELINE.json). We report
 tokens/sec/chip and vs_baseline = achieved_MFU / 0.40.
 
-Sweeps the perf-kernel variants (XLA baseline first so a number is banked
-early, then pallas attention and the fused lm-head+xent kernel) and reports
-the fastest; a wedged accelerator or a variant that fails to compile loses
-that variant, not the whole bench. Set OPENDILOCO_TPU_BENCH_ATTN /
-OPENDILOCO_TPU_BENCH_FUSED to pin a single variant.
+Sweeps perf variants -- the measured-best pallas+fused first (hits the
+persistent compile cache, banks a nonzero number early), then the remat
+policies (False/"dots" trade memory for recompute FLOPs), then the XLA
+baseline for the comparison row -- and reports the fastest; a wedged
+accelerator or a variant that fails to compile loses that variant, not the
+whole bench. Pin a single variant with OPENDILOCO_TPU_BENCH_ATTN /
+OPENDILOCO_TPU_BENCH_FUSED / OPENDILOCO_TPU_BENCH_REMAT
+(true|false|dots).
 """
 
 import json
@@ -113,7 +116,9 @@ def _watchdog(seconds: float):
     return t
 
 
-def _run_variant(cfg, attn: str, fused: bool, seq: int, bs: int, accum: int):
+def _run_variant(
+    cfg, attn: str, fused: bool, seq: int, bs: int, accum: int, remat=True
+):
     import jax
 
     from opendiloco_tpu.parallel.mesh import build_mesh
@@ -121,7 +126,7 @@ def _run_variant(cfg, attn: str, fused: bool, seq: int, bs: int, accum: int):
 
     tc = TrainerConfig(
         lr=4e-4, warmup_steps=10, total_steps=1000, precision="bf16-mixed",
-        attn_impl=attn, remat=True, fused_loss=fused,
+        attn_impl=attn, remat=remat, fused_loss=fused,
     )
     trainer = InnerTrainer(cfg, tc, build_mesh("NO_SHARD"))
     state = trainer.init_state(jax.random.key(0))
@@ -183,21 +188,35 @@ def main():
 
     env_attn = os.environ.get("OPENDILOCO_TPU_BENCH_ATTN")
     env_fused = os.environ.get("OPENDILOCO_TPU_BENCH_FUSED")
-    if env_attn or env_fused:
+    env_remat = os.environ.get("OPENDILOCO_TPU_BENCH_REMAT")
+    if env_attn or env_fused or env_remat:
         # pinned single variant; FUSED=1 alone keeps the historical default
         # of pallas attention (the round-1 toggle semantics)
+        remat = {"false": False, "true": True}.get(
+            (env_remat or "true").lower(), env_remat
+        )
         variants = [
-            (env_attn or "pallas", (env_fused or "0") in ("1", "true"))
+            (env_attn or "pallas", (env_fused or "0") in ("1", "true"), remat)
         ]
     else:
-        # known-good baseline first (banks a nonzero number early), then
-        # the perf kernels; a flaky remote compile skips a variant only
-        variants = [("xla", False), ("pallas", False), ("pallas", True), ("xla", True)]
+        # measured-best first (hits the persistent compile cache and banks a
+        # nonzero number early), then the remat levers (full remat re-runs
+        # the forward -- dropping it buys FLOPs when activations fit HBM),
+        # then the xla baseline for the comparison row; a flaky remote
+        # compile or OOM loses a variant only
+        variants = [
+            ("pallas", True, True),
+            ("pallas", True, False),
+            ("pallas", True, "dots"),
+            ("xla", False, True),
+        ]
 
-    for attn, fused in variants:
-        name = f"{attn}{'+fused' if fused else ''}"
+    for attn, fused, remat in variants:
+        name = f"{attn}{'+fused' if fused else ''}+remat={remat}"
         try:
-            _RESULTS[name] = _run_variant(cfg, attn, fused, seq, bs, accum)
+            _RESULTS[name] = _run_variant(
+                cfg, attn, fused, seq, bs, accum, remat=remat
+            )
         except Exception as e:  # compile flake / OOM: lose the variant only
             print(f"# variant {name} failed: {e}", flush=True)
 
