@@ -252,14 +252,7 @@ def test_bulk_data_plane_carries_large_frames(monkeypatch):
 
     monkeypatch.setenv("ODTP_BULK_THRESHOLD", "1")  # everything goes bulk
     seen = []
-    orig_read = bulk_mod.read_frame_sync
-
-    def counting_read(sock):
-        r = orig_read(sock)
-        seen.append(r[0])
-        return r
-
-    monkeypatch.setattr(bulk_mod, "read_frame_sync", counting_read)
+    monkeypatch.setattr(bulk_mod, "_frame_observer", seen.append)
     server = RendezvousServer(host="127.0.0.1", port=0).start_in_thread()
     backends = [
         TcpBackend([server.address], peer_id=f"w{i}", matchmaking_time=1.0)
@@ -271,6 +264,68 @@ def test_bulk_data_plane_carries_large_frames(monkeypatch):
             assert group == 2
             np.testing.assert_allclose(out[0], 1.5)
         assert "push" in seen and "result" in seen
+    finally:
+        for b in backends:
+            b.close()
+        server.stop()
+
+
+def test_bulk_striped_transfer_roundtrip(monkeypatch):
+    """Frames above the stripe floor split over parallel TCP streams and
+    reassemble zero-copy into one buffer; bytes must survive exactly."""
+    from opendiloco_tpu.diloco import bulk as bulk_mod
+
+    monkeypatch.setenv("ODTP_BULK_STREAMS", "3")
+    monkeypatch.setenv("ODTP_BULK_STRIPE_MIN", "1024")
+    got = []
+    done = __import__("threading").Event()
+
+    def deliver(msg, meta, payload):
+        got.append((msg, meta, payload.copy()))
+        done.set()
+
+    server = bulk_mod.BulkServer(deliver, host="127.0.0.1")
+    sender = bulk_mod.BulkSender()
+    try:
+        rng = np.random.default_rng(3)
+        data = rng.integers(0, 255, 1_000_003, np.uint8)  # odd size: uneven stripes
+        sender.send("127.0.0.1", server.port, "push", {"k": 1}, data)
+        assert done.wait(20.0)
+        msg, meta, payload = got[0]
+        assert msg == "push" and meta == {"k": 1}
+        np.testing.assert_array_equal(payload, data)
+        # sub-floor payloads stay single-stream
+        done.clear()
+        small = rng.integers(0, 255, 64, np.uint8)
+        sender.send("127.0.0.1", server.port, "push", {"k": 2}, small)
+        assert done.wait(20.0)
+        np.testing.assert_array_equal(got[1][2], small)
+    finally:
+        sender.close()
+        server.stop()
+
+
+def test_bulk_striped_allreduce(monkeypatch):
+    """End-to-end butterfly all-reduce with striping forced on: results
+    stay exact and _stripe frames actually travel."""
+    from opendiloco_tpu.diloco import bulk as bulk_mod
+
+    monkeypatch.setenv("ODTP_BULK_THRESHOLD", "1")
+    monkeypatch.setenv("ODTP_BULK_STREAMS", "3")
+    monkeypatch.setenv("ODTP_BULK_STRIPE_MIN", "64")
+    seen = []
+    monkeypatch.setattr(bulk_mod, "_frame_observer", seen.append)
+    server = RendezvousServer(host="127.0.0.1", port=0).start_in_thread()
+    backends = [
+        TcpBackend([server.address], peer_id=f"w{i}", matchmaking_time=1.0)
+        for i in range(2)
+    ]
+    try:
+        data = [[np.full(4096, float(i + 1), np.float32)] for i in range(2)]
+        for out, group in concurrent_allreduce(backends, data, timeout=30.0):
+            assert group == 2
+            np.testing.assert_allclose(out[0], 1.5)
+        assert "_stripe" in seen
     finally:
         for b in backends:
             b.close()
