@@ -57,7 +57,11 @@ def _listdir(path: str) -> list[str]:
 
         fs, _, (p,) = fsspec.get_fs_token_paths(path)
         try:
-            return [x.rstrip("/").split("/")[-1] for x in fs.ls(p)]
+            # detail=False: AbstractFileSystem.ls defaults to detail=True on
+            # several backends (memory, gcs), which returns info dicts
+            return [
+                x.rstrip("/").split("/")[-1] for x in fs.ls(p, detail=False)
+            ]
         except FileNotFoundError:
             return []
     try:

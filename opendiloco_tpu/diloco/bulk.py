@@ -47,7 +47,17 @@ log = get_text_logger(__name__)
 
 _HDR = struct.Struct(">4sI")
 _ACK = b"\x01"
-_STRIPE_WAIT_S = 300.0  # stripe channels must land within the transfer budget
+def _stripe_wait_s() -> float:
+    """Stripe channels must land within the transfer budget; tunable so a
+    deployment with a known round budget can fail a lost stripe faster than
+    the 5-minute default (the retry path then re-forms the group)."""
+    try:
+        return float(os.environ.get("ODTP_BULK_STRIPE_WAIT_S", "300"))
+    except ValueError:
+        return 300.0
+
+
+_TOMBSTONE_S = 60.0  # how long finished session ids stay known-dead
 
 # test seam: called with every received frame's type ("push", "result",
 # "_stripe", ...) from BulkServer handler threads
@@ -188,6 +198,11 @@ class BulkServer:
         self._conns: set[socket.socket] = set()
         self._lock = threading.Lock()
         self._sessions: dict[str, _Session] = {}
+        # sid -> expiry: sessions that already completed or failed. A stripe
+        # arriving after its session finished (sender retry, slow socket)
+        # must fail fast instead of blocking its connection for the full
+        # stripe wait while the sender's next round needs it.
+        self._dead_sessions: dict[str, float] = {}
         self._sess_cond = threading.Condition()
         self._accept_thread = threading.Thread(
             target=self._accept_loop, name="odtp-bulk-accept", daemon=True
@@ -247,9 +262,11 @@ class BulkServer:
 
     def _read_stripe(self, conn: socket.socket, header: dict) -> None:
         sid, j = header["session"], header["stripe"]
-        deadline = time.monotonic() + _STRIPE_WAIT_S
+        deadline = time.monotonic() + _stripe_wait_s()
         with self._sess_cond:
             while sid not in self._sessions:
+                if sid in self._dead_sessions:  # tombstoned: fail fast
+                    raise WireError(f"stripe {j} for finished session {sid}")
                 left = deadline - time.monotonic()
                 if left <= 0 or self._stop.is_set():
                     raise WireError(f"stripe {j} for unknown session {sid}")
@@ -282,7 +299,7 @@ class BulkServer:
             self._sess_cond.notify_all()
         try:
             native.sock_recvall(conn, views[0])
-            deadline = time.monotonic() + _STRIPE_WAIT_S
+            deadline = time.monotonic() + _stripe_wait_s()
             with self._sess_cond:
                 while sess.remaining > 0 and not sess.failed:
                     left = deadline - time.monotonic()
@@ -294,6 +311,13 @@ class BulkServer:
         finally:
             with self._sess_cond:
                 self._sessions.pop(sid, None)
+                now = time.monotonic()
+                self._dead_sessions[sid] = now + _TOMBSTONE_S
+                for k in [
+                    k for k, t in self._dead_sessions.items() if t < now
+                ]:
+                    del self._dead_sessions[k]
+                self._sess_cond.notify_all()
         return payload
 
     def stop(self) -> None:

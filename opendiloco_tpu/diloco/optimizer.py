@@ -79,6 +79,14 @@ class DiLoCoOptimizer:
         # overlapped-communication state (arxiv 2502.12996): at most one
         # outer all-reduce in flight while inner training continues
         self._pending: Optional[dict[str, Any]] = None
+        # pre-round snapshot served while the BLOCKING outer_step mutates
+        # the master in place (OuterSGD.step is in-place): without it a peer
+        # onboarding mid-round could fetch a torn master with mixed
+        # pre/post-update leaves (hivemind's load_state_from_peers always
+        # returns a consistent epoch snapshot, hivemind_diloco.py:528-531)
+        self._blocking_snap: Optional[dict[str, Any]] = None
+        # serializes state serving against round-boundary publications
+        self._serve_lock = threading.Lock()
         self._abandoned: Optional[Any] = None  # dropped round still running
         self._landed_metrics: Optional[dict[str, Any]] = None
         self._apply_delta = None
@@ -89,7 +97,7 @@ class DiLoCoOptimizer:
     # onboarding (reference: load_state_from_peers, train_fsdp.py:348-349)
     # ------------------------------------------------------------------
 
-    def _state_for_peers(self) -> dict[str, Any]:
+    def _state_unlocked(self) -> dict[str, Any]:
         if self._pending is not None:
             # while a round is in flight, epoch is already advanced but the
             # master excludes that round's update; serve the consistent
@@ -101,11 +109,29 @@ class DiLoCoOptimizer:
                 "epoch": p["epoch"],
                 "outer_opt": dict(p["opt_snap"]),
             }
+        snap = self._blocking_snap
+        if snap is not None:
+            # blocking outer step in progress: serve the consistent
+            # pre-round snapshot, never the in-place-mutating live master
+            return {
+                "master": [m.copy() for m in snap["master"]],
+                "epoch": snap["epoch"],
+                "outer_opt": dict(snap["outer_opt"]),
+            }
         return {
             "master": [m.copy() for m in self.master],
             "epoch": self.epoch,
             "outer_opt": self.outer_opt.state_dict(),
         }
+
+    def _state_for_peers(self) -> dict[str, Any]:
+        # the lock makes the flag checks + field reads in _state_unlocked
+        # atomic against the round-boundary publications (all of which also
+        # hold the lock): without it, a fetch that passes the flag checks
+        # just before a round completes could still copy a (pre-round
+        # master, post-round epoch) mix. Held only for host-RAM copies.
+        with self._serve_lock:
+            return self._state_unlocked()
 
     def load_state_from_peers(self, state: dict) -> Optional[dict]:
         """Adopt a peer's master params/epoch; returns updated device state."""
@@ -113,11 +139,15 @@ class DiLoCoOptimizer:
         remote = self.backend.fetch_state()
         if remote is None:
             return None
-        self.master = [np.asarray(m, np.float32).copy() for m in remote["master"]]
-        self.epoch = int(remote["epoch"])
-        self.outer_opt.load_state_dict(remote["outer_opt"])
-        self.local_step = 0
-        self.samples_in_epoch = 0
+        with self._serve_lock:
+            self._blocking_snap = None  # superseded pre-round snapshot
+            self.master = [
+                np.asarray(m, np.float32).copy() for m in remote["master"]
+            ]
+            self.epoch = int(remote["epoch"])
+            self.outer_opt.load_state_dict(remote["outer_opt"])
+            self.local_step = 0
+            self.samples_in_epoch = 0
         state = self._write_master_to_device(state)
         # resume the LR schedule where the swarm is, not at warmup step 0
         return self.trainer.force_step_position(
@@ -275,13 +305,19 @@ class DiLoCoOptimizer:
             est_opt.step(est_master, pseudo_grad)
             delta = [e - b for e, b in zip(est_master, boundary)]
             state = self._apply_delta_to_device(state, delta)
-            self.master = est_master
             pending["est_master"] = est_master
 
-        self._pending = pending
-        self.epoch += 1
-        self.local_step = 0
-        self.samples_in_epoch = 0
+        # publish atomically against the serve thread: the eager master
+        # rebind, the pending round, and the epoch advance must appear
+        # together (a fetch between them would pair an estimated master
+        # with the old epoch, or a new epoch with no pending snapshot)
+        with self._serve_lock:
+            if "est_master" in pending:
+                self.master = pending["est_master"]
+            self._pending = pending
+            self.epoch += 1
+            self.local_step = 0
+            self.samples_in_epoch = 0
         self._epoch_t0 = time.monotonic()
         outer_metrics = {
             "outer_step_s": time.monotonic() - t0,
@@ -328,28 +364,38 @@ class DiLoCoOptimizer:
         fut = pending["future"]
         if not block and not fut.done():
             return state
-        self._pending = None
-        avg, group_size = fut.result(
-            timeout=None if not block else self.cfg.averaging_timeout + 60
-        )
-        self._check_group_size(group_size)
+        # keep _pending published until the landed master/opt are assigned:
+        # the serve thread falls back to the live (still pre-round in the
+        # delayed mode) master the moment _pending clears, so clearing
+        # before the assignment would open a (new epoch, old master) window
+        # for onboarding peers. The finally also clears on failure, where
+        # the live state is the correct thing to serve.
+        try:
+            avg, group_size = fut.result(
+                timeout=None if not block else self.cfg.averaging_timeout + 60
+            )
+            self._check_group_size(group_size)
 
-        master = [m.copy() for m in pending["master_snap"]]
-        opt = OuterSGD(
-            lr=self.cfg.outer_lr,
-            momentum=self.cfg.outer_momentum,
-            nesterov=self.cfg.outer_nesterov,
-        )
-        opt.load_state_dict(pending["opt_snap"])
-        opt.step(master, avg)
-        self.outer_opt = opt
+            master = [m.copy() for m in pending["master_snap"]]
+            opt = OuterSGD(
+                lr=self.cfg.outer_lr,
+                momentum=self.cfg.outer_momentum,
+                nesterov=self.cfg.outer_nesterov,
+            )
+            opt.load_state_dict(pending["opt_snap"])
+            opt.step(master, avg)
 
-        if "est_master" in pending:  # eager: correct the estimated update
-            delta = [t - e for t, e in zip(master, pending["est_master"])]
-        else:  # delayed: the deferred boundary rewrite
-            delta = [t - b for t, b in zip(master, pending["boundary"])]
-        state = self._apply_delta_to_device(state, delta)
-        self.master = master
+            if "est_master" in pending:  # eager: correct the estimated update
+                delta = [t - e for t, e in zip(master, pending["est_master"])]
+            else:  # delayed: the deferred boundary rewrite
+                delta = [t - b for t, b in zip(master, pending["boundary"])]
+            state = self._apply_delta_to_device(state, delta)
+            with self._serve_lock:
+                self.outer_opt = opt
+                self.master = master
+        finally:
+            with self._serve_lock:
+                self._pending = None
         landed_s = time.monotonic() - pending["t_launch"]
         # surface the landing in the next metrics row (dashboards would
         # otherwise never see overlapped round size/latency)
@@ -421,6 +467,18 @@ class DiLoCoOptimizer:
         assert schema_fingerprint(state["params"]) == self._schema, (
             "parameter schema changed mid-epoch"
         )
+        # publish the pre-round state for onboarding peers. Holds the master
+        # list by reference (no copy): every mutation below rebinds
+        # self.master to a freshly built list instead of writing into these
+        # arrays, so the snapshot stays bit-stable for the serve thread.
+        # Left in place on failure (the pre-round snapshot is the only
+        # guaranteed-consistent state if the round aborts midway).
+        with self._serve_lock:
+            self._blocking_snap = {
+                "master": self.master,
+                "epoch": self.epoch,
+                "outer_opt": self.outer_opt.state_dict(),
+            }
         t0 = time.monotonic()
 
         # overlap the D2H transfer with the straggler wait (SURVEY hard-part
@@ -486,7 +544,11 @@ class DiLoCoOptimizer:
             allreduce_s,
         )
 
-        self.outer_opt.step(self.master, averaged)
+        # copy-then-rebind: OuterSGD.step updates in place, and the serve
+        # thread may be reading the snapshot'd (pre-round) master arrays
+        new_master = [m.copy() for m in self.master]
+        self.outer_opt.step(new_master, averaged)
+        self.master = new_master
 
         # optional periodic full state averaging (hivemind
         # average_state_every, hivemind_diloco.py:634-638): corrects any
@@ -500,9 +562,14 @@ class DiLoCoOptimizer:
 
         state = self._write_master_to_device(state)  # [H2D]
 
-        self.epoch += 1
-        self.local_step = 0
-        self.samples_in_epoch = 0
+        with self._serve_lock:
+            self.epoch += 1
+            self.local_step = 0
+            self.samples_in_epoch = 0
+            # master + epoch + outer_opt are all post-round now: resume
+            # serving live state (a fetch sees exactly the pre- or the
+            # post-round state, never a mix)
+            self._blocking_snap = None
         self._epoch_t0 = time.monotonic()
         outer_metrics = {
             "outer_step_s": time.monotonic() - t0,
@@ -539,12 +606,17 @@ class DiLoCoOptimizer:
         }
 
     def load_state_dict(self, sd: dict) -> None:
-        self.master = [np.asarray(m, np.float32).copy() for m in sd["master"]]
-        self.outer_opt.load_state_dict(sd["outer_opt"])
-        self.epoch = int(sd["epoch"])
-        self.local_step = int(sd["local_step"])
-        # older checkpoints lack samples_in_epoch; reconstruct so a mid-epoch
-        # resume reports true progress and peers' wait_for_all doesn't stall
-        self.samples_in_epoch = int(
-            sd.get("samples_in_epoch", self.local_step * self.batch_size)
-        )
+        with self._serve_lock:
+            self._blocking_snap = None  # superseded pre-round snapshot
+            self.master = [
+                np.asarray(m, np.float32).copy() for m in sd["master"]
+            ]
+            self.outer_opt.load_state_dict(sd["outer_opt"])
+            self.epoch = int(sd["epoch"])
+            self.local_step = int(sd["local_step"])
+            # older checkpoints lack samples_in_epoch; reconstruct so a
+            # mid-epoch resume reports true progress and peers' wait_for_all
+            # doesn't stall
+            self.samples_in_epoch = int(
+                sd.get("samples_in_epoch", self.local_step * self.batch_size)
+            )

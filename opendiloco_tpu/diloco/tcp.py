@@ -678,6 +678,7 @@ class TcpBackend(OuterBackend):
         # decode+accumulate; native single-pass kernels when built)
         async def collect():
             from opendiloco_tpu import native as _native
+            from opendiloco_tpu.diloco.bulk import release_buffer
 
             acc = np.array(parts[my_idx], dtype=np.float32)
             for p in group:
@@ -687,6 +688,10 @@ class TcpBackend(OuterBackend):
                     (round_key, "push", p["peer_id"]), deadline
                 )
                 self.codec.decode_accumulate(payload, pmeta["meta"], acc)
+                # fully folded into acc: recycle bulk-plane receive buffers
+                # so steady-state rounds stop allocating (no-op for asyncio
+                # bytes payloads)
+                release_buffer(payload)
             _native.scale_inplace(acc, 1.0 / n)
             return acc
 
@@ -714,6 +719,8 @@ class TcpBackend(OuterBackend):
             )
 
         async def recv_results():
+            from opendiloco_tpu.diloco.bulk import release_buffer
+
             out: dict[int, np.ndarray] = {my_idx: my_avg}
             for j in range(n):
                 if j == my_idx:
@@ -724,6 +731,13 @@ class TcpBackend(OuterBackend):
                 out[j] = self.codec.decode(
                     payload, (int(rmeta["shape"][0]),), rmeta["meta"]
                 )
+                # codec "none" decode aliases the payload (kept until the
+                # final concatenate); only recycle buffers the decode copied
+                if not (
+                    isinstance(payload, np.ndarray)
+                    and np.shares_memory(out[j], payload)
+                ):
+                    release_buffer(payload)
             return out
 
         t_ph = time.monotonic()

@@ -29,6 +29,9 @@ from opendiloco_tpu.models.llama import (
 )
 from opendiloco_tpu.parallel.mesh import MeshPlan
 from opendiloco_tpu.parallel.sharding import optstate_specs, param_specs
+from opendiloco_tpu.utils.logger import get_text_logger
+
+log = get_text_logger(__name__)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -111,7 +114,22 @@ def _resolve_perf_defaults(
     on_tpu = "tpu" in getattr(dev, "device_kind", "").lower()
     changes: dict = {}
     if tc.attn_impl == "auto":
-        changes["attn_impl"] = "pallas" if on_tpu else "xla"
+        if getattr(plan, "sp_axis", None) is not None and getattr(
+            plan, "pp_axis", None
+        ) is None:
+            # sequence-parallel mesh: flash/xla attention are not
+            # sequence-sharded, so XLA would all-gather the full sequence
+            # per device, silently defeating the sp axis -- ring attention
+            # is the only impl that keeps the shards local
+            changes["attn_impl"] = "ring"
+        else:
+            if getattr(plan, "sp_axis", None) is not None:
+                log.warning(
+                    "attn_impl=auto with sp+pp: ring attention cannot nest "
+                    "inside pipeline stages; falling back to full-sequence "
+                    "attention (the sp axis only shards activations)"
+                )
+            changes["attn_impl"] = "pallas" if on_tpu else "xla"
     if tc.fused_loss is None:
         # auto-on only where the sweep measured a win: pallas attention on a
         # non-sequence-parallel mesh (xla+fused measured slower than xla

@@ -1,0 +1,270 @@
+"""On-chip Pallas kernel evidence: parity vs XLA + timings, non-interpret.
+
+Writes KERNEL_EVIDENCE.json at the repo root -- the committed artifact VERDICT
+round 2 asked for (in-tree tests run the kernels in interpret mode on CPU;
+this is the real-chip record). Each section is independent and the artifact
+is rewritten after every section, so a tunnel that dies mid-run still leaves
+the sections that finished. Run under scripts/tunnel_watch.sh.
+
+Covers the three kernel families (ref counterpart: flash-attn is the
+optional-but-benchmarked fast path in the reference's ecosystem,
+/root/reference/README.md:41-47):
+  - flash attention fwd + bwd (opendiloco_tpu/ops/flash_attention.py)
+  - fused lm-head + cross-entropy fwd + bwd (ops/fused_xent.py)
+  - ring attention per-chunk path under shard_map (ops/ring_attention.py)
+"""
+
+import functools
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:  # runnable from anywhere without an install
+    sys.path.insert(0, _ROOT)
+
+_OUT = os.path.join(_ROOT, "KERNEL_EVIDENCE.json")
+_DOC = {"sections": {}, "started": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())}
+
+
+def _flush():
+    _DOC["updated"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    with open(_OUT, "w") as f:
+        json.dump(_DOC, f, indent=1, sort_keys=True)
+        f.write("\n")
+
+
+def _watchdog(seconds: float):
+    def fire():
+        _DOC["aborted"] = f"watchdog after {seconds}s (tunnel wedge)"
+        _flush()
+        os._exit(4)
+
+    t = threading.Timer(seconds, fire)
+    t.daemon = True
+    t.start()
+    return t
+
+
+def _timeit(fn, *args, iters: int = 10):
+    """Median wall time in microseconds (post-warmup, device-synced)."""
+    import jax
+
+    r = fn(*args)
+    jax.block_until_ready(r)  # compile + first run
+    ts = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(*args))
+        ts.append(time.perf_counter() - t0)
+    return float(np.median(ts) * 1e6)
+
+
+def _section(name):
+    def deco(fn):
+        def run():
+            t0 = time.time()
+            try:
+                _DOC["sections"][name] = {"ok": True, **fn()}
+            except Exception as e:  # record the failure, keep going
+                _DOC["sections"][name] = {"ok": False, "error": f"{type(e).__name__}: {e}"}
+            _DOC["sections"][name]["wall_s"] = round(time.time() - t0, 1)
+            _flush()
+
+        return run
+
+    return deco
+
+
+@_section("flash_attention")
+def flash_section():
+    import jax
+    import jax.numpy as jnp
+
+    from opendiloco_tpu.ops.attention import xla_attention
+    from opendiloco_tpu.ops.flash_attention import flash_attention
+
+    rng = np.random.default_rng(0)
+    B, T, HQ, HKV, D = 2, 2048, 16, 8, 64
+    if _DOC.get("smoke"):
+        T = 256
+    mk = lambda h, dt: jnp.asarray(rng.normal(size=(B, T, h, D)) * 0.5, dt)
+
+    # parity in f32 (kernel accumulates f32; tolerance covers bf16-free paths)
+    q, k, v = mk(HQ, jnp.float32), mk(HKV, jnp.float32), mk(HKV, jnp.float32)
+    ref = jax.jit(functools.partial(xla_attention, causal=True))(q, k, v)
+    got = jax.jit(functools.partial(flash_attention, causal=True))(q, k, v)
+    fwd_err = float(jnp.max(jnp.abs(got - ref)))
+    assert fwd_err < 2e-3, f"flash fwd parity: max|err|={fwd_err}"
+
+    def loss(fn, q, k, v):
+        return jnp.sum(fn(q, k, v, causal=True) ** 2)
+
+    gr = jax.jit(jax.grad(functools.partial(loss, xla_attention), argnums=(0, 1, 2)))(q, k, v)
+    gg = jax.jit(jax.grad(functools.partial(loss, flash_attention), argnums=(0, 1, 2)))(q, k, v)
+    bwd_err = float(max(jnp.max(jnp.abs(a - b)) for a, b in zip(gr, gg)))
+    scale = float(max(jnp.max(jnp.abs(a)) for a in gr))
+    assert bwd_err < 2e-2 * max(scale, 1.0), f"flash bwd parity: max|err|={bwd_err} scale={scale}"
+
+    # timings in bf16 (production dtype)
+    qb, kb, vb = (x.astype(jnp.bfloat16) for x in (q, k, v))
+    f_fwd = jax.jit(functools.partial(flash_attention, causal=True))
+    x_fwd = jax.jit(functools.partial(xla_attention, causal=True))
+    f_bwd = jax.jit(jax.grad(functools.partial(loss, flash_attention), argnums=(0, 1, 2)))
+    x_bwd = jax.jit(jax.grad(functools.partial(loss, xla_attention), argnums=(0, 1, 2)))
+    return {
+        "shape": f"B{B} T{T} Hq{HQ} Hkv{HKV} D{D}",
+        "fwd_max_abs_err_f32": fwd_err,
+        "bwd_max_abs_err_f32": bwd_err,
+        "bf16_us": {
+            "pallas_fwd": _timeit(f_fwd, qb, kb, vb),
+            "xla_fwd": _timeit(x_fwd, qb, kb, vb),
+            "pallas_fwd_bwd": _timeit(f_bwd, qb, kb, vb),
+            "xla_fwd_bwd": _timeit(x_bwd, qb, kb, vb),
+        },
+    }
+
+
+@_section("fused_xent")
+def xent_section():
+    import jax
+    import jax.numpy as jnp
+
+    from opendiloco_tpu.ops.fused_xent import fused_linear_cross_entropy
+
+    rng = np.random.default_rng(1)
+    N, D, V = 4096, 1024, 32000
+    if _DOC.get("smoke"):
+        N, D, V = 256, 256, 2048
+    h32 = jnp.asarray(rng.normal(size=(N, D)) * 0.02, jnp.float32)
+    w32 = jnp.asarray(rng.normal(size=(D, V)) * 0.02, jnp.float32)
+    labels = jnp.asarray(rng.integers(0, V, N), jnp.int32)
+    labels = labels.at[:64].set(-100)  # exercise the ignore path
+
+    def ref_nll(h, w, labels):
+        mask = labels != -100
+        logits = h @ w
+        lp = jax.nn.log_softmax(logits, axis=-1)
+        safe = jnp.where(mask, labels, 0)
+        nll = -jnp.take_along_axis(lp, safe[:, None], axis=1)[:, 0] * mask
+        return jnp.sum(nll) / jnp.maximum(jnp.sum(mask), 1)
+
+    ref = float(jax.jit(ref_nll)(h32, w32, labels))
+    got = float(jax.jit(fused_linear_cross_entropy)(h32, w32, labels))
+    fwd_err = abs(got - ref)
+    assert fwd_err < 1e-3, f"xent fwd parity: |{got}-{ref}|={fwd_err}"
+
+    gr = jax.jit(jax.grad(ref_nll, argnums=(0, 1)))(h32, w32, labels)
+    gg = jax.jit(jax.grad(fused_linear_cross_entropy, argnums=(0, 1)))(h32, w32, labels)
+    bwd_err = float(max(jnp.max(jnp.abs(a - b)) for a, b in zip(gr, gg)))
+    assert bwd_err < 1e-4, f"xent bwd parity: max|err|={bwd_err}"
+
+    hb, wb = h32.astype(jnp.bfloat16), w32.astype(jnp.bfloat16)
+    f_fwd = jax.jit(fused_linear_cross_entropy)
+    x_fwd = jax.jit(ref_nll)
+    f_bwd = jax.jit(jax.grad(fused_linear_cross_entropy, argnums=(0, 1)))
+    x_bwd = jax.jit(jax.grad(ref_nll, argnums=(0, 1)))
+    return {
+        "shape": f"N{N} D{D} V{V} (pad path: V=32000 -> 2048-blocks)",
+        "fwd_abs_err_f32": fwd_err,
+        "bwd_max_abs_err_f32": bwd_err,
+        "bf16_us": {
+            "fused_fwd": _timeit(f_fwd, hb, wb, labels),
+            "xla_fwd": _timeit(x_fwd, hb, wb, labels),
+            "fused_fwd_bwd": _timeit(f_bwd, hb, wb, labels),
+            "xla_fwd_bwd": _timeit(x_bwd, hb, wb, labels),
+        },
+    }
+
+
+@_section("ring_attention")
+def ring_section():
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from opendiloco_tpu.ops.attention import xla_attention
+    from opendiloco_tpu.ops.ring_attention import ring_attention
+    from jax.experimental.shard_map import shard_map
+
+    # single real chip: sp=1 ring still runs the per-chunk Pallas kernels
+    # on-chip through the shard_map/collective machinery
+    mesh = jax.sharding.Mesh(np.array(jax.devices()[:1]), ("sp",))
+    rng = np.random.default_rng(2)
+    B, T, HQ, HKV, D = 2, 2048, 16, 8, 64
+    if _DOC.get("smoke"):
+        T = 256
+    q = jnp.asarray(rng.normal(size=(B, T, HQ, D)) * 0.5, jnp.float32)
+    k = jnp.asarray(rng.normal(size=(B, T, HKV, D)) * 0.5, jnp.float32)
+    v = jnp.asarray(rng.normal(size=(B, T, HKV, D)) * 0.5, jnp.float32)
+
+    ring = jax.jit(
+        shard_map(
+            functools.partial(ring_attention, axis_name="sp", causal=True),
+            mesh=mesh,
+            in_specs=(P(None, "sp"), P(None, "sp"), P(None, "sp")),
+            out_specs=P(None, "sp"),
+        )
+    )
+    ref = jax.jit(functools.partial(xla_attention, causal=True))(q, k, v)
+    got = ring(q, k, v)
+    fwd_err = float(jnp.max(jnp.abs(got - ref)))
+    assert fwd_err < 2e-3, f"ring fwd parity: max|err|={fwd_err}"
+
+    qb, kb, vb = (x.astype(jnp.bfloat16) for x in (q, k, v))
+    return {
+        "shape": f"B{B} T{T} Hq{HQ} Hkv{HKV} D{D} (sp=1 on one chip)",
+        "fwd_max_abs_err_f32": fwd_err,
+        "bf16_us": {"ring_fwd": _timeit(ring, qb, kb, vb)},
+    }
+
+
+def main():
+    global _OUT
+    import jax
+
+    if os.environ.get("KERNEL_EVIDENCE_SMOKE"):
+        # CPU logic check only: interpret-mode kernels, artifact to /tmp so
+        # the committed KERNEL_EVIDENCE.json stays real-chip-only
+        jax.config.update("jax_platforms", "cpu")
+        import jax.experimental.pallas as pl
+
+        orig = pl.pallas_call
+        from opendiloco_tpu.ops import flash_attention as fa
+        from opendiloco_tpu.ops import fused_xent as fx
+
+        def patched(*args, **kwargs):
+            kwargs["interpret"] = True
+            return orig(*args, **kwargs)
+
+        fa.pl.pallas_call = patched
+        fx.pl.pallas_call = patched
+        _OUT = "/tmp/kernel_evidence_smoke.json"
+        _DOC["smoke"] = True
+
+    cache_dir = os.environ.get("OPENDILOCO_TPU_COMPILE_CACHE", "/tmp/odtp-jax-cache")
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass
+    wd = _watchdog(float(os.environ.get("KERNEL_EVIDENCE_TIMEOUT", "780")))
+    _DOC["device"] = jax.devices()[0].device_kind
+    _DOC["backend"] = jax.default_backend()
+    _flush()
+    flash_section()
+    xent_section()
+    ring_section()
+    wd.cancel()
+    _flush()
+    ok = all(s.get("ok") for s in _DOC["sections"].values())
+    print(json.dumps(_DOC["sections"], indent=1, sort_keys=True))
+    sys.exit(0 if ok else 5)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,28 @@
+#!/bin/bash
+# Jobs to run whenever the TPU tunnel is alive (invoked by tunnel_watch.sh
+# from the repo root). Each job banks its result in-repo immediately and is
+# skipped once it has what it needs, so a short window is spent on whatever
+# is still missing. Every job has a hard timeout: a tunnel that dies mid-job
+# must not wedge the watcher.
+
+# 150m variant sweep -- bench.py appends every measurement to BENCH_LIVE.json
+timeout 900 python bench.py > /tmp/bench_watch.out 2>&1
+echo "bench 150m rc=$?"
+
+# on-chip kernel parity + timing evidence (VERDICT r2 ask #2), once
+if [ -f scripts/kernel_evidence.py ] && [ ! -f KERNEL_EVIDENCE.json ]; then
+  timeout 900 python scripts/kernel_evidence.py > /tmp/kernel_evidence.out 2>&1
+  echo "kernel_evidence rc=$?"
+fi
+
+# MFU sweep: batch scaling / remat / configs table (VERDICT r2 ask #3)
+if [ -f scripts/mfu_sweep.py ] && [ ! -f MFU_SWEEP.json ]; then
+  timeout 1800 python scripts/mfu_sweep.py > /tmp/mfu_sweep.out 2>&1
+  echo "mfu_sweep rc=$?"
+fi
+
+# 1b config headline number, once
+if ! grep -q '"model": "1b"' BENCH_LIVE.json 2>/dev/null; then
+  OPENDILOCO_TPU_BENCH_MODEL=1b timeout 1200 python bench.py > /tmp/bench_1b.out 2>&1
+  echo "bench 1b rc=$?"
+fi

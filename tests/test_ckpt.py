@@ -154,3 +154,78 @@ def test_legacy_dataloader_sidecar_fallback(tmp_path, tiny_cfg):
         json.dump({"samples_seen": 7}, f)
     _, _, lstate, _ = ckpt_lib.load_checkpoint(d, state)
     assert lstate == {"samples_seen": 7}
+
+
+def test_remote_path_full_cycle_memory_fs(monkeypatch):
+    """Exercise every _is_remote branch (fs_open/listdir/exists/probe/GC)
+    against fsspec's in-process memory:// filesystem -- the same code paths
+    a gs:// deployment hits (reference: ckpt_utils.py:74-82). Orbax owns the
+    device_state leg and speaks gs:// natively, so it is stubbed here; this
+    covers the repo's own remote-path code."""
+    import fsspec
+
+    fsspec.filesystem("memory").store.clear()
+
+    class _StubCkptr:
+        """Records the path form handed to Orbax; no device I/O."""
+
+        saved: list[str] = []
+
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *a):
+            return False
+
+        def save(self, path, state, force=False):
+            _StubCkptr.saved.append(path)
+
+        def restore(self, path, target):
+            return target
+
+    import orbax.checkpoint as ocp
+
+    monkeypatch.setattr(ocp, "StandardCheckpointer", _StubCkptr)
+
+    root = "memory://ckpts"
+    # writability probe: create + delete through fsspec
+    ckpt_lib.check_checkpoint_path_access(root, rank=0)
+    assert not fsspec.filesystem("memory").exists("/ckpts/.write_probe_0")
+
+    diloco_state = {
+        "master": [np.arange(6, dtype=np.float32)],
+        "outer_opt": {"lr": 0.7, "momentum": 0.9, "nesterov": True, "bufs": None},
+        "epoch": 2,
+        "local_step": 1,
+    }
+    state = {"step": np.int32(7)}
+    for step in (3, 7):
+        d = ckpt_lib.save_checkpoint(
+            root,
+            step,
+            state,
+            diloco_rank=0,
+            diloco_state=diloco_state,
+            dataloader_state={"dataset": {"samples_seen": 5}},
+            extra={"loss": 0.5},
+        )
+    assert d == "memory://ckpts/model_step_7/diloco_rank_0"
+    # remote paths must NOT be os.path.abspath'd before reaching Orbax
+    assert _StubCkptr.saved[-1] == f"{d}/device_state"
+
+    # discovery over fs.ls
+    ok, found, step = ckpt_lib.get_resume_info(True, root, diloco_rank=0)
+    assert ok and step == 7 and found == d
+
+    # sidecar roundtrip over fsspec open/exists
+    state2, dstate2, lstate2, extra2 = ckpt_lib.load_checkpoint(d, state)
+    assert dstate2["epoch"] == 2
+    np.testing.assert_array_equal(dstate2["master"][0], diloco_state["master"][0])
+    assert lstate2["dataset"]["samples_seen"] == 5
+    assert extra2["loss"] == 0.5
+
+    # retention GC over fs.rm(recursive)
+    ckpt_lib.delete_old_checkpoints(root, topk=1)
+    ok3, _, step3 = ckpt_lib.get_resume_info(True, root, diloco_rank=0)
+    assert ok3 and step3 == 7
+    assert not ckpt_lib._exists(f"{root}/model_step_3/diloco_rank_0/diloco_state.json")

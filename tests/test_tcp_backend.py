@@ -305,6 +305,39 @@ def test_bulk_striped_transfer_roundtrip(monkeypatch):
         server.stop()
 
 
+def test_bulk_orphan_stripe_fails_fast():
+    """A _stripe frame for a session that already finished (tombstoned) must
+    fail immediately, not block its connection for the full stripe wait
+    while the sender retries the round on it."""
+    import json
+    import socket
+    import struct
+    import threading
+    import time
+
+    from opendiloco_tpu.diloco import bulk as bulk_mod
+
+    server = bulk_mod.BulkServer(lambda *a: None, host="127.0.0.1")
+    try:
+        with server._sess_cond:
+            server._dead_sessions["dead-sid"] = time.monotonic() + 60
+        hdr = json.dumps(
+            {"type": "_stripe", "session": "dead-sid", "stripe": 1}
+        ).encode()
+        conn = socket.create_connection(("127.0.0.1", server.port), timeout=5)
+        try:
+            conn.sendall(struct.pack(">4sI", bulk_mod.MAGIC, len(hdr)) + hdr)
+            conn.settimeout(5.0)
+            t0 = time.monotonic()
+            # server raises WireError and closes the connection promptly
+            assert conn.recv(1) == b""
+            assert time.monotonic() - t0 < 4.0
+        finally:
+            conn.close()
+    finally:
+        server.stop()
+
+
 def test_bulk_striped_allreduce(monkeypatch):
     """End-to-end butterfly all-reduce with striping forced on: results
     stay exact and _stripe frames actually travel."""

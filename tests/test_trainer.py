@@ -231,9 +231,17 @@ def test_auto_perf_defaults_on_tpu_device_kind(tiny_cfg):
     tc = _resolve_perf_defaults(TrainerConfig(attn_impl="xla"), tiny_cfg, plan)
     assert tc.fused_loss is False
 
-    # sequence-parallel mesh: fused kernel is not sequence-sharded
-    sp_plan = SimpleNamespace(mesh=plan.mesh, sp_axis="sp")
+    # sequence-parallel mesh: full-sequence attention impls would gather
+    # the whole sequence per device -> auto must pick ring; the fused
+    # kernel is likewise not sequence-sharded -> off
+    sp_plan = SimpleNamespace(mesh=plan.mesh, sp_axis="sp", pp_axis=None)
     tc = _resolve_perf_defaults(TrainerConfig(), tiny_cfg, sp_plan)
+    assert tc.attn_impl == "ring" and tc.fused_loss is False
+
+    # sp+pp: ring cannot nest inside pipeline stages -> full-sequence
+    # attention with a warning, never a crash
+    sppp_plan = SimpleNamespace(mesh=plan.mesh, sp_axis="sp", pp_axis="pp")
+    tc = _resolve_perf_defaults(TrainerConfig(), tiny_cfg, sppp_plan)
     assert tc.attn_impl == "pallas" and tc.fused_loss is False
 
     moe_cfg = dataclasses.replace(tiny_cfg, num_experts=2)
