@@ -90,6 +90,30 @@ bool get_number(const std::string& s, const std::string& key, double* out) {
     } catch (...) { return false; }
 }
 
+// split a raw JSON array "[{...},{...}]" into its top-level elements
+std::vector<std::string> split_array(const std::string& arr) {
+    std::vector<std::string> out;
+    int depth = 0; bool in_str = false; size_t start = std::string::npos;
+    for (size_t i = 0; i < arr.size(); ++i) {
+        char c = arr[i];
+        if (in_str) {
+            if (c == '\\') i++;
+            else if (c == '"') in_str = false;
+        } else if (c == '"') in_str = true;
+        else if (c == '{' || c == '[') {
+            if (depth == 1 && start == std::string::npos) start = i;
+            depth++;
+        } else if (c == '}' || c == ']') {
+            depth--;
+            if (depth == 1 && start != std::string::npos) {
+                out.push_back(arr.substr(start, i - start + 1));
+                start = std::string::npos;
+            }
+        }
+    }
+    return out;
+}
+
 // capture a raw JSON value (object/number/string/bool/null) as a substring
 bool get_raw(const std::string& s, const std::string& key, std::string* out) {
     size_t v = find_value(s, key);
@@ -264,16 +288,56 @@ void handle(int fd, const std::string& header) {
     if (!get_raw(header, "meta", &meta)) meta = "{}";
 
     if (type == "register") {
+        // extract the known_peers array FIRST and blank it out of the meta
+        // before the scalar lookups: the embedded peer objects repeat the
+        // peer_id/host/port keys and find_value is first-occurrence, so a
+        // serializer that orders known_peers before peer_id would otherwise
+        // register the wrong id
+        std::string known;
+        bool has_known = get_raw(meta, "known_peers", &known);
+        std::string scalars = meta;
+        if (has_known) {
+            size_t pos = scalars.find(known);
+            if (pos != std::string::npos) scalars.erase(pos, known.size());
+        }
         Peer p;
-        get_string(meta, "peer_id", &p.id);
-        get_string(meta, "host", &p.host);
+        get_string(scalars, "peer_id", &p.id);
+        get_string(scalars, "host", &p.host);
         double port = 0;
-        get_number(meta, "port", &port);
+        get_number(scalars, "port", &port);
         p.port = (int)port;
         p.last_seen = now_s();
         g_peers[p.id] = p;
         fprintf(stderr, "[odtp-rendezvousd] peer %s joined from %s:%d\n",
                 p.id.c_str(), p.host.c_str(), p.port);
+        // registry replication (protocol twin of rendezvous.py): a
+        // failing-over worker carries the swarm registry; adopt entries we
+        // don't have so matchmaking never sees a one-peer swarm. Existing
+        // entries win; adopted peers age out via the normal TTL.
+        if (has_known) {
+            int adopted = 0;
+            for (const std::string& pj : split_array(known)) {
+                Peer kp;
+                if (!get_string(pj, "peer_id", &kp.id) || kp.id.empty()) continue;
+                if (g_peers.count(kp.id)) continue;
+                get_string(pj, "host", &kp.host);
+                double kport = 0;
+                get_number(pj, "port", &kport);
+                kp.port = (int)kport;
+                std::string prog;
+                if (get_raw(pj, "progress", &prog)) kp.raw_progress = prog;
+                std::string serves;
+                if (get_raw(pj, "serves_state", &serves))
+                    kp.serves_state = (serves == "true");
+                kp.last_seen = now_s();
+                g_peers[kp.id] = kp;
+                adopted++;
+            }
+            if (adopted)
+                fprintf(stderr,
+                        "[odtp-rendezvousd] adopted %d replicated "
+                        "registration(s) from %s\n", adopted, p.id.c_str());
+        }
         queue_reply(fd, "ok", "{\"identity\":\"odtp-rendezvousd\",\"peers\":" + peers_json() + "}");
     } else if (type == "unregister") {
         std::string id;

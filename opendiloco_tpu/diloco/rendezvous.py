@@ -78,6 +78,7 @@ class RendezvousServer:
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._thread: Optional[threading.Thread] = None
         self._started = threading.Event()
+        self._writers: set[asyncio.StreamWriter] = set()  # live connections
 
     # -- lifecycle -------------------------------------------------------
 
@@ -112,7 +113,21 @@ class RendezvousServer:
 
     def stop(self) -> None:
         if self._loop and self._server:
-            self._loop.call_soon_threadsafe(self._server.close)
+
+            def _shutdown():
+                # close parked connections too (join_group waiters), so
+                # clients get the same prompt FIN a killed daemon process
+                # would deliver via the kernel -- without this the in-thread
+                # server leaks the sockets and a parked worker only notices
+                # the death at its RPC timeout
+                for w in list(self._writers):
+                    try:
+                        w.close()
+                    except Exception:
+                        pass
+                self._server.close()
+
+            self._loop.call_soon_threadsafe(_shutdown)
         if self._thread:
             self._thread.join(timeout=5)
 
@@ -131,9 +146,11 @@ class RendezvousServer:
         return self.peers
 
     async def _handle(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        self._writers.add(writer)
         try:
             msg, meta, _ = await read_frame(reader, timeout=120.0)
         except Exception:
+            self._writers.discard(writer)
             writer.close()
             return
         try:
@@ -141,6 +158,32 @@ class RendezvousServer:
                 info = PeerInfo(meta["peer_id"], meta["host"], meta["port"])
                 self.peers[info.peer_id] = info
                 log.info("peer %s joined from %s:%d", info.peer_id, info.host, info.port)
+                # registry replication: a failing-over worker carries the
+                # swarm's registry (see TcpBackend._announce_to) so this
+                # daemon -- possibly fresh or restarted -- immediately knows
+                # every peer and matchmaking never closes a round around the
+                # single re-registered worker. Existing (locally fresher)
+                # entries win; carried peers get a fresh TTL and expire
+                # normally if actually dead.
+                adopted = 0
+                for p in meta.get("known_peers", []):
+                    pid = p.get("peer_id")
+                    if not pid or pid in self.peers:
+                        continue
+                    self.peers[pid] = PeerInfo(
+                        pid,
+                        p.get("host", ""),
+                        int(p.get("port", 0)),
+                        progress=p.get("progress"),
+                        serves_state=bool(p.get("serves_state", False)),
+                    )
+                    adopted += 1
+                if adopted:
+                    log.info(
+                        "adopted %d replicated registration(s) from %s",
+                        adopted,
+                        info.peer_id,
+                    )
                 await send_frame(
                     writer,
                     "ok",
@@ -191,6 +234,7 @@ class RendezvousServer:
             except Exception:
                 pass
         finally:
+            self._writers.discard(writer)
             writer.close()
             try:
                 await writer.wait_closed()

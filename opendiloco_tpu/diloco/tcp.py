@@ -34,7 +34,13 @@ import numpy as np
 
 from opendiloco_tpu.diloco.backend import AllReduceError, OuterBackend, PeerProgress
 from opendiloco_tpu.diloco.compression import Codec, get_codec
-from opendiloco_tpu.diloco.wire import STREAM_LIMIT, read_frame, request, send_frame
+from opendiloco_tpu.diloco.wire import (
+    STREAM_LIMIT,
+    WireError,
+    read_frame,
+    request,
+    send_frame,
+)
 from opendiloco_tpu.utils.logger import get_text_logger
 
 log = get_text_logger(__name__)
@@ -132,6 +138,13 @@ class TcpBackend(OuterBackend):
             self._bulk_sender = BulkSender()
         self._progress_cache: list[PeerProgress] = []
         self._own_progress: Optional[PeerProgress] = None
+        # full registry view (peer_id -> peer json) refreshed from every
+        # rendezvous reply. Workers carry the swarm registry so a fresh
+        # daemon can be repopulated on failover (the DHT property that every
+        # hivemind peer holds the registry, train_fsdp.py:205-212): without
+        # it, the first worker to fail over registers alone, the daemon sees
+        # a one-peer swarm, and matchmaking closes rounds as solo groups
+        self._peers_view: dict[str, dict] = {}
         # mailbox: (round, kind, sender_or_part) -> (meta, payload)
         self._mailbox: dict[tuple, tuple[dict, bytes]] = {}
         self._mailbox_cv: Optional[asyncio.Condition] = None
@@ -165,6 +178,7 @@ class TcpBackend(OuterBackend):
             _, meta, _ = await self._rdv_request(
                 "register", self._register_meta(), timeout=self.rpc_timeout
             )
+            self._note_peers(meta)
             log.info(
                 "%s registered with rendezvous %s (%d peers known)",
                 self._peer_id,
@@ -189,9 +203,36 @@ class TcpBackend(OuterBackend):
     def _register_meta(self) -> dict:
         return {"peer_id": self._peer_id, "host": self.host, "port": self.port}
 
+    def _note_peers(self, meta: dict) -> None:
+        """Adopt a rendezvous reply's peer list as the carried registry.
+
+        REPLACE semantics, not merge: the reply is the daemon's full live
+        registry (and every failover/failback announce pushes this view
+        before reading a reply, so the daemon already absorbed anything only
+        this worker knew). Merging instead would resurrect peers that
+        cleanly unregistered or TTL-expired, re-injecting them into daemons
+        on every failover and stalling WAIT_FOR_ALL on departed workers.
+        """
+        if "peers" not in meta:
+            return
+        view = {p["peer_id"]: p for p in meta["peers"] if p.get("peer_id")}
+        if view:
+            self._peers_view = view
+
     async def _announce_to(self, addr: tuple[str, int], timeout: float) -> None:
-        """Register (and re-push progress) with a specific daemon."""
-        await request(*addr, "register", self._register_meta(), timeout=timeout)
+        """Register (and re-push progress) with a specific daemon, carrying
+        the full registry view so a daemon that lost (or never had) the
+        swarm's registrations recovers them from any single worker."""
+        known = [
+            p for pid, p in self._peers_view.items() if pid != self._peer_id
+        ]
+        _, meta, _ = await request(
+            *addr,
+            "register",
+            {**self._register_meta(), "known_peers": known},
+            timeout=timeout,
+        )
+        self._note_peers(meta)
         if self._own_progress is not None:
             p = self._own_progress
             await request(
@@ -240,7 +281,7 @@ class TcpBackend(OuterBackend):
                     )
                     self._rdv_idx = k
                     break
-                except (OSError, ConnectionError, asyncio.TimeoutError):
+                except (OSError, asyncio.TimeoutError, EOFError, WireError):
                     continue
 
         last_err: Optional[Exception] = None
@@ -250,7 +291,12 @@ class TcpBackend(OuterBackend):
             addr = self.rendezvous_list[self._rdv_idx]
             try:
                 return await request(*addr, msg, meta, payload, timeout=timeout)
-            except (OSError, ConnectionError, asyncio.TimeoutError) as e:
+            # EOFError covers asyncio.IncompleteReadError: a daemon dying
+            # WHILE this worker is parked in join_group closes the stream
+            # mid-read (clean FIN, not ECONNRESET) -- that must fail over,
+            # not crash the worker; WireError covers a torn partial frame
+            # from the dying daemon
+            except (OSError, asyncio.TimeoutError, EOFError, WireError) as e:
                 last_err = e
                 if isinstance(e, asyncio.TimeoutError) and not retried_timeout:
                     retried_timeout = True  # same daemon, one more chance
@@ -543,6 +589,7 @@ class TcpBackend(OuterBackend):
         except Exception as e:
             log.warning("progress report failed: %s", e)
             return
+        self._note_peers(meta)
         cache = []
         for p in meta.get("peers", []):
             prog = p.get("progress") or {}

@@ -225,6 +225,91 @@ def test_rendezvous_failover_allreduce():
         secondary.stop()
 
 
+@pytest.mark.parametrize("impl", ["python", "native"])
+def test_rendezvous_dies_mid_matchmaking_registry_replicates(impl):
+    """Kill the daemon WHILE a worker is parked in its matchmaking window.
+
+    Two things must hold (ref capability: the hivemind DHT survives
+    bootstrap death mid-round, train_fsdp.py:205-212):
+    - the parked worker sees a clean EOF (not ECONNREFUSED) and fails over
+      instead of crashing;
+    - the first worker to reach the fresh daemon carries the swarm registry
+      (TcpBackend._announce_to known_peers), so the fresh daemon never
+      closes a solo group around one re-registered worker and the round
+      completes over BOTH peers.
+
+    Runs against both daemon implementations; the native one is SIGKILLed
+    for true kernel-FIN death semantics.
+    """
+    import signal
+
+    from opendiloco_tpu.diloco.backend import PeerProgress
+
+    if impl == "native":
+        if not os.path.exists(_NATIVE_DAEMON):
+            pytest.skip("native daemon not built (make -C native)")
+        primary, secondary = _NativeDaemon(), _NativeDaemon()
+
+        def kill_primary():
+            primary.proc.send_signal(signal.SIGKILL)
+            primary.proc.wait(timeout=5)
+    else:
+        primary = RendezvousServer(host="127.0.0.1", port=0).start_in_thread()
+        secondary = RendezvousServer(host="127.0.0.1", port=0).start_in_thread()
+        kill_primary = primary.stop
+    peers = [primary.address, secondary.address]
+    backends = [
+        TcpBackend(peers, peer_id=f"mw-{i}", matchmaking_time=6.0,
+                   rpc_timeout=5.0)
+        for i in range(2)
+    ]
+    try:
+        # the production loop pushes progress every step, which is what
+        # keeps every worker's carried registry fresh -- mirror that
+        for b in backends:
+            b.report_progress(
+                PeerProgress(
+                    peer_id=b.peer_id,
+                    epoch=0,
+                    samples=0,
+                    samples_per_second=0.0,
+                    timestamp=time.time(),
+                )
+            )
+        data = [[np.full(8, float(i + 1), np.float32)] for i in range(2)]
+        results: list = [None, None]
+        errors: list = []
+
+        def run(i, delay):
+            try:
+                time.sleep(delay)
+                results[i] = backends[i].all_reduce(data[i], timeout=90.0)
+            except Exception as e:  # surfaced below
+                errors.append((i, e))
+
+        threads = [
+            threading.Thread(target=run, args=(0, 0.0)),
+            threading.Thread(target=run, args=(1, 2.0)),
+        ]
+        for t in threads:
+            t.start()
+        time.sleep(1.0)  # worker-0 is parked in primary's matchmaking window
+        kill_primary()  # daemon dies mid-matchmaking
+        for t in threads:
+            t.join(timeout=120)
+        assert not errors, errors
+        assert all(r is not None for r in results)
+        for out, group in results:
+            assert group == 2  # never a solo split on the fresh daemon
+            np.testing.assert_allclose(out[0], 1.5)
+        if impl == "python":
+            assert set(secondary.peers) >= {"mw-0", "mw-1"}
+    finally:
+        for b in backends:
+            b.close()
+        secondary.stop()
+
+
 def test_rendezvous_failover_at_startup():
     """A dead first daemon in initial_peers doesn't break backend startup."""
     live = RendezvousServer(host="127.0.0.1", port=0).start_in_thread()
