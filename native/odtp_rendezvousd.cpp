@@ -22,6 +22,7 @@
 #include <cstdio>
 #include <cstring>
 #include <fcntl.h>
+#include <netdb.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
 #include <poll.h>
@@ -114,6 +115,24 @@ std::vector<std::string> split_array(const std::string& arr) {
     return out;
 }
 
+// extract the top-level string literals of a raw JSON array ["a","b",...]
+// (split_array only captures object/array elements)
+std::vector<std::string> split_string_array(const std::string& arr) {
+    std::vector<std::string> out;
+    int depth = 0; bool in_str = false; std::string cur;
+    for (size_t i = 0; i < arr.size(); ++i) {
+        char c = arr[i];
+        if (in_str) {
+            if (c == '\\' && i + 1 < arr.size()) { cur += arr[++i]; continue; }
+            if (c == '"') { in_str = false; if (depth == 1) out.push_back(cur); continue; }
+            cur += c;
+        } else if (c == '"') { in_str = true; cur.clear(); }
+        else if (c == '{' || c == '[') depth++;
+        else if (c == '}' || c == ']') depth--;
+    }
+    return out;
+}
+
 // capture a raw JSON value (object/number/string/bool/null) as a substring
 bool get_raw(const std::string& s, const std::string& key, std::string* out) {
     size_t v = find_value(s, key);
@@ -191,6 +210,36 @@ struct Conn {
 std::map<std::string, Peer> g_peers;
 std::map<std::string, Round> g_rounds;
 std::map<int, Conn> g_conns;
+// dynamic daemon membership (protocol twin of rendezvous.py): other daemons
+// learned from daemon_hello announces and workers' known_daemons; advertised
+// in every register/progress reply so workers can grow their failover list
+// while the swarm runs
+std::set<std::string> g_daemons;
+std::string g_advertise;
+
+std::string daemons_json() {
+    std::string out = "[\"" + json_escape(g_advertise) + "\"";
+    for (auto& d : g_daemons) out += ",\"" + json_escape(d) + "\"";
+    return out + "]";
+}
+
+bool is_loopback_addr(const std::string& a) {
+    return a.rfind("127.0.0.1:", 0) == 0 || a.rfind("localhost:", 0) == 0;
+}
+
+void adopt_daemons(const std::string& raw_array, const char* source) {
+    // loopback guard (twin of rendezvous.py _adopt_daemons): a multi-host-
+    // advertised daemon must not adopt loopback aliases from colocated
+    // workers and re-advertise them fabric-wide
+    bool self_loopback = is_loopback_addr(g_advertise);
+    for (auto& a : split_string_array(raw_array)) {
+        if (a.empty() || a == g_advertise || g_daemons.count(a)) continue;
+        if (is_loopback_addr(a) && !self_loopback) continue;
+        g_daemons.insert(a);
+        fprintf(stderr, "[odtp-rendezvousd] learned daemon %s (%s)\n",
+                a.c_str(), source);
+    }
+}
 
 void expire_peers() {
     double now = now_s();
@@ -213,6 +262,31 @@ std::string peers_json() {
         first = false;
     }
     return out + "]";
+}
+
+// adopt unknown registry entries from a raw JSON array of peer objects
+// (replication from a worker announce or another daemon); existing --
+// locally fresher -- entries win, adopted peers age out via the normal TTL
+int adopt_peer_list(const std::string& raw_array) {
+    int adopted = 0;
+    for (const std::string& pj : split_array(raw_array)) {
+        Peer kp;
+        if (!get_string(pj, "peer_id", &kp.id) || kp.id.empty()) continue;
+        if (g_peers.count(kp.id)) continue;
+        get_string(pj, "host", &kp.host);
+        double kport = 0;
+        get_number(pj, "port", &kport);
+        kp.port = (int)kport;
+        std::string prog;
+        if (get_raw(pj, "progress", &prog)) kp.raw_progress = prog;
+        std::string serves;
+        if (get_raw(pj, "serves_state", &serves))
+            kp.serves_state = (serves == "true");
+        kp.last_seen = now_s();
+        g_peers[kp.id] = kp;
+        adopted++;
+    }
+    return adopted;
 }
 
 std::string frame(const std::string& type, const std::string& meta_json) {
@@ -312,33 +386,19 @@ void handle(int fd, const std::string& header) {
                 p.id.c_str(), p.host.c_str(), p.port);
         // registry replication (protocol twin of rendezvous.py): a
         // failing-over worker carries the swarm registry; adopt entries we
-        // don't have so matchmaking never sees a one-peer swarm. Existing
-        // entries win; adopted peers age out via the normal TTL.
+        // don't have so matchmaking never sees a one-peer swarm.
         if (has_known) {
-            int adopted = 0;
-            for (const std::string& pj : split_array(known)) {
-                Peer kp;
-                if (!get_string(pj, "peer_id", &kp.id) || kp.id.empty()) continue;
-                if (g_peers.count(kp.id)) continue;
-                get_string(pj, "host", &kp.host);
-                double kport = 0;
-                get_number(pj, "port", &kport);
-                kp.port = (int)kport;
-                std::string prog;
-                if (get_raw(pj, "progress", &prog)) kp.raw_progress = prog;
-                std::string serves;
-                if (get_raw(pj, "serves_state", &serves))
-                    kp.serves_state = (serves == "true");
-                kp.last_seen = now_s();
-                g_peers[kp.id] = kp;
-                adopted++;
-            }
+            int adopted = adopt_peer_list(known);
             if (adopted)
                 fprintf(stderr,
                         "[odtp-rendezvousd] adopted %d replicated "
                         "registration(s) from %s\n", adopted, p.id.c_str());
         }
-        queue_reply(fd, "ok", "{\"identity\":\"odtp-rendezvousd\",\"peers\":" + peers_json() + "}");
+        std::string kd;
+        if (get_raw(meta, "known_daemons", &kd)) adopt_daemons(kd, p.id.c_str());
+        queue_reply(fd, "ok",
+                    "{\"identity\":\"odtp-rendezvousd\",\"peers\":" + peers_json() +
+                        ",\"daemons\":" + daemons_json() + "}");
     } else if (type == "unregister") {
         std::string id;
         get_string(meta, "peer_id", &id);
@@ -366,7 +426,21 @@ void handle(int fd, const std::string& header) {
             if (get_raw(meta, "serves_state", &serves))
                 it->second.serves_state = (serves == "true");
         }
-        queue_reply(fd, "ok", "{\"peers\":" + peers_json() + "}");
+        std::string kd;
+        if (get_raw(meta, "known_daemons", &kd)) adopt_daemons(kd, id.c_str());
+        queue_reply(fd, "ok", "{\"peers\":" + peers_json() + ",\"daemons\":" +
+                                  daemons_json() + "}");
+    } else if (type == "daemon_hello") {
+        // a daemon added mid-run announces itself; record it and hand back
+        // the full registry + daemon set so it serves a current swarm view
+        std::string addr, ident = "?", kd;
+        get_string(meta, "daemon", &addr);
+        get_string(meta, "identity", &ident);
+        if (!addr.empty()) adopt_daemons("[\"" + json_escape(addr) + "\"]", ident.c_str());
+        if (get_raw(meta, "known_daemons", &kd)) adopt_daemons(kd, ident.c_str());
+        queue_reply(fd, "ok",
+                    "{\"identity\":\"odtp-rendezvousd\",\"peers\":" + peers_json() +
+                        ",\"daemons\":" + daemons_json() + "}");
     } else if (type == "who_has_state") {
         expire_peers();
         std::string exclude;
@@ -411,14 +485,92 @@ void handle(int fd, const std::string& header) {
     }
 }
 
+// blocking daemon_hello to an existing daemon (--join bootstrap): announce
+// this daemon, adopt the reply's registry + daemon set. Runs once before the
+// poll loop; failures are non-fatal (matching rendezvous.py --join).
+bool daemon_join(const std::string& addr, const std::string& identity) {
+    size_t colon = addr.rfind(':');
+    if (colon == std::string::npos) return false;
+    std::string host = addr.substr(0, colon);
+    int port = atoi(addr.c_str() + colon + 1);
+
+    // resolve hostnames too (TPU-VM fleets name their rendezvous hosts;
+    // the Python twin resolves via asyncio) -- not just dotted quads
+    addrinfo hints{}, *res = nullptr;
+    hints.ai_family = AF_INET;
+    hints.ai_socktype = SOCK_STREAM;
+    char portstr[16];
+    snprintf(portstr, sizeof portstr, "%d", port);
+    if (getaddrinfo(host.c_str(), portstr, &hints, &res) != 0 || !res)
+        return false;
+    int fd = socket(AF_INET, SOCK_STREAM, 0);
+    if (fd < 0) { freeaddrinfo(res); return false; }
+    timeval tv{5, 0};
+    setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof tv);
+    setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof tv);
+    int rc = connect(fd, res->ai_addr, (socklen_t)res->ai_addrlen);
+    freeaddrinfo(res);
+    if (rc != 0) {
+        close(fd);
+        return false;
+    }
+    std::string meta = "{\"daemon\":\"" + json_escape(g_advertise) +
+                       "\",\"identity\":\"" + json_escape(identity) +
+                       "\",\"known_daemons\":" + daemons_json() + "}";
+    std::string req = frame("daemon_hello", meta);
+    if (write(fd, req.data(), req.size()) != (ssize_t)req.size()) {
+        close(fd);
+        return false;
+    }
+    char hdr[8];
+    size_t hgot = 0;
+    while (hgot < 8) {  // the prefix can arrive split across segments
+        ssize_t n = read(fd, hdr + hgot, 8 - hgot);
+        if (n <= 0) { close(fd); return false; }
+        hgot += (size_t)n;
+    }
+    if (memcmp(hdr, "ODTP", 4) != 0) {
+        close(fd);
+        return false;
+    }
+    uint32_t hlen;
+    memcpy(&hlen, hdr + 4, 4);
+    hlen = ntohl(hlen);
+    if (hlen > (1u << 20)) { close(fd); return false; }
+    std::string header(hlen, 0);
+    size_t got = 0;
+    while (got < hlen) {
+        ssize_t n = read(fd, &header[got], hlen - got);
+        if (n <= 0) { close(fd); return false; }
+        got += (size_t)n;
+    }
+    close(fd);
+
+    std::string ds;
+    if (get_raw(header, "daemons", &ds)) adopt_daemons(ds, "join reply");
+    adopt_daemons("[\"" + json_escape(addr) + "\"]", "join");
+    std::string peers;
+    int adopted = 0;
+    if (get_raw(header, "peers", &peers)) adopted = adopt_peer_list(peers);
+    fprintf(stderr,
+            "[odtp-rendezvousd] joined daemon fabric via %s "
+            "(%d peers, %zu daemons adopted)\n",
+            addr.c_str(), adopted, g_daemons.size());
+    return true;
+}
+
 }  // namespace
 
 int main(int argc, char** argv) {
     int port = 29400;
     const char* identity_file = nullptr;
+    const char* advertise = nullptr;
+    const char* join = nullptr;
     for (int i = 1; i < argc - 1; ++i) {
         if (!strcmp(argv[i], "--port")) port = atoi(argv[i + 1]);
         if (!strcmp(argv[i], "--identity-file")) identity_file = argv[i + 1];
+        if (!strcmp(argv[i], "--advertise")) advertise = argv[i + 1];
+        if (!strcmp(argv[i], "--join")) join = argv[i + 1];
     }
     std::string identity = "odtp-rendezvousd";
     if (identity_file) {
@@ -455,6 +607,26 @@ int main(int argc, char** argv) {
     fprintf(stderr, "[odtp-rendezvousd] %s listening on :%d\n", identity.c_str(),
             ntohs(addr.sin_port));
     fflush(stdout);
+
+    // default advertise is loopback -- fine for single-host fabrics/tests;
+    // multi-host daemons MUST pass --advertise (workers refuse loopback
+    // addresses advertised by remote daemons, see TcpBackend._note_daemons)
+    char adv_buf[64];
+    snprintf(adv_buf, sizeof adv_buf, "127.0.0.1:%d", ntohs(addr.sin_port));
+    g_advertise = advertise ? advertise : adv_buf;
+    if (join) {
+        std::string list = join;
+        size_t start = 0;
+        while (start <= list.size()) {
+            size_t comma = list.find(',', start);
+            std::string a = list.substr(
+                start, comma == std::string::npos ? std::string::npos : comma - start);
+            if (!a.empty() && !daemon_join(a, identity))
+                fprintf(stderr, "[odtp-rendezvousd] --join %s failed\n", a.c_str());
+            if (comma == std::string::npos) break;
+            start = comma + 1;
+        }
+    }
 
     while (true) {
         std::vector<pollfd> pfds;

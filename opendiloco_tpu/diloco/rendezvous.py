@@ -68,12 +68,30 @@ class _GroupRound:
 
 
 class RendezvousServer:
-    def __init__(self, host: str = "0.0.0.0", port: int = 0, identity: Optional[str] = None):
+    def __init__(
+        self,
+        host: str = "0.0.0.0",
+        port: int = 0,
+        identity: Optional[str] = None,
+        advertise: Optional[str] = None,
+        join: Optional[list[str]] = None,
+    ):
         self.host = host
         self.port = port
         self.identity = identity or uuid.uuid4().hex[:16]
         self.peers: dict[str, PeerInfo] = {}
         self.rounds: dict[str, _GroupRound] = {}
+        # dynamic daemon membership: other rendezvous daemons this one knows
+        # of (addr string -> first_seen). Learned from `daemon_hello` (a new
+        # daemon announcing itself via --join) and from workers' announces
+        # (`known_daemons`). Advertised back to workers in every register/
+        # progress reply so the bootstrap list can be a single address and
+        # the daemon set can grow while the swarm runs -- the hivemind-DHT
+        # property that the peer fabric is not fixed at launch
+        # (reference: train_fsdp.py:205-212 initial_peers bootstrap).
+        self.daemons: dict[str, float] = {}
+        self._advertise = advertise
+        self._join = list(join or [])
         self._server: Optional[asyncio.base_events.Server] = None
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._thread: Optional[threading.Thread] = None
@@ -86,7 +104,9 @@ class RendezvousServer:
         """Run the server on a background thread (in-process daemon)."""
         self._thread = threading.Thread(target=self._thread_main, daemon=True)
         self._thread.start()
-        if not self._started.wait(10):
+        # --join announces run (synchronously, for determinism) before the
+        # started flag; give each unreachable join address its timeout
+        if not self._started.wait(10 + 6 * len(self._join)):
             raise RuntimeError("rendezvous server failed to start")
         return self
 
@@ -98,6 +118,11 @@ class RendezvousServer:
         self._server = await asyncio.start_server(self._handle, self.host, self.port, limit=STREAM_LIMIT)
         self.port = self._server.sockets[0].getsockname()[1]
         log.info("rendezvous %s listening on %s:%d", self.identity, self.host, self.port)
+        for addr in self._join:
+            try:
+                await self._daemon_hello(addr)
+            except Exception as e:
+                log.warning("daemon_hello to %s failed: %s", addr, e)
         if announce:
             # the BOUND port (with --port 0 the requested one is useless)
             print(
@@ -127,13 +152,88 @@ class RendezvousServer:
                         pass
                 self._server.close()
 
-            self._loop.call_soon_threadsafe(_shutdown)
+            try:
+                self._loop.call_soon_threadsafe(_shutdown)
+            except RuntimeError:
+                pass  # loop already closed -- stop() is idempotent
         if self._thread:
             self._thread.join(timeout=5)
 
     @property
     def address(self) -> str:
         return f"{self.host if self.host != '0.0.0.0' else '127.0.0.1'}:{self.port}"
+
+    @property
+    def advertised(self) -> str:
+        """The address this daemon tells peers/daemons to reach it at."""
+        return self._advertise or self.address
+
+    # -- dynamic daemon membership ---------------------------------------
+
+    async def _daemon_hello(self, addr: str) -> None:
+        """Announce this daemon to an existing one (--join bootstrap) and
+        adopt its registry + daemon set, so a daemon added mid-run serves a
+        current swarm view before the first worker ever reaches it."""
+        from opendiloco_tpu.diloco.wire import request
+
+        host, port = addr.rsplit(":", 1)
+        _, meta, _ = await request(
+            host,
+            int(port),
+            "daemon_hello",
+            {
+                "daemon": self.advertised,
+                "identity": self.identity,
+                "known_daemons": self._daemon_list(),
+            },
+            timeout=5.0,
+        )
+        self._adopt_daemons([addr], source="join")
+        self._adopt_daemons(meta.get("daemons", []), source="join reply")
+        adopted = self._adopt_peers(meta.get("peers", []))
+        log.info(
+            "joined daemon fabric via %s (%d peers, %d daemons adopted)",
+            addr,
+            adopted,
+            len(self.daemons),
+        )
+
+    def _adopt_peers(self, peers: list) -> int:
+        """Adopt unknown registry entries (replication from a worker announce
+        or another daemon). Existing -- locally fresher -- entries win;
+        adopted peers get a fresh TTL and expire normally if actually dead."""
+        adopted = 0
+        for p in peers or []:
+            pid = p.get("peer_id")
+            if not pid or pid in self.peers:
+                continue
+            self.peers[pid] = PeerInfo(
+                pid,
+                p.get("host", ""),
+                int(p.get("port", 0)),
+                progress=p.get("progress"),
+                serves_state=bool(p.get("serves_state", False)),
+            )
+            adopted += 1
+        return adopted
+
+    def _daemon_list(self) -> list[str]:
+        """This daemon's advertised address plus every daemon it knows."""
+        return [self.advertised] + sorted(self.daemons)
+
+    def _adopt_daemons(self, addrs: list, source: str = "") -> None:
+        # loopback guard (mirror of TcpBackend._note_daemons): a loopback
+        # address only means something on its own host, so a daemon that is
+        # itself multi-host-advertised must not adopt -- and re-advertise
+        # fabric-wide -- loopback aliases carried in from colocated workers
+        self_loopback = self.advertised.split(":")[0] in ("127.0.0.1", "localhost")
+        for a in addrs:
+            if not isinstance(a, str) or a == self.advertised or a in self.daemons:
+                continue
+            if a.split(":")[0] in ("127.0.0.1", "localhost") and not self_loopback:
+                continue
+            self.daemons[a] = time.monotonic()
+            log.info("learned rendezvous daemon %s (%s)", a, source)
 
     # -- request handling ------------------------------------------------
 
@@ -162,34 +262,24 @@ class RendezvousServer:
                 # swarm's registry (see TcpBackend._announce_to) so this
                 # daemon -- possibly fresh or restarted -- immediately knows
                 # every peer and matchmaking never closes a round around the
-                # single re-registered worker. Existing (locally fresher)
-                # entries win; carried peers get a fresh TTL and expire
-                # normally if actually dead.
-                adopted = 0
-                for p in meta.get("known_peers", []):
-                    pid = p.get("peer_id")
-                    if not pid or pid in self.peers:
-                        continue
-                    self.peers[pid] = PeerInfo(
-                        pid,
-                        p.get("host", ""),
-                        int(p.get("port", 0)),
-                        progress=p.get("progress"),
-                        serves_state=bool(p.get("serves_state", False)),
-                    )
-                    adopted += 1
+                # single re-registered worker.
+                adopted = self._adopt_peers(meta.get("known_peers", []))
                 if adopted:
                     log.info(
                         "adopted %d replicated registration(s) from %s",
                         adopted,
                         info.peer_id,
                     )
+                self._adopt_daemons(
+                    meta.get("known_daemons", []), source=info.peer_id
+                )
                 await send_frame(
                     writer,
                     "ok",
                     {
                         "identity": self.identity,
                         "peers": [p.to_json() for p in self._live_peers().values()],
+                        "daemons": self._daemon_list(),
                     },
                 )
             elif msg == "unregister":
@@ -206,10 +296,30 @@ class RendezvousServer:
                     self.peers[pid].last_seen = time.monotonic()
                     self.peers[pid].progress = meta["progress"]
                     self.peers[pid].serves_state = meta.get("serves_state", False)
+                self._adopt_daemons(meta.get("known_daemons", []), source=pid)
                 await send_frame(
                     writer,
                     "ok",
-                    {"peers": [p.to_json() for p in self._live_peers().values()]},
+                    {
+                        "peers": [p.to_json() for p in self._live_peers().values()],
+                        "daemons": self._daemon_list(),
+                    },
+                )
+            elif msg == "daemon_hello":
+                # a daemon added mid-run announces itself; hand it the full
+                # registry + daemon set and record it for worker discovery
+                self._adopt_daemons(
+                    [meta.get("daemon")] + list(meta.get("known_daemons", [])),
+                    source=f"daemon {meta.get('identity', '?')}",
+                )
+                await send_frame(
+                    writer,
+                    "ok",
+                    {
+                        "identity": self.identity,
+                        "peers": [p.to_json() for p in self._live_peers().values()],
+                        "daemons": self._daemon_list(),
+                    },
                 )
             elif msg == "join_group":
                 await self._join_group(writer, meta)
@@ -305,6 +415,22 @@ def main(argv: Optional[list[str]] = None) -> None:
         default=None,
         help="persist/reuse a stable daemon identity (fixed_key.pem parity)",
     )
+    ap.add_argument(
+        "--join",
+        default=None,
+        help="comma list of existing daemon addresses to join (the daemon "
+        "announces itself, adopts their registry, and workers learn it "
+        "from any daemon's replies)",
+    )
+    ap.add_argument(
+        "--advertise",
+        default=None,
+        help="address other hosts can reach this daemon at "
+        "(default: bind host:port, with 0.0.0.0 as 127.0.0.1). REQUIRED for "
+        "multi-host fabrics: workers refuse to adopt loopback addresses "
+        "from remote daemons, so an unadvertised daemon is only "
+        "discoverable on its own host",
+    )
     args = ap.parse_args(argv)
 
     identity = None
@@ -318,7 +444,13 @@ def main(argv: Optional[list[str]] = None) -> None:
             with open(args.identity_file, "w") as f:
                 f.write(identity)
 
-    server = RendezvousServer(args.host, args.port, identity)
+    server = RendezvousServer(
+        args.host,
+        args.port,
+        identity,
+        advertise=args.advertise,
+        join=args.join.split(",") if args.join else None,
+    )
     asyncio.run(server._serve_forever(announce=True))
 
 

@@ -201,9 +201,53 @@ class TcpBackend(OuterBackend):
         return self.rendezvous_list[self._rdv_idx]
 
     def _register_meta(self) -> dict:
-        return {"peer_id": self._peer_id, "host": self.host, "port": self.port}
+        return {
+            "peer_id": self._peer_id,
+            "host": self.host,
+            "port": self.port,
+            # workers carry the daemon membership the same way they carry
+            # the peer registry: every announce tells the daemon which other
+            # daemons this worker can reach, so membership learned anywhere
+            # propagates everywhere
+            "known_daemons": [f"{h}:{p}" for h, p in self.rendezvous_list],
+        }
 
-    def _note_peers(self, meta: dict) -> None:
+    def _note_daemons(self, meta: dict, source=None) -> None:
+        """Adopt daemon addresses advertised in a rendezvous reply.
+
+        APPEND semantics (unlike the peer registry's replace): the bootstrap
+        list's order is the failover/failback preference, and a daemon this
+        worker once knew may be the only one that survives -- dropping it
+        because one reply omitted it would shrink the escape hatch. Dead
+        daemons cost one fast connection-refused per failover sweep.
+
+        Loopback guard: a daemon bound without --advertise defaults to
+        advertising 127.0.0.1:<port>, which only means something on the
+        daemon's own host. Adopting it from a REMOTE daemon would point this
+        worker's failover at its own loopback (nothing there, or a different
+        swarm's local daemon) -- so loopback addresses are only adopted when
+        the daemon that advertised them is itself loopback (single-host
+        deployments and tests); multi-host daemons must set --advertise.
+
+        ``source`` is the daemon whose reply is being processed -- NOT
+        necessarily the current preferred daemon (the failback probe
+        announces to earlier-index daemons before switching to them).
+        """
+        src = source if source is not None else self.rendezvous
+        talking_to_loopback = src[0] in ("127.0.0.1", "localhost")
+        for a in meta.get("daemons", []):
+            try:
+                h, p = a.rsplit(":", 1)
+                addr = (h, int(p))
+            except (ValueError, AttributeError):
+                continue
+            if h in ("127.0.0.1", "localhost") and not talking_to_loopback:
+                continue
+            if addr not in self.rendezvous_list:
+                self.rendezvous_list.append(addr)
+                log.info("learned rendezvous daemon %s:%d at runtime", *addr)
+
+    def _note_peers(self, meta: dict, source=None) -> None:
         """Adopt a rendezvous reply's peer list as the carried registry.
 
         REPLACE semantics, not merge: the reply is the daemon's full live
@@ -213,6 +257,7 @@ class TcpBackend(OuterBackend):
         cleanly unregistered or TTL-expired, re-injecting them into daemons
         on every failover and stalling WAIT_FOR_ALL on departed workers.
         """
+        self._note_daemons(meta, source=source)
         if "peers" not in meta:
             return
         view = {p["peer_id"]: p for p in meta["peers"] if p.get("peer_id")}
@@ -232,7 +277,7 @@ class TcpBackend(OuterBackend):
             {**self._register_meta(), "known_peers": known},
             timeout=timeout,
         )
-        self._note_peers(meta)
+        self._note_peers(meta, source=addr)
         if self._own_progress is not None:
             p = self._own_progress
             await request(
@@ -571,9 +616,7 @@ class TcpBackend(OuterBackend):
                 self._rdv_request(
                     "progress",
                     {
-                        "peer_id": self._peer_id,
-                        "host": self.host,
-                        "port": self.port,
+                        **self._register_meta(),
                         "progress": {
                             "epoch": progress.epoch,
                             "samples": progress.samples,

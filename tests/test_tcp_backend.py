@@ -24,9 +24,11 @@ _NATIVE_DAEMON = os.path.join(_REPO, "native", "odtp-rendezvousd")
 class _NativeDaemon:
     """Handle mimicking RendezvousServer for the C++ daemon binary."""
 
-    def __init__(self):
+    def __init__(self, *extra_args):
         self.proc = subprocess.Popen(
-            [_NATIVE_DAEMON, "--port", "0"], stdout=subprocess.PIPE, text=True
+            [_NATIVE_DAEMON, "--port", "0", *extra_args],
+            stdout=subprocess.PIPE,
+            text=True,
         )
         line = self.proc.stdout.readline()
         m = re.search(r":(\d+)", line)
@@ -308,6 +310,67 @@ def test_rendezvous_dies_mid_matchmaking_registry_replicates(impl):
         for b in backends:
             b.close()
         secondary.stop()
+
+
+@pytest.mark.parametrize("impl", ["python", "native"])
+def test_daemon_added_at_runtime_extends_failover(impl):
+    """Daemon membership is dynamic, not fixed at launch: a daemon started
+    mid-run with --join announces itself to the fabric (daemon_hello),
+    workers learn it from any daemon's reply, and a worker bootstrapped
+    with ONLY the original daemon survives that daemon's death by failing
+    over to the late-joined one it learned at runtime (hivemind-DHT
+    property: any peer can become part of the bootstrap fabric,
+    reference train_fsdp.py:205-212).
+    """
+    import signal
+
+    if impl == "native":
+        if not os.path.exists(_NATIVE_DAEMON):
+            pytest.skip("native daemon not built (make -C native)")
+        a = _NativeDaemon()
+        b_daemon = _NativeDaemon("--join", a.address)
+
+        def kill_a():
+            a.proc.send_signal(signal.SIGKILL)
+            a.proc.wait(timeout=5)
+
+        def stop_a():
+            if a.proc.poll() is None:
+                a.stop()
+    else:
+        a = RendezvousServer(host="127.0.0.1", port=0).start_in_thread()
+        b_daemon = RendezvousServer(
+            host="127.0.0.1", port=0, join=[a.address]
+        ).start_in_thread()
+        kill_a = a.stop
+        stop_a = a.stop
+    w = TcpBackend(
+        [a.address], peer_id="dyn-0", matchmaking_time=1.0, rpc_timeout=5.0
+    )
+    try:
+        # the worker bootstrapped knowing only A; one heartbeat against A
+        # (whose reply advertises B) must teach it the new daemon
+        w.report_progress(PeerProgress("dyn-0", 0, 0, 1.0, time.time()))
+        w.peer_progress()
+        host, port = b_daemon.address.rsplit(":", 1)
+        assert (host, int(port)) in w.rendezvous_list
+
+        kill_a()  # only bootstrap-listed daemon dies
+
+        # the next RPC must fail over to the runtime-learned daemon -- and
+        # B must already serve a valid registry view for this worker
+        # (adopted at daemon_hello time, refreshed by the announce)
+        w.report_progress(PeerProgress("dyn-0", 1, 10, 1.0, time.time()))
+        time.sleep(0.6)  # age the progress cache past its 0.5s freshness
+        progress = w.peer_progress()
+        assert {p.peer_id for p in progress} == {"dyn-0"}
+        assert w.rendezvous == (host, int(port))
+        if impl == "python":
+            assert "dyn-0" in b_daemon.peers
+    finally:
+        w.close()
+        b_daemon.stop()
+        stop_a()
 
 
 def test_rendezvous_failover_at_startup():
