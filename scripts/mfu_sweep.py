@@ -1,0 +1,175 @@
+"""MFU sweep on the real chip: batch scaling x remat x model configs.
+
+Writes MFU_SWEEP.json at the repo root incrementally (a dying tunnel keeps
+whatever finished) and banks every measurement into BENCH_LIVE.json via
+bench._bank so the headline benchmark benefits too. Run under
+scripts/tunnel_watch.sh.
+
+Also records the compiled step's cost analysis (FLOPs, HBM bytes) for the
+best 150m config, giving a roofline attribution of where non-MXU time goes
+(the VERDICT r3 ask: a table with >=1 config at >=40% MFU, or a measured
+explanation of the ceiling).
+
+North-star: BASELINE.md >=40% inner-loop MFU on llama-150m.
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
+import bench  # noqa: E402  (repo-root headline bench; reuses its helpers)
+
+_OUT = os.path.join(_ROOT, "MFU_SWEEP.json")
+_DOC: dict = {"rows": [], "started": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())}
+
+
+def _flush():
+    _DOC["updated"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    with open(_OUT, "w") as f:
+        json.dump(_DOC, f, indent=1, sort_keys=True)
+        f.write("\n")
+
+
+def _watchdog(seconds: float):
+    def fire():
+        _DOC["aborted"] = f"watchdog after {seconds}s (tunnel wedge)"
+        _flush()
+        os._exit(0 if _DOC["rows"] else 4)
+
+    t = threading.Timer(seconds, fire)
+    t.daemon = True
+    t.start()
+    return t
+
+
+def main():
+    import jax
+
+    cache_dir = os.environ.get("OPENDILOCO_TPU_COMPILE_CACHE", "/tmp/odtp-jax-cache")
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass
+    wd = _watchdog(float(os.environ.get("MFU_SWEEP_TIMEOUT", "1700")))
+
+    from opendiloco_tpu.models.hf_io import get_model
+
+    _DOC["device"] = jax.devices()[0].device_kind
+    peak = bench.peak_flops_per_chip()
+    n_chips = len(jax.devices())
+    _flush()
+
+    # (model, seq, per-chip bs, accum, remat) -- known-best first so a short
+    # window still refreshes the headline; then the levers
+    plan = [
+        ("150m", 1024, 16, 1, True),
+        ("150m", 1024, 16, 1, False),
+        ("150m", 1024, 16, 1, "dots"),
+        ("150m", 1024, 24, 1, False),
+        ("150m", 1024, 32, 1, False),
+        ("150m", 1024, 32, 1, True),
+        ("150m", 1024, 8, 1, True),
+        ("150m", 2048, 8, 1, True),
+        ("1b", 1024, 4, 4, True),
+        ("1b", 1024, 8, 2, True),
+    ]
+    cfgs = {}
+    for model, seq, bs, accum, remat in plan:
+        if model not in cfgs:
+            cfgs[model] = get_model(model)[0]
+        cfg = cfgs[model]
+        bench._CTX.update(
+            model=model,
+            chips=n_chips,
+            device=_DOC["device"],
+            peak=peak,
+            flops_per_token=bench.model_flops_per_token(cfg, seq),
+        )
+        name = f"{model} seq{seq} bs{bs} accum{accum} remat={remat}"
+        try:
+            tps = bench._run_variant(
+                cfg, "pallas", True, seq, bs * n_chips, accum, remat=remat
+            )
+            mfu = tps * bench._CTX["flops_per_token"] / peak
+            row = {
+                "model": model, "seq": seq, "per_chip_bs": bs, "accum": accum,
+                "remat": str(remat), "attn": "pallas+fused",
+                "tokens_per_sec_per_chip": round(tps, 1),
+                "mfu": round(mfu, 4),
+            }
+            _DOC["rows"].append(row)
+            bench._bank(model, f"pallas+fused+remat={remat}+bs{bs}+seq{seq}", tps)
+            print(f"# {name}: {tps:.0f} tok/s/chip, {mfu:.1%} MFU", flush=True)
+        except Exception as e:
+            _DOC["rows"].append({"config": name, "error": f"{type(e).__name__}: {e}"})
+            print(f"# {name} failed: {e}", flush=True)
+        _flush()
+
+    # roofline attribution for the measured-best 150m row: compiled-step
+    # cost analysis says whether the ceiling is FLOPs or HBM bytes
+    try:
+        best = max(
+            (r for r in _DOC["rows"] if r.get("model") == "150m" and "mfu" in r),
+            key=lambda r: r["mfu"],
+            default=None,
+        )
+        if best is not None:
+            import numpy as np
+
+            from opendiloco_tpu.parallel.mesh import build_mesh
+            from opendiloco_tpu.trainer import InnerTrainer, TrainerConfig
+
+            cfg = cfgs["150m"]
+            remat = {"True": True, "False": False, "dots": "dots"}[best["remat"]]
+            tc = TrainerConfig(
+                lr=4e-4, warmup_steps=10, total_steps=1000,
+                precision="bf16-mixed", attn_impl="pallas", remat=remat,
+                fused_loss=True,
+            )
+            trainer = InnerTrainer(cfg, tc, build_mesh("NO_SHARD"))
+            state = trainer.init_state(jax.random.key(0))
+            ids = np.zeros((best["per_chip_bs"] * n_chips, best["seq"]), np.int32)
+            batch = trainer.shard_batch(ids, ids.copy(), accum=best["accum"])
+            lowered = trainer._train_step.lower(state, batch)  # noqa: SLF001
+            ca = lowered.compile().cost_analysis()
+            ca = ca[0] if isinstance(ca, (list, tuple)) else ca
+            flops = float(ca.get("flops", 0.0))
+            bytes_hbm = float(ca.get("bytes accessed", 0.0))
+            step_s = (
+                best["per_chip_bs"] * n_chips * best["seq"]
+                / (best["tokens_per_sec_per_chip"] * n_chips)
+            )
+            _DOC["roofline"] = {
+                "config": f"150m bs{best['per_chip_bs']} seq{best['seq']} remat={best['remat']}",
+                "xla_flops_per_step": flops,
+                "xla_hbm_bytes_per_step": bytes_hbm,
+                "measured_step_s": round(step_s, 5),
+                "flops_bound_step_s": round(flops / bench.peak_flops_per_chip(), 5),
+                # v5e HBM ~819 GB/s
+                "hbm_bound_step_s": round(bytes_hbm / 819e9, 5),
+                "note": (
+                    "step time vs max(flops_bound, hbm_bound) attributes the "
+                    "gap; if hbm_bound > flops_bound the kernel mix is "
+                    "bandwidth-limited and more MFU needs bigger batch/seq "
+                    "or fewer remat passes, not faster matmuls"
+                ),
+            }
+            _flush()
+    except Exception as e:
+        _DOC["roofline_error"] = f"{type(e).__name__}: {e}"
+        _flush()
+
+    wd.cancel()
+    _flush()
+    print(json.dumps(_DOC, indent=1, sort_keys=True))
+
+
+if __name__ == "__main__":
+    main()
