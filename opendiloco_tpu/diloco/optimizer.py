@@ -90,8 +90,35 @@ class DiLoCoOptimizer:
         self._abandoned: Optional[Any] = None  # dropped round still running
         self._landed_metrics: Optional[dict[str, Any]] = None
         self._apply_delta = None
+        # persistent pseudo-gradient buffers (reference: hivemind averages
+        # into the outer optimizer's persistent grad buffers,
+        # hivemind_diloco.py:68-119). Fresh model-sized allocations every
+        # round hit kernel page-fault/compaction stalls at 1b scale; two
+        # slots so the overlapped path never writes into buffers a wedged
+        # abandoned round might still be streaming from
+        self._pg_bufs: list[Optional[list[np.ndarray]]] = [None, None]
+        # alternation is tracked explicitly, NOT by epoch parity: onboarding
+        # (load_state_from_peers) teleports self.epoch to the swarm's value,
+        # which could land the next round on the slot an abandoned round is
+        # still streaming from
+        self._pg_slot = 0
 
         backend.serve_state(self._state_for_peers)
+
+    def _pseudo_grad_into(self, boundary: list, slot: int) -> list[np.ndarray]:
+        """master - boundary, written into the persistent slot buffers."""
+        bufs = self._pg_bufs[slot]
+        if (
+            bufs is None
+            or len(bufs) != len(self.master)
+            or any(b.shape != m.shape for b, m in zip(bufs, self.master))
+        ):
+            bufs = [np.empty(m.shape, np.float32) for m in self.master]
+            self._pg_bufs[slot] = bufs
+        return [
+            native.sub(m, d, out=b)
+            for m, d, b in zip(self.master, boundary, bufs)
+        ]
 
     # ------------------------------------------------------------------
     # onboarding (reference: load_state_from_peers, train_fsdp.py:348-349)
@@ -250,11 +277,20 @@ class DiLoCoOptimizer:
         if self._abandoned is not None:
             # a dropped round may still be running (its reduce can't be
             # cancelled); let it drain before keying a new round
+            drained = True
             try:
                 self._abandoned.result(timeout=self.cfg.averaging_timeout + 60)
+            except TimeoutError:
+                drained = False
             except Exception:
                 pass
             self._abandoned = None
+            if not drained:
+                # a truly wedged round may still be streaming from its
+                # pseudo-grad buffers: surrender both slots to it and
+                # allocate fresh ones rather than risk torn bytes on the
+                # wire (leaks one buffer set, once, on a pathological path)
+                self._pg_bufs = [None, None]
 
         # overlap the boundary D2H with the straggler wait (same trick as
         # the blocking path): params are final at the boundary
@@ -281,7 +317,8 @@ class DiLoCoOptimizer:
         wait_s = time.monotonic() - t0
         fetcher.join()
         boundary = fetch_result[0]
-        pseudo_grad = [native.sub(m, d) for m, d in zip(self.master, boundary)]
+        self._pg_slot ^= 1
+        pseudo_grad = self._pseudo_grad_into(boundary, slot=self._pg_slot)
 
         pending: dict[str, Any] = {
             "master_snap": [m.copy() for m in self.master],
@@ -508,8 +545,9 @@ class DiLoCoOptimizer:
         fetcher.join()
         device_flat = fetch_result[0]
 
-        # pseudo-gradient = master - current device params
-        pseudo_grad = [native.sub(m, d) for m, d in zip(self.master, device_flat)]
+        # pseudo-gradient = master - current device params (persistent slot
+        # buffer: the blocking path consumes it synchronously, slot 0 only)
+        pseudo_grad = self._pseudo_grad_into(device_flat, slot=0)
 
         t1 = time.monotonic()
         if self.cfg.outer_mode == "gossip":
@@ -557,7 +595,10 @@ class DiLoCoOptimizer:
             averaged_state, n = self.backend.all_reduce(
                 self.master, timeout=self.cfg.averaging_timeout, tag="state"
             )
-            self.master = [np.asarray(a, np.float32) for a in averaged_state]
+            # np.array COPIES: the result views live in a pooled backend
+            # buffer that the next all_reduce call reclaims (see the
+            # lifetime contract on TcpBackend.all_reduce)
+            self.master = [np.array(a, dtype=np.float32) for a in averaged_state]
             log.info("averaged full state over %d peers at epoch %d", n, self.epoch)
 
         state = self._write_master_to_device(state)  # [H2D]

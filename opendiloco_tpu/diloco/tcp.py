@@ -136,6 +136,18 @@ class TcpBackend(OuterBackend):
 
             self._bulk_server = BulkServer(self._deliver_bulk, host)
             self._bulk_sender = BulkSender()
+        # round-buffer pool: the flatten / accumulate / reassemble phases
+        # each touch a full model-sized f32 buffer per round, and fresh
+        # multi-GB allocations hit kernel page-fault/compaction stalls
+        # (measured 0.1 GB/s worst-case vs ~1 GB/s into an existing buffer;
+        # glibc munmaps >128KB frees, so every round refaults zeroed pages).
+        # Buffers check out by exact element count and check back in when
+        # the round (or the NEXT round, for buffers backing returned views)
+        # is done. Reference analogue: hivemind averages into the outer
+        # optimizer's persistent grad buffers (hivemind_diloco.py:68-119).
+        self._free_bufs: dict[int, list[np.ndarray]] = {}
+        self._retired_bufs: list[np.ndarray] = []  # reclaim at next round
+        self._pool_lock = threading.Lock()  # caller + event-loop threads
         self._progress_cache: list[PeerProgress] = []
         self._own_progress: Optional[PeerProgress] = None
         # full registry view (peer_id -> peer json) refreshed from every
@@ -658,6 +670,31 @@ class TcpBackend(OuterBackend):
             out.append(self._own_progress)
         return out
 
+    def _checkout_buf(self, count: int) -> np.ndarray:
+        with self._pool_lock:
+            free = self._free_bufs.get(count)
+            if free:
+                buf = free.pop()
+                if not free:  # empty keys must not count toward eviction
+                    del self._free_bufs[count]
+                return buf
+        return np.empty(count, np.float32)
+
+    def _checkin_buf(self, buf: Optional[np.ndarray]) -> None:
+        if buf is None:
+            return
+        with self._pool_lock:
+            self._free_bufs.setdefault(buf.size, []).append(buf)
+            # keep the pool bounded to the live working set: at most 2
+            # buffers per size, 4 sizes. Evict SMALLEST sizes first -- the
+            # multi-GB model-flat buffer is exactly the one whose fresh
+            # reallocation stalls on kernel page faults, so it must survive
+            # transient small sizes (barrier probes, gossip pairs)
+            if len(self._free_bufs[buf.size]) > 2:
+                self._free_bufs[buf.size].pop(0)
+            while len(self._free_bufs) > 4:
+                del self._free_bufs[min(self._free_bufs)]
+
     def all_reduce(
         self, arrays, *, timeout=None, tag: str = "grads", epoch=None, group_cap=0
     ):
@@ -666,7 +703,19 @@ class TcpBackend(OuterBackend):
         the same key (the rendezvous opens a fresh matchmaking window) and
         the group fingerprint keeps stale traffic out of the new round.
         ``group_cap`` > 0 asks the rendezvous to partition joiners into
-        groups of at most that size (gossip mode)."""
+        groups of at most that size (gossip mode).
+
+        RESULT LIFETIME: the returned arrays are views of a pooled internal
+        buffer that is recycled on the NEXT all_reduce call on this backend
+        -- consume (or copy) them before calling again. Every in-tree
+        consumer applies the result immediately (optimizer.outer_step); the
+        pooling is what keeps multi-GB rounds from re-faulting freshly
+        mmapped pages every epoch."""
+        # reclaim buffers whose views the caller has consumed by now
+        with self._pool_lock:
+            reclaim, self._retired_bufs = self._retired_bufs, []
+        for b in reclaim:
+            self._checkin_buf(b)
         timeout = timeout or 300.0
         deadline = time.monotonic() + timeout
         if epoch is None:
@@ -694,6 +743,23 @@ class TcpBackend(OuterBackend):
 
     async def _all_reduce_round(
         self, arrays: list[np.ndarray], join_key: str, deadline: float, group_cap=0
+    ):
+        scratch: list[np.ndarray] = []  # pooled buffers local to this round
+        try:
+            return await self._all_reduce_round_inner(
+                arrays, join_key, deadline, scratch, group_cap=group_cap
+            )
+        finally:
+            for b in scratch:
+                self._checkin_buf(b)
+
+    async def _all_reduce_round_inner(
+        self,
+        arrays: list[np.ndarray],
+        join_key: str,
+        deadline: float,
+        scratch: list[np.ndarray],
+        group_cap=0,
     ):
         timings: dict[str, float] = {}
         t_mm = time.monotonic()
@@ -740,7 +806,12 @@ class TcpBackend(OuterBackend):
             else np.ascontiguousarray(a, np.float32).reshape(-1)
             for a in arrays
         ]
-        flat = flats[0] if len(flats) == 1 else np.concatenate(flats)
+        if len(flats) == 1:
+            flat = flats[0]
+        else:
+            flat = self._checkout_buf(sum(f.size for f in flats))
+            scratch.append(flat)
+            np.concatenate(flats, out=flat)
         bounds = np.linspace(0, flat.size, n + 1).astype(np.int64)
         parts = [flat[bounds[j] : bounds[j + 1]] for j in range(n)]
         timings["flatten_s"] = time.monotonic() - t_ph
@@ -770,7 +841,9 @@ class TcpBackend(OuterBackend):
             from opendiloco_tpu import native as _native
             from opendiloco_tpu.diloco.bulk import release_buffer
 
-            acc = np.array(parts[my_idx], dtype=np.float32)
+            acc = self._checkout_buf(parts[my_idx].size)
+            scratch.append(acc)
+            np.copyto(acc, parts[my_idx])
             for p in group:
                 if p["peer_id"] == self._peer_id:
                     continue
@@ -838,8 +911,14 @@ class TcpBackend(OuterBackend):
         timings["all_gather_s"] = time.monotonic() - t_ph
         self.last_round_timings = timings
 
-        # 6. reassemble
-        flat_avg = np.concatenate([parts_avg[j] for j in range(n)])
+        # 6. reassemble. The result buffer outlives this round (the caller
+        # gets views of it), so it retires instead of joining scratch and is
+        # reclaimed at the START of the next all_reduce call (see the
+        # lifetime contract on all_reduce).
+        flat_avg = self._checkout_buf(flat.size)
+        with self._pool_lock:
+            self._retired_bufs.append(flat_avg)
+        np.concatenate([parts_avg[j] for j in range(n)], out=flat_avg)
         out, off = [], 0
         for a in arrays:
             out.append(flat_avg[off : off + a.size].reshape(a.shape))

@@ -102,9 +102,11 @@ def worker_main() -> None:
     ap.add_argument("--model", required=True)
     ap.add_argument("--compression", required=True)
     ap.add_argument("--rounds", type=int, required=True)
+    ap.add_argument("--peers", type=int, required=True)
     ap.add_argument("--timeout", type=float, default=600.0)
     args = ap.parse_args()
 
+    from opendiloco_tpu.diloco.backend import PeerProgress
     from opendiloco_tpu.diloco.tcp import TcpBackend
 
     data = make_leaves(args.model, args.rank)
@@ -114,11 +116,25 @@ def worker_main() -> None:
         compression=args.compression,
         matchmaking_time=1.0,
     )
+    # a worker that starts its round before the others register gets a SOLO
+    # matchmaking group (n=1, no wire traffic -- a meaningless number); the
+    # production loop gates rounds on peer progress, so the bench must too
+    backend.report_progress(
+        PeerProgress(f"bench-{args.rank}", 0, 0, 0.0, time.time())
+    )
+    deadline = time.time() + 120
+    # peer_progress() re-polls the rendezvous when its cache is stale;
+    # num_peers() alone would spin on a frozen snapshot
+    while len(backend.peer_progress()) < args.peers and time.time() < deadline:
+        time.sleep(0.3)
     times = []
+    n = 0
     for _ in range(args.rounds):
         t0 = time.perf_counter()
         out, n = backend.all_reduce(data, timeout=args.timeout)
         times.append(time.perf_counter() - t0)
+        if n < args.peers:
+            break  # solo/partial round: the row must not pass as a result
     timings = {
         k: round(v, 3)
         for k, v in getattr(backend, "last_round_timings", {}).items()
@@ -190,6 +206,7 @@ def main() -> None:
                         "--rendezvous", server.address, "--rank", str(i),
                         "--model", args.model, "--compression", compression,
                         "--rounds", str(args.rounds),
+                        "--peers", str(args.peers),
                         "--timeout", str(round_timeout),
                     ],
                     stdout=subprocess.PIPE,
@@ -224,6 +241,15 @@ def main() -> None:
                 _append_row({
                     "model": args.model, "peers": args.peers,
                     "codec": compression, "error": "worker failure",
+                })
+                continue
+            group_n = int(line.split()[-1].split("=")[1])
+            if group_n < args.peers:
+                print(f"{compression:>14}: SOLO/PARTIAL GROUP n={group_n}")
+                _append_row({
+                    "model": args.model, "peers": args.peers,
+                    "codec": compression,
+                    "error": f"matchmade group {group_n} < {args.peers}",
                 })
                 continue
             tline = next(

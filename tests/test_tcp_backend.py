@@ -312,6 +312,58 @@ def test_rendezvous_dies_mid_matchmaking_registry_replicates(impl):
         secondary.stop()
 
 
+def test_round_buffers_recycle_across_rounds():
+    """The flatten/accumulate/reassemble buffers are pooled per backend:
+    round N+1 recycles round N's result buffer (its views become invalid
+    at the next all_reduce call -- the documented lifetime contract), and
+    recycled buffers never leak stale values into the new round's average.
+    Fresh model-sized allocations every round hit kernel page-fault stalls
+    at 1b scale, which is why the pool exists.
+    """
+    server = RendezvousServer(host="127.0.0.1", port=0).start_in_thread()
+    backends = [
+        TcpBackend([server.address], peer_id=f"rb-{i}", matchmaking_time=1.0)
+        for i in range(2)
+    ]
+    try:
+        shapes = [(1000,), (37, 11), (5,)]  # multi-leaf: exercises concat
+
+        def data(round_no):
+            return [
+                [
+                    np.full(s, float(10 * round_no + i + 1), np.float32)
+                    for s in shapes
+                ]
+                for i in range(2)
+            ]
+
+        r1 = concurrent_allreduce(backends, data(1))
+        for out, group in r1:
+            assert group == 2
+            np.testing.assert_allclose(out[0], 11.5)
+        # epoch advances the round key (same-key rounds would collide)
+        for i, b in enumerate(backends):
+            b.report_progress(
+                PeerProgress(b.peer_id, 1, 100, 1.0, time.time())
+            )
+        r1_first_leaf = [out[0] for out, _ in r1]
+        r2 = concurrent_allreduce(backends, data(2))
+        for out, group in r2:
+            assert group == 2
+            np.testing.assert_allclose(out[0], 21.5)  # no stale round-1 data
+            np.testing.assert_allclose(out[1], 21.5)
+            np.testing.assert_allclose(out[2], 21.5)
+        # the recycling itself: the next all_reduce call reclaimed round 1's
+        # result buffer for its own use, so round 1's views no longer hold
+        # the round-1 average -- exactly what the lifetime contract warns
+        for i in range(2):
+            assert not np.allclose(r1_first_leaf[i], 11.5)
+    finally:
+        for b in backends:
+            b.close()
+        server.stop()
+
+
 @pytest.mark.parametrize("impl", ["python", "native"])
 def test_daemon_added_at_runtime_extends_failover(impl):
     """Daemon membership is dynamic, not fixed at launch: a daemon started
