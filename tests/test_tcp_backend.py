@@ -425,6 +425,42 @@ def test_daemon_added_at_runtime_extends_failover(impl):
         stop_a()
 
 
+def test_loopback_daemon_addresses_not_adopted_from_remote_sources():
+    """An unadvertised daemon defaults to 127.0.0.1:<port>, which only
+    means something on its own host. Workers must not adopt loopback
+    addresses advertised by a REMOTE daemon (they'd point failover at the
+    wrong machine), and a multi-host-advertised daemon must not adopt --
+    and re-advertise fabric-wide -- loopback aliases from announces.
+    Loopback-to-loopback adoption (single-host fabrics, tests) stays
+    allowed."""
+    server = RendezvousServer(host="127.0.0.1", port=0).start_in_thread()
+    w = TcpBackend([server.address], peer_id="lg-0", matchmaking_time=1.0)
+    try:
+        before = list(w.rendezvous_list)
+        # remote daemon advertising a loopback alias: refused
+        w._note_daemons({"daemons": ["127.0.0.1:19999"]}, source=("10.0.0.5", 1))
+        assert w.rendezvous_list == before
+        # loopback daemon advertising loopback: adopted
+        w._note_daemons({"daemons": ["127.0.0.1:19999"]}, source=("127.0.0.1", 1))
+        assert ("127.0.0.1", 19999) in w.rendezvous_list
+        # remote daemon advertising a real address: adopted
+        w._note_daemons({"daemons": ["10.0.0.6:29400"]}, source=("10.0.0.5", 1))
+        assert ("10.0.0.6", 29400) in w.rendezvous_list
+    finally:
+        w.close()
+        server.stop()
+
+    # daemon-side mirror guard
+    multi = RendezvousServer(host="127.0.0.1", port=0, advertise="10.0.0.5:29400")
+    multi._adopt_daemons(["127.0.0.1:19999"], source="worker")
+    assert "127.0.0.1:19999" not in multi.daemons
+    multi._adopt_daemons(["10.0.0.6:29400"], source="worker")
+    assert "10.0.0.6:29400" in multi.daemons
+    local = RendezvousServer(host="127.0.0.1", port=1234)
+    local._adopt_daemons(["127.0.0.1:19999"], source="worker")
+    assert "127.0.0.1:19999" in local.daemons
+
+
 def test_rendezvous_failover_at_startup():
     """A dead first daemon in initial_peers doesn't break backend startup."""
     live = RendezvousServer(host="127.0.0.1", port=0).start_in_thread()
