@@ -18,6 +18,7 @@ boundary, so the 500-step inner phases never recompile.
 
 from __future__ import annotations
 
+import concurrent.futures
 import threading
 import time
 from typing import Any, Optional
@@ -124,41 +125,46 @@ class DiLoCoOptimizer:
     # onboarding (reference: load_state_from_peers, train_fsdp.py:348-349)
     # ------------------------------------------------------------------
 
-    def _state_unlocked(self) -> dict[str, Any]:
+    def _state_refs_unlocked(self) -> tuple[list[np.ndarray], int, dict]:
+        """(master, epoch, outer_opt state) as REFERENCES — no array copies.
+
+        Safe to copy after the lock is released because every mutation path
+        rebinds (fresh lists / cloned optimizers) instead of writing the
+        published arrays in place; a captured reference stays bit-stable.
+        """
         if self._pending is not None:
             # while a round is in flight, epoch is already advanced but the
             # master excludes that round's update; serve the consistent
             # pre-round snapshot so an onboarding peer never adopts a
             # (new epoch, old master) mismatch
             p = self._pending
-            return {
-                "master": [m.copy() for m in p["master_snap"]],
-                "epoch": p["epoch"],
-                "outer_opt": dict(p["opt_snap"]),
-            }
+            return p["master_snap"], p["epoch"], p["opt_snap"]
         snap = self._blocking_snap
         if snap is not None:
             # blocking outer step in progress: serve the consistent
-            # pre-round snapshot, never the in-place-mutating live master
-            return {
-                "master": [m.copy() for m in snap["master"]],
-                "epoch": snap["epoch"],
-                "outer_opt": dict(snap["outer_opt"]),
-            }
-        return {
-            "master": [m.copy() for m in self.master],
-            "epoch": self.epoch,
-            "outer_opt": self.outer_opt.state_dict(),
-        }
+            # pre-round snapshot, never the mid-round live master
+            return snap["master"], snap["epoch"], snap["outer_opt"]
+        return self.master, self.epoch, self.outer_opt.state_dict_refs()
 
     def _state_for_peers(self) -> dict[str, Any]:
-        # the lock makes the flag checks + field reads in _state_unlocked
-        # atomic against the round-boundary publications (all of which also
-        # hold the lock): without it, a fetch that passes the flag checks
-        # just before a round completes could still copy a (pre-round
-        # master, post-round epoch) mix. Held only for host-RAM copies.
+        # the lock makes the flag checks + reference reads atomic against
+        # the round-boundary publications (all of which also hold the lock):
+        # without it, a fetch that passes the flag checks just before a
+        # round completes could capture a (pre-round master, post-round
+        # epoch) mix. The multi-GB array copies happen AFTER release so an
+        # onboarding peer's fetch never blocks the training thread's
+        # round-boundary publication (which needs the same lock).
         with self._serve_lock:
-            return self._state_unlocked()
+            master, epoch, opt_sd = self._state_refs_unlocked()
+        bufs = opt_sd.get("bufs")
+        return {
+            "master": [m.copy() for m in master],
+            "epoch": epoch,
+            "outer_opt": {
+                **opt_sd,
+                "bufs": None if bufs is None else [b.copy() for b in bufs],
+            },
+        }
 
     def load_state_from_peers(self, state: dict) -> Optional[dict]:
         """Adopt a peer's master params/epoch; returns updated device state."""
@@ -274,23 +280,7 @@ class DiLoCoOptimizer:
         t0 = time.monotonic()
         if self._pending is not None:  # at most one round in flight
             state = self._poll_pending(state, block=True)
-        if self._abandoned is not None:
-            # a dropped round may still be running (its reduce can't be
-            # cancelled); let it drain before keying a new round
-            drained = True
-            try:
-                self._abandoned.result(timeout=self.cfg.averaging_timeout + 60)
-            except TimeoutError:
-                drained = False
-            except Exception:
-                pass
-            self._abandoned = None
-            if not drained:
-                # a truly wedged round may still be streaming from its
-                # pseudo-grad buffers: surrender both slots to it and
-                # allocate fresh ones rather than risk torn bytes on the
-                # wire (leaks one buffer set, once, on a pathological path)
-                self._pg_bufs = [None, None]
+        self._drain_abandoned()
 
         # overlap the boundary D2H with the straggler wait (same trick as
         # the blocking path): params are final at the boundary
@@ -364,12 +354,35 @@ class DiLoCoOptimizer:
         self.last_outer_metrics = outer_metrics
         return state, outer_metrics
 
+    def _drain_abandoned(self) -> None:
+        """A dropped round may still be running (its reduce can't be
+        cancelled); let it drain before writing fresh pseudo-gradients into
+        slot buffers it might still be streaming from. Called by BOTH outer
+        paths: the blocking path writes slot 0, which an abandoned overlapped
+        round may own."""
+        if self._abandoned is None:
+            return
+        drained = True
+        try:
+            self._abandoned.result(timeout=self.cfg.averaging_timeout + 60)
+        except (TimeoutError, concurrent.futures.TimeoutError):
+            # on 3.10 futures.TimeoutError is NOT the builtin; both must be
+            # caught or a wedged round silently counts as drained
+            drained = False
+        except Exception:
+            pass
+        self._abandoned = None
+        if not drained:
+            # a truly wedged round may still be streaming from its
+            # pseudo-grad buffers: surrender both slots to it and
+            # allocate fresh ones rather than risk torn bytes on the
+            # wire (leaks one buffer set, once, on a pathological path)
+            self._pg_bufs = [None, None]
+
     def _spawn_all_reduce(self, pseudo_grad: list, epoch: int):
         """Run backend.all_reduce on a daemon thread (a wedged round must
         never block interpreter exit) with the round epoch pinned at submit
         time (the training thread advances self.epoch immediately after)."""
-        import concurrent.futures
-
         fut: concurrent.futures.Future = concurrent.futures.Future()
 
         def _run():
@@ -498,6 +511,9 @@ class DiLoCoOptimizer:
     def outer_step(self, state: dict) -> tuple[dict, dict]:
         if self._pending is not None:  # a blocking round supersedes overlap
             state = self._poll_pending(state, block=True)
+        # an abandoned overlapped round (desync re-onboard -> drop_pending)
+        # may still be streaming from slot 0; never write into it live
+        self._drain_abandoned()
         # parameter layout must be stable across the epoch (schema-hash
         # assertion, hivemind_diloco.py:560-568,575) -- a changed pytree
         # here means silent desync, not a recoverable condition
@@ -514,7 +530,9 @@ class DiLoCoOptimizer:
             self._blocking_snap = {
                 "master": self.master,
                 "epoch": self.epoch,
-                "outer_opt": self.outer_opt.state_dict(),
+                # refs, not copies: the round below clones-then-rebinds the
+                # optimizer, so these buf arrays stay bit-stable
+                "outer_opt": self.outer_opt.state_dict_refs(),
             }
         t0 = time.monotonic()
 
@@ -582,11 +600,15 @@ class DiLoCoOptimizer:
             allreduce_s,
         )
 
-        # copy-then-rebind: OuterSGD.step updates in place, and the serve
-        # thread may be reading the snapshot'd (pre-round) master arrays
+        # clone-then-rebind: OuterSGD.step updates params AND momentum bufs
+        # in place, and a serve-thread fetch may hold references to the
+        # current master/buf arrays (copies happen outside the lock); every
+        # live array must stay bit-stable once published
         new_master = [m.copy() for m in self.master]
-        self.outer_opt.step(new_master, averaged)
+        new_opt = self.outer_opt.clone()
+        new_opt.step(new_master, averaged)
         self.master = new_master
+        self.outer_opt = new_opt
 
         # optional periodic full state averaging (hivemind
         # average_state_every, hivemind_diloco.py:634-638): corrects any

@@ -48,13 +48,29 @@ class OuterSGD:
                 d = buf
             p -= self.lr * d
 
-    def state_dict(self) -> dict:
+    def clone(self) -> "OuterSGD":
+        """Deep copy (one buf copy, not the two of state_dict+load).
+        Enables the copy-on-write discipline in DiLoCoOptimizer: step the
+        clone, then rebind, so published buf arrays stay bit-stable."""
+        new = OuterSGD(lr=self.lr, momentum=self.momentum, nesterov=self.nesterov)
+        new.bufs = None if self.bufs is None else [b.copy() for b in self.bufs]
+        return new
+
+    def state_dict_refs(self) -> dict:
+        """state_dict without the buf copies — arrays are shared with the
+        live optimizer. Only safe while every mutation path rebinds rather
+        than writing published arrays in place."""
         return {
             "lr": self.lr,
             "momentum": self.momentum,
             "nesterov": self.nesterov,
-            "bufs": None if self.bufs is None else [b.copy() for b in self.bufs],
+            "bufs": self.bufs,
         }
+
+    def state_dict(self) -> dict:
+        sd = self.state_dict_refs()
+        sd["bufs"] = None if self.bufs is None else [b.copy() for b in self.bufs]
+        return sd
 
     def load_state_dict(self, state: dict) -> None:
         self.lr = state["lr"]

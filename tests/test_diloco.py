@@ -603,6 +603,92 @@ def test_desync_recovery(tiny_cfg):
     assert trainer._train_step._cache_size() == 1
 
 
+def test_blocking_outer_step_drains_abandoned_round(tiny_cfg):
+    """The blocking path writes slot-0 pseudo-grad buffers; an abandoned
+    overlapped round (desync re-onboard -> drop_pending) may still be
+    streaming from them, so outer_step must drain it first — and surrender
+    the buffers if it is wedged — before putting bytes on the wire."""
+    import concurrent.futures as cf
+    from types import SimpleNamespace
+
+    # unit: a finished abandoned round is cleared, buffers kept
+    stub = SimpleNamespace(
+        _abandoned=None,
+        _pg_bufs=[["slot0"], ["slot1"]],
+        cfg=SimpleNamespace(averaging_timeout=-59.8),  # drain deadline ~0.2s
+    )
+    fut: cf.Future = cf.Future()
+    fut.set_result(([np.zeros(1)], 1))
+    stub._abandoned = fut
+    DiLoCoOptimizer._drain_abandoned(stub)
+    assert stub._abandoned is None
+    assert stub._pg_bufs == [["slot0"], ["slot1"]]
+
+    # unit: a wedged round (never resolves) surrenders BOTH slots
+    stub._abandoned = cf.Future()
+    DiLoCoOptimizer._drain_abandoned(stub)
+    assert stub._abandoned is None
+    assert stub._pg_bufs == [None, None]
+
+    # integration: the blocking outer path drains before writing slot 0
+    trainer = make_trainer(tiny_cfg)
+    state = trainer.init_state(jax.random.key(7))
+    world = LoopbackWorld(1)
+    (backend,) = world.make_backends()
+    cfg = DilocoConfig(local_steps=2, backend="loopback", overlap_comm="none")
+    opt = DiLoCoOptimizer(trainer, backend, cfg, state, batch_size=8)
+    done: cf.Future = cf.Future()
+    done.set_result(([np.zeros(1)], 1))
+    opt._abandoned = done
+    for ids, labels in batches(0, tiny_cfg.vocab_size, 2):
+        state, _ = opt.step(state, trainer.shard_batch(ids, labels, accum=1))
+    assert opt.epoch == 1
+    assert opt._abandoned is None
+
+
+def test_onboarding_fetch_copies_outside_serve_lock(tiny_cfg):
+    """ADVICE r3: _state_for_peers must not hold the serve lock during the
+    model-sized copies — a peer's fetch would otherwise block the training
+    thread's round-boundary publication for seconds at 1b scale."""
+    trainer = make_trainer(tiny_cfg)
+    state = trainer.init_state(jax.random.key(7))
+    world = LoopbackWorld(1)
+    (backend,) = world.make_backends()
+    cfg = DilocoConfig(local_steps=4, backend="loopback")
+    opt = DiLoCoOptimizer(trainer, backend, cfg, state, batch_size=8)
+
+    lock_at_refs = []
+    lock_at_copy = []
+
+    class SpyList(list):
+        # _state_for_peers copies via `[m.copy() for m in master]`: record
+        # whether the serve lock is held at the moment the copies iterate
+        def __iter__(self):
+            lock_at_copy.append(opt._serve_lock.locked())
+            return super().__iter__()
+
+    real_refs = DiLoCoOptimizer._state_refs_unlocked
+
+    def spying_refs(self):
+        master, epoch, opt_sd = real_refs(self)
+        lock_at_refs.append(opt._serve_lock.locked())
+        return SpyList(master), epoch, opt_sd
+
+    opt._state_refs_unlocked = spying_refs.__get__(opt)
+    got = opt._state_for_peers()
+    # refs are captured under the lock; the copies run after it is released
+    assert lock_at_refs == [True]
+    assert lock_at_copy and not any(lock_at_copy)
+    assert not opt._serve_lock.locked()
+    assert got["epoch"] == 0
+    assert len(got["master"]) == len(opt.master)
+    # served arrays are copies, not aliases of the live master
+    assert not any(
+        g is m or np.shares_memory(g, m)
+        for g, m in zip(got["master"], opt.master)
+    )
+
+
 def test_no_recompilation_across_outer_step(tiny_cfg):
     """SURVEY hard-part 3: the inner jit step must not recompile after the
     outer step rewrites params (same shapes/shardings/donation)."""
