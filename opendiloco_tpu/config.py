@@ -143,6 +143,10 @@ class Config(BaseModel):
     # fused lm-head+xent Pallas kernel; None = auto (on for TPU dense models,
     # off elsewhere -- the kernel avoids the [tokens, vocab] f32 logits in HBM)
     fused_loss: Optional[bool] = None
+    # sp+pp cannot run ring attention; with this opt-in the sp axis shards
+    # activations only (full-sequence attention per device). Without it the
+    # combination is an error rather than a silent downgrade.
+    allow_sp_activation_sharding: bool = False
 
     # data
     dataset_name_or_paths: str = "allenai/c4"

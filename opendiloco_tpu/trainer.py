@@ -54,6 +54,12 @@ class TrainerConfig:
     # None = auto (TPU dense models on, otherwise off)
     fused_loss: Optional[bool] = None
     pp_microbatches: Optional[int] = None  # pipeline microbatches (None = pp size)
+    # sp+pp cannot run ring attention (it nests its own shard_map inside the
+    # pipeline stages); the only thing the sp axis can then do is shard
+    # activations while every device attends over the FULL sequence. That is
+    # a real memory-scaling mode but never an implicit one: combining sp and
+    # pp raises unless this is set.
+    allow_sp_activation_sharding: bool = False
     # fp16 dynamic loss scaling (torch GradScaler parity, train_fsdp.py:228,
     # 383-405; bf16 needs none -- the reference itself recommends bf16)
     init_loss_scale: float = 2.0**15
@@ -124,10 +130,14 @@ def _resolve_perf_defaults(
             changes["attn_impl"] = "ring"
         else:
             if getattr(plan, "sp_axis", None) is not None:
+                # sp+pp: only reachable with allow_sp_activation_sharding
+                # (InnerTrainer.__init__ raises otherwise); the sp axis
+                # shards activations while attention sees the full sequence
                 log.warning(
-                    "attn_impl=auto with sp+pp: ring attention cannot nest "
-                    "inside pipeline stages; falling back to full-sequence "
-                    "attention (the sp axis only shards activations)"
+                    "sp+pp with allow_sp_activation_sharding: using "
+                    "full-sequence %s attention; the sp axis only shards "
+                    "activations",
+                    "pallas" if on_tpu else "xla",
                 )
             changes["attn_impl"] = "pallas" if on_tpu else "xla"
     if tc.fused_loss is None:
@@ -155,6 +165,21 @@ class InnerTrainer:
     """
 
     def __init__(self, model_cfg: LlamaConfig, tc: TrainerConfig, plan: MeshPlan):
+        # checked before perf-default resolution: the auto path would
+        # otherwise log its opt-in warning for a combination about to raise
+        if (
+            plan.pp_axis
+            and getattr(plan, "sp_axis", None)
+            and not tc.allow_sp_activation_sharding
+        ):
+            raise ValueError(
+                "sp+pp cannot run ring attention (it nests its own shard_map "
+                "inside pipeline stages), so the sp axis would only shard "
+                "activations while every device attends over the FULL "
+                "sequence. If that activation-sharding mode is what you "
+                "want, opt in with --allow-sp-activation-sharding; otherwise "
+                "drop sp_size or pp_size"
+            )
         tc = _resolve_perf_defaults(tc, model_cfg, plan)
         self.model_cfg = model_cfg
         self.tc = tc

@@ -92,6 +92,45 @@ def test_pp_composes_with_fsdp_and_remat(pp_cfg):
     np.testing.assert_allclose(got, ref, rtol=1e-5, atol=2e-5)
 
 
+def test_sp_pp_requires_explicit_optin(pp_cfg):
+    """sp+pp cannot run ring attention, so the sp axis only shards
+    activations (full-sequence attention per device). That mode must be
+    chosen, not discovered: without allow_sp_activation_sharding the
+    combination is an error; with it, training runs and matches the
+    sequential trajectory."""
+    plan = build_mesh("NO_SHARD", pp_size=2, sp_size=2)
+    tc = TrainerConfig(
+        lr=1e-3, warmup_steps=2, total_steps=10, precision="fp32", remat=False
+    )
+    with pytest.raises(ValueError, match="allow-sp-activation-sharding"):
+        InnerTrainer(pp_cfg, tc, plan)
+
+    # the explicit attn choice doesn't bypass the gate either
+    tc_explicit = TrainerConfig(
+        lr=1e-3, warmup_steps=2, total_steps=10, precision="fp32",
+        remat=False, attn_impl="xla",
+    )
+    with pytest.raises(ValueError, match="allow-sp-activation-sharding"):
+        InnerTrainer(pp_cfg, tc_explicit, plan)
+
+    # opted in: runs, and the first-step loss matches the sequential ref
+    tc_ok = TrainerConfig(
+        lr=1e-3, warmup_steps=2, total_steps=10, precision="fp32",
+        remat=False, allow_sp_activation_sharding=True,
+    )
+    trainer = InnerTrainer(pp_cfg, tc_ok, plan)
+    state = trainer.init_state(jax.random.key(0))
+    ids = _data()
+    batch = trainer.shard_batch(ids, ids.copy(), accum=1)
+    _, m = trainer.train_step(state, batch)
+    params = jax.device_get(trainer.init_state(jax.random.key(0))["params"])
+    logits = forward(
+        params, jnp.asarray(ids), pp_cfg, compute_dtype=jnp.float32, remat=False
+    )
+    ref = float(causal_lm_loss(logits, jnp.asarray(ids)))
+    np.testing.assert_allclose(float(m["loss"]), ref, atol=2e-5)
+
+
 def test_pp_requires_divisible_layers(pp_cfg):
     """Layer count not divisible by pp: specs fall back to replicated, and
     the trainer refuses loudly at construction (a silent sequential
