@@ -114,6 +114,84 @@ def release_buffer(buf) -> None:
     _pool.release(buf)
 
 
+class _TokenBucket:
+    """Global egress rate cap emulating a constrained WAN link.
+
+    ``ODTP_BULK_BANDWIDTH_BPS`` (bytes/second; unset or 0 = unlimited) caps
+    the aggregate payload egress of this process across all bulk streams —
+    the bench's stand-in for tc/netem where traffic shaping isn't
+    permitted. Tokens are taken in chunks so concurrent stripes interleave
+    fairly instead of one stream draining the bucket."""
+
+    def __init__(self, rate_bps: float):
+        self.rate = float(rate_bps)
+        # ~50ms of burst, floor 1MB: small enough to shape the flow, large
+        # enough not to turn every chunk into a sleep
+        self.burst = max(self.rate * 0.05, float(1 << 20))
+        self.tokens = self.burst
+        self.t = time.monotonic()
+        self.lock = threading.Lock()
+
+    def acquire(self, n: int) -> None:
+        remaining = float(n)
+        while remaining > 0:
+            take = min(remaining, self.burst)
+            with self.lock:
+                now = time.monotonic()
+                self.tokens = min(
+                    self.burst, self.tokens + (now - self.t) * self.rate
+                )
+                self.t = now
+                if self.tokens >= take:
+                    self.tokens -= take
+                    remaining -= take
+                    continue
+                wait = (take - self.tokens) / self.rate
+            time.sleep(min(wait, 0.25))
+
+
+_rate_lock = threading.Lock()
+_rate_bucket: Optional[_TokenBucket] = None
+_rate_bps: float = -1.0
+
+
+def egress_bucket() -> Optional[_TokenBucket]:
+    """The process-wide egress bucket, rebuilt when the env knob changes
+    (the bench sweeps several caps in one parent process). Shared with the
+    asyncio RPC path: bytes that bypass the bulk plane (small frames, bulk
+    fallback) must drain the same budget or capped bench rows lie."""
+    global _rate_bucket, _rate_bps
+    try:
+        bps = float(os.environ.get("ODTP_BULK_BANDWIDTH_BPS", "0") or 0.0)
+    except ValueError:
+        bps = 0.0
+    with _rate_lock:
+        if bps != _rate_bps:
+            _rate_bps = bps
+            _rate_bucket = _TokenBucket(bps) if bps > 0 else None
+        return _rate_bucket
+
+
+_THROTTLE_CHUNK = 1 << 20
+
+
+_bucket = egress_bucket  # internal alias
+
+
+def _send_payload(sock: socket.socket, data) -> None:
+    """Payload sendall with the optional egress cap applied per-chunk."""
+    bucket = egress_bucket()
+    if bucket is None:
+        native.sock_sendall(sock, data)
+        return
+    view = data if isinstance(data, memoryview) else memoryview(data)
+    view = view.cast("B")
+    for off in range(0, len(view), _THROTTLE_CHUNK):
+        chunk = view[off : off + _THROTTLE_CHUNK]
+        bucket.acquire(len(chunk))
+        native.sock_sendall(sock, chunk)
+
+
 def _num_streams() -> int:
     try:
         return max(1, int(os.environ.get("ODTP_BULK_STREAMS", "4")))
@@ -154,7 +232,7 @@ def send_frame_sync(
     ).encode()
     native.sock_sendall(sock, _HDR.pack(MAGIC, len(header)) + header)
     if nbytes:
-        native.sock_sendall(sock, payload)
+        _send_payload(sock, payload)
 
 
 def read_frame_sync(sock: socket.socket) -> tuple[str, dict, np.ndarray]:
@@ -443,7 +521,7 @@ class BulkSender:
                 ).encode()
                 native.sock_sendall(conns[j], _HDR.pack(MAGIC, len(sub)) + sub)
                 if lens[j]:
-                    native.sock_sendall(conns[j], data[offs[j] : offs[j + 1]])
+                    _send_payload(conns[j], data[offs[j] : offs[j + 1]])
             except BaseException as e:  # surfaced on the main thread
                 errors.append(e)
 
@@ -455,7 +533,7 @@ class BulkSender:
             t.start()
         native.sock_sendall(conns[0], _HDR.pack(MAGIC, len(header)) + header)
         if lens[0]:
-            native.sock_sendall(conns[0], data[offs[0] : offs[1]])
+            _send_payload(conns[0], data[offs[0] : offs[1]])
         for t in threads:
             t.join()
         if errors:

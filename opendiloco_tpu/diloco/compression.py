@@ -47,6 +47,15 @@ class Codec:
         kernels where they exist."""
         native.add_inplace(dst, self.decode(payload, dst.shape, meta))
 
+    def decode_into(self, payload: bytes, meta: dict, dst: np.ndarray) -> None:
+        """dst[:] = decode(payload); dst is a contiguous float32 1-D view.
+
+        The butterfly's result-collect path decodes every gathered part
+        straight into its slice of the output buffer — one native pass, no
+        intermediate array, no reassembly concatenate. Base implementation
+        routes through ``self.decode``; subclasses write into dst directly."""
+        np.copyto(dst, self.decode(payload, dst.shape, meta))
+
 
 class Float16Codec(Codec):
     name = "fp16"
@@ -59,6 +68,9 @@ class Float16Codec(Codec):
 
     def decode_accumulate(self, payload, meta, dst):
         native.f16_accumulate(payload, dst)
+
+    def decode_into(self, payload, meta, dst):
+        native.f16_bytes_to_f32(payload, dst.size, out=dst)
 
 
 class ScaledFloat16Codec(Codec):
@@ -84,23 +96,36 @@ class ScaledFloat16Codec(Codec):
         native.scale_inplace(dec, float(meta["scale"]))
         native.add_inplace(dst, dec.reshape(dst.shape))
 
+    def decode_into(self, payload, meta, dst):
+        native.f16_bytes_to_f32(payload, dst.size, out=dst)
+        native.scale_inplace(dst, float(meta["scale"]))
+
 
 class Uniform8BitCodec(Codec):
-    """Linear min/max quantization to uint8."""
+    """Linear min/max quantization to uint8 (native single-pass kernels:
+    the numpy pipeline's astype + arithmetic allocations made this codec's
+    collect phases several times slower than the wire)."""
 
     name = "uniform8bit"
 
     def encode(self, arr):
-        arr = np.asarray(arr, np.float32)
-        lo = float(arr.min()) if arr.size else 0.0
-        hi = float(arr.max()) if arr.size else 0.0
-        span = (hi - lo) or 1.0
-        q = np.clip(np.round((arr - lo) / span * 255.0), 0, 255).astype(np.uint8)
-        return q.tobytes(), {"lo": lo, "span": span}
+        payload, lo, span = native.quantize_uniform8(arr)
+        return payload, {"lo": lo, "span": span}
 
     def decode(self, payload, shape, meta):
-        q = np.frombuffer(payload, dtype=np.uint8).astype(np.float32)
-        return (q / 255.0 * meta["span"] + meta["lo"]).reshape(shape)
+        return native.dequantize_uniform8(
+            payload, meta["lo"], meta["span"], int(np.prod(shape))
+        ).reshape(shape)
+
+    def decode_accumulate(self, payload, meta, dst):
+        native.dequant_uniform8_accumulate(
+            payload, meta["lo"], meta["span"], dst
+        )
+
+    def decode_into(self, payload, meta, dst):
+        native.dequantize_uniform8(
+            payload, meta["lo"], meta["span"], dst.size, out=dst
+        )
 
 
 class Quantile8BitCodec(Codec):
@@ -123,8 +148,17 @@ class Quantile8BitCodec(Codec):
 
     def decode(self, payload, shape, meta):
         codebook = np.frombuffer(payload[: 256 * 4], dtype=np.float32)
-        idx = np.frombuffer(payload[256 * 4 :], dtype=np.uint8)
-        return codebook[idx].reshape(shape)
+        return native.lut256_gather(
+            payload[256 * 4 :], codebook, int(np.prod(shape))
+        ).reshape(shape)
+
+    def decode_accumulate(self, payload, meta, dst):
+        codebook = np.frombuffer(payload[: 256 * 4], dtype=np.float32)
+        native.lut256_accumulate(payload[256 * 4 :], codebook, dst)
+
+    def decode_into(self, payload, meta, dst):
+        codebook = np.frombuffer(payload[: 256 * 4], dtype=np.float32)
+        native.lut256_gather(payload[256 * 4 :], codebook, dst.size, out=dst)
 
 
 class Blockwise8BitCodec(Codec):
@@ -151,6 +185,10 @@ class Blockwise8BitCodec(Codec):
     def decode_accumulate(self, payload, meta, dst):
         scales, q = self._split(payload, meta)
         native.dequant8_accumulate(q, scales, dst, _BLOCK)
+
+    def decode_into(self, payload, meta, dst):
+        scales, q = self._split(payload, meta)
+        native.dequantize_blockwise(q, scales, dst.size, _BLOCK, out=dst)
 
 
 _CODECS = {

@@ -566,6 +566,14 @@ class TcpBackend(OuterBackend):
                         bulk_port,
                         e,
                     )
+        # the RPC path must drain the same egress budget as the bulk plane:
+        # small frames (below the bulk threshold) and bulk-fallback sends
+        # would otherwise bypass the emulated link cap
+        from opendiloco_tpu.diloco.bulk import egress_bucket
+
+        bucket = egress_bucket()
+        if bucket is not None and nbytes:
+            await self._loop.run_in_executor(None, bucket.acquire, nbytes)
         await self._peer_request(host, port, msg, meta, payload, timeout=timeout)
 
     def _close_conn_pool(self) -> None:
@@ -863,9 +871,16 @@ class TcpBackend(OuterBackend):
         my_avg = results[0]
         timings["scatter_reduce_s"] = time.monotonic() - t_ph
 
-        # 5. fan the averaged part back out; gather the other parts
+        # 5. fan the averaged part back out; gather the other parts.
+        # Encode ONCE — the same payload serves every destination (the old
+        # per-destination encode re-quantized identical bytes n-1 times),
+        # and the owner adopts the DECODED wire value for its own part too:
+        # every peer then reconstructs a bit-identical averaged buffer
+        # regardless of codec lossiness (hivemind's averaged tensors have
+        # the same property: one compressed result, everyone decodes it)
+        result_payload, result_cmeta = self.codec.encode(my_avg)
+
         async def send_result(j):
-            payload, cmeta = self.codec.encode(my_avg)
             await self._send_part(
                 group[j]["host"],
                 group[j]["port"],
@@ -874,51 +889,58 @@ class TcpBackend(OuterBackend):
                     "round": round_key,
                     "part": my_idx,
                     "from": self._peer_id,
-                    "meta": cmeta,
+                    "meta": result_cmeta,
                     "shape": [int(my_avg.size)],
                 },
-                payload,
+                result_payload,
                 timeout=max(5.0, deadline - time.monotonic()),
             )
+
+        # the result buffer outlives this round (the caller gets views of
+        # it), so it retires instead of joining scratch and is reclaimed at
+        # the START of the next all_reduce call (see the lifetime contract
+        # on all_reduce). Checked out before the gather: every arriving
+        # part decodes STRAIGHT into its slice (one native pass per part,
+        # no intermediate array, no reassembly concatenate afterwards).
+        flat_avg = self._checkout_buf(flat.size)
+        with self._pool_lock:
+            self._retired_bufs.append(flat_avg)
 
         async def recv_results():
             from opendiloco_tpu.diloco.bulk import release_buffer
 
-            out: dict[int, np.ndarray] = {my_idx: my_avg}
+            self.codec.decode_into(
+                result_payload,
+                result_cmeta,
+                flat_avg[bounds[my_idx] : bounds[my_idx + 1]],
+            )
             for j in range(n):
                 if j == my_idx:
                     continue
                 rmeta, payload = await self._wait_mailbox(
                     (round_key, "result", j), deadline
                 )
-                out[j] = self.codec.decode(
-                    payload, (int(rmeta["shape"][0]),), rmeta["meta"]
-                )
-                # codec "none" decode aliases the payload (kept until the
-                # final concatenate); only recycle buffers the decode copied
-                if not (
-                    isinstance(payload, np.ndarray)
-                    and np.shares_memory(out[j], payload)
-                ):
-                    release_buffer(payload)
-            return out
+                dst = flat_avg[bounds[j] : bounds[j + 1]]
+                if int(rmeta["shape"][0]) != dst.size:
+                    raise WireError(
+                        f"result part {j}: peer claims {rmeta['shape']} "
+                        f"elements, expected {dst.size}"
+                    )
+                # (decode_into additionally validates the actual payload
+                # length against dst.size before any native kernel runs)
+                self.codec.decode_into(payload, rmeta["meta"], dst)
+                # fully decoded into flat_avg: recycle bulk-plane receive
+                # buffers (no-op for asyncio bytes payloads)
+                release_buffer(payload)
 
         t_ph = time.monotonic()
-        results = await asyncio.gather(
+        await asyncio.gather(
             recv_results(), *[send_result(j) for j in range(n) if j != my_idx]
         )
-        parts_avg = results[0]
         timings["all_gather_s"] = time.monotonic() - t_ph
         self.last_round_timings = timings
 
-        # 6. reassemble. The result buffer outlives this round (the caller
-        # gets views of it), so it retires instead of joining scratch and is
-        # reclaimed at the START of the next all_reduce call (see the
-        # lifetime contract on all_reduce).
-        flat_avg = self._checkout_buf(flat.size)
-        with self._pool_lock:
-            self._retired_bufs.append(flat_avg)
-        np.concatenate([parts_avg[j] for j in range(n)], out=flat_avg)
+        # 6. hand back per-array views of the reassembled buffer
         out, off = [], 0
         for a in arrays:
             out.append(flat_avg[off : off + a.size].reshape(a.shape))

@@ -73,6 +73,18 @@ def get_lib() -> Optional[ctypes.CDLL]:
     lib.odtp_quantile_assign.argtypes = [f32p, f32p, u8p, st]
     lib.odtp_quantile_edges.argtypes = [f32p, st, f32p]
     lib.odtp_version.restype = ctypes.c_int
+    try:  # version-2 kernels (a stale .so without them keeps the v1 surface)
+        lib.odtp_quantize_uniform8.argtypes = [f32p, u8p, st, f32p, f32p]
+        lib.odtp_dequantize_uniform8.argtypes = [
+            u8p, ctypes.c_float, ctypes.c_float, f32p, st,
+        ]
+        lib.odtp_dequantize_uniform8_accumulate.argtypes = [
+            u8p, ctypes.c_float, ctypes.c_float, f32p, st,
+        ]
+        lib.odtp_lut256_gather.argtypes = [u8p, f32p, f32p, st]
+        lib.odtp_lut256_accumulate.argtypes = [u8p, f32p, f32p, st]
+    except AttributeError:
+        pass
     for fn in (lib.odtp_sendall, lib.odtp_recvall):
         fn.argtypes = [ctypes.c_int, ctypes.c_void_p, st]
         fn.restype = ctypes.c_int
@@ -94,6 +106,24 @@ def _u16p(a: np.ndarray):
 
 def _i8p(a: np.ndarray):
     return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int8))
+
+
+def _check_out(out: np.ndarray) -> None:
+    """Decode destinations must be 1-D contiguous float32: the C kernels
+    write raw pointers, and the numpy fallbacks' reshape(-1) would silently
+    copy (and discard the result) for non-contiguous ND views."""
+    if out.dtype != np.float32 or out.ndim != 1 or not out.flags.c_contiguous:
+        raise ValueError(
+            "out must be a contiguous 1-D float32 array, got "
+            f"dtype={out.dtype} ndim={out.ndim} contiguous={out.flags.c_contiguous}"
+        )
+
+
+def _check_len(have: int, need: int, what: str) -> None:
+    """The C kernels read exactly `need` elements; a short payload (peer
+    bug, truncated transfer) must fail loudly, not read out of bounds."""
+    if have < need:
+        raise ValueError(f"{what}: payload holds {have} elements, need {need}")
 
 
 # -- public ops (native with numpy fallback) --------------------------------
@@ -146,12 +176,19 @@ def f32_to_f16_bytes(a: np.ndarray) -> bytes:
     return out.tobytes()
 
 
-def f16_bytes_to_f32(payload: bytes, n: int) -> np.ndarray:
+def f16_bytes_to_f32(
+    payload: bytes, n: int, out: Optional[np.ndarray] = None
+) -> np.ndarray:
     lib = get_lib()
-    if lib is None:
-        return np.frombuffer(payload, np.float16).astype(np.float32)
     src = np.frombuffer(payload, np.uint16)
-    out = np.empty(n, np.float32)
+    _check_len(src.size, n, "f16_bytes_to_f32")
+    if out is None:
+        out = np.empty(n, np.float32)
+    else:
+        _check_out(out)
+    if lib is None:
+        out[:] = np.frombuffer(payload, np.float16)[:n]
+        return out
     lib.odtp_f16_to_f32(_u16p(src), _f32p(out), n)
     return out
 
@@ -159,6 +196,7 @@ def f16_bytes_to_f32(payload: bytes, n: int) -> np.ndarray:
 def f16_accumulate(payload: bytes, dst: np.ndarray) -> None:
     """dst += decode_f16(payload) in one fused pass."""
     lib = get_lib()
+    _check_len(len(payload) // 2, dst.size, "f16_accumulate")
     if lib is None or dst.dtype != np.float32 or not dst.flags.c_contiguous:
         dst += np.frombuffer(payload, np.float16).astype(np.float32).reshape(dst.shape)
         return
@@ -186,16 +224,25 @@ def quantize_blockwise(a: np.ndarray, block: int) -> tuple[bytes, bytes]:
     return q.tobytes(), scales.tobytes()
 
 
-def dequantize_blockwise(payload: bytes, scales_payload: bytes, n: int, block: int) -> np.ndarray:
+def dequantize_blockwise(
+    payload: bytes, scales_payload: bytes, n: int, block: int,
+    out: Optional[np.ndarray] = None,
+) -> np.ndarray:
     lib = get_lib()
     q = np.frombuffer(payload, np.int8)
     scales = np.frombuffer(scales_payload, np.float32)
+    _check_len(q.size, n, "dequantize_blockwise")
+    _check_len(scales.size, (n + block - 1) // block, "dequantize_blockwise scales")
+    if out is None:
+        out = np.empty(n, np.float32)
+    else:
+        _check_out(out)
     if lib is None:
         pad = (-n) % block
-        qp = np.pad(q.astype(np.float32), (0, pad)).reshape(-1, block)
-        out = qp * (scales[:, None] / 127.0)
-        return out.reshape(-1)[:n].copy()
-    out = np.empty(n, np.float32)
+        qp = np.pad(q[:n].astype(np.float32), (0, pad)).reshape(-1, block)
+        dec = qp * (scales[: qp.shape[0], None] / 127.0)
+        out[:] = dec.reshape(-1)[:n]
+        return out
     lib.odtp_dequantize_blockwise_i8(_i8p(q), _f32p(scales), _f32p(out), n, block)
     return out
 
@@ -203,6 +250,12 @@ def dequantize_blockwise(payload: bytes, scales_payload: bytes, n: int, block: i
 def dequant8_accumulate(payload: bytes, scales_payload: bytes, dst: np.ndarray, block: int) -> None:
     """dst += dequantize_blockwise(payload) in one fused pass."""
     lib = get_lib()
+    _check_len(len(payload), dst.size, "dequant8_accumulate")
+    _check_len(
+        len(scales_payload) // 4,
+        (dst.size + block - 1) // block,
+        "dequant8_accumulate scales",
+    )
     if lib is None or dst.dtype != np.float32 or not dst.flags.c_contiguous:
         dst += dequantize_blockwise(payload, scales_payload, dst.size, block).reshape(
             dst.shape
@@ -213,6 +266,122 @@ def dequant8_accumulate(payload: bytes, scales_payload: bytes, dst: np.ndarray, 
     lib.odtp_dequantize_blockwise_i8_accumulate(
         _i8p(q), _f32p(scales), _f32p(dst), dst.size, block
     )
+
+
+def _u8p(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+
+
+def _has(lib, name: str) -> bool:
+    try:
+        return lib is not None and getattr(lib, name) is not None
+    except AttributeError:  # stale .so predating the symbol
+        return False
+
+
+def quantize_uniform8(a: np.ndarray) -> tuple[bytes, float, float]:
+    """Linear lo/span uint8 quantization -> (payload, lo, span); min/max
+    reduction and quantize both native single passes when built."""
+    a = np.ascontiguousarray(a, np.float32).reshape(-1)
+    lib = get_lib()
+    if not _has(lib, "odtp_quantize_uniform8"):
+        lo = float(a.min()) if a.size else 0.0
+        hi = float(a.max()) if a.size else 0.0
+        span = (hi - lo) or 1.0
+        q = np.clip(np.round((a - lo) / span * 255.0), 0, 255).astype(np.uint8)
+        return q.tobytes(), lo, span
+    q = np.empty(a.size, np.uint8)
+    lo_out = np.empty(1, np.float32)
+    span_out = np.empty(1, np.float32)
+    lib.odtp_quantize_uniform8(
+        _f32p(a), _u8p(q), a.size, _f32p(lo_out), _f32p(span_out)
+    )
+    return q.tobytes(), float(lo_out[0]), float(span_out[0])
+
+
+def dequantize_uniform8(
+    payload: bytes, lo: float, span: float, n: int,
+    out: Optional[np.ndarray] = None,
+) -> np.ndarray:
+    """Single-pass uniform8 decode, optionally straight into ``out``."""
+    q = np.frombuffer(payload, np.uint8)
+    _check_len(q.size, n, "dequantize_uniform8")
+    lib = get_lib()
+    if out is None:
+        out = np.empty(n, np.float32)
+    else:
+        _check_out(out)
+    if not _has(lib, "odtp_dequantize_uniform8"):
+        np.multiply(q[:n].astype(np.float32), span / 255.0, out=out)
+        out += lo
+        return out
+    lib.odtp_dequantize_uniform8(
+        _u8p(q), ctypes.c_float(lo), ctypes.c_float(span), _f32p(out), n
+    )
+    return out
+
+
+def dequant_uniform8_accumulate(
+    payload: bytes, lo: float, span: float, dst: np.ndarray
+) -> None:
+    """dst += uniform8_decode(payload) in one fused pass."""
+    lib = get_lib()
+    _check_len(len(payload), dst.size, "dequant_uniform8_accumulate")
+    if (
+        not _has(lib, "odtp_dequantize_uniform8_accumulate")
+        or dst.dtype != np.float32
+        or not dst.flags.c_contiguous
+    ):
+        q = np.frombuffer(payload, np.uint8)
+        dst += (q.astype(np.float32) * (span / 255.0) + lo).reshape(dst.shape)
+        return
+    lib.odtp_dequantize_uniform8_accumulate(
+        _u8p(np.frombuffer(payload, np.uint8)),
+        ctypes.c_float(lo),
+        ctypes.c_float(span),
+        _f32p(dst),
+        dst.size,
+    )
+
+
+def lut256_gather(
+    idx_payload: bytes, lut: np.ndarray, n: int,
+    out: Optional[np.ndarray] = None,
+) -> np.ndarray:
+    """out = lut[idx] (quantile codebook decode), optionally into ``out``."""
+    idx = np.frombuffer(idx_payload, np.uint8)
+    _check_len(idx.size, n, "lut256_gather")
+    lut = np.ascontiguousarray(lut, np.float32)
+    _check_len(lut.size, 256, "lut256_gather codebook")
+    lib = get_lib()
+    if out is None:
+        out = np.empty(n, np.float32)
+    else:
+        _check_out(out)
+    if not _has(lib, "odtp_lut256_gather"):
+        np.take(lut, idx[:n], out=out)
+        return out
+    lib.odtp_lut256_gather(_u8p(idx), _f32p(lut), _f32p(out), n)
+    return out
+
+
+def lut256_accumulate(
+    idx_payload: bytes, lut: np.ndarray, dst: np.ndarray
+) -> None:
+    """dst += lut[idx] in one fused pass."""
+    idx = np.frombuffer(idx_payload, np.uint8)
+    _check_len(idx.size, dst.size, "lut256_accumulate")
+    lut = np.ascontiguousarray(lut, np.float32)
+    _check_len(lut.size, 256, "lut256_accumulate codebook")
+    lib = get_lib()
+    if (
+        not _has(lib, "odtp_lut256_accumulate")
+        or dst.dtype != np.float32
+        or not dst.flags.c_contiguous
+    ):
+        dst += lut[idx].reshape(dst.shape)
+        return
+    lib.odtp_lut256_accumulate(_u8p(idx), _f32p(lut), _f32p(dst), dst.size)
 
 
 def quantile_assign(flat: np.ndarray, inner_edges: np.ndarray) -> np.ndarray:

@@ -164,6 +164,16 @@ def _append_row(row: dict) -> None:
         f.write("\n")
 
 
+def _parse_bandwidth(spec: str) -> float:
+    """'1gbps' / '100mbps' / '12500000' (bytes/s) -> bytes/s; 0 = unlimited."""
+    s = spec.strip().lower()
+    if s.endswith("gbps"):
+        return float(s[:-4]) * 1e9 / 8
+    if s.endswith("mbps"):
+        return float(s[:-4]) * 1e6 / 8
+    return float(s or 0)
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--peers", type=int, default=2)
@@ -171,6 +181,13 @@ def main() -> None:
     ap.add_argument("--rounds", type=int, default=3)
     ap.add_argument("--codecs", default=",".join(ALL_CODECS),
                     help="comma list from: " + ",".join(ALL_CODECS))
+    ap.add_argument(
+        "--bandwidth", default="0",
+        help="comma list of per-worker egress caps (token bucket in the "
+        "bulk plane), e.g. '0,1gbps,100mbps'; 0 = unlimited. The caps make "
+        "the codec tradeoff measurable: on a constrained link the 8-bit "
+        "wire beats raw fp32 even after paying encode/decode",
+    )
     args = ap.parse_args()
 
     from opendiloco_tpu.diloco.rendezvous import RendezvousServer
@@ -182,104 +199,127 @@ def main() -> None:
     nbytes = sum(
         int(np.prod(s.shape)) * 4 for s in jax.tree.leaves(shapes(cfg))
     )
-    # generous per-round budget on a throttled box: quantile encode of a
-    # 4 GB buffer on one core is minutes, not seconds
-    round_timeout = max(600.0, nbytes / 20e6)
-    proc_timeout = args.rounds * round_timeout + 300.0
     print(
         f"model {args.model}: {nbytes / 1e6:.0f} MB fp32, {args.peers} peers, "
         f"{args.rounds} rounds, cores={os.cpu_count()}"
     )
 
-    env = dict(os.environ)
-    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
-    env.setdefault("OPENDILOCO_TPU_PLATFORM", "cpu")
+    base_env = dict(os.environ)
+    base_env["PYTHONPATH"] = REPO + os.pathsep + base_env.get("PYTHONPATH", "")
+    base_env.setdefault("OPENDILOCO_TPU_PLATFORM", "cpu")
 
     server = RendezvousServer(host="127.0.0.1", port=0).start_in_thread()
     try:
-        for compression in args.codecs.split(","):
-            ceiling = loopback_ceiling_gbps()
-            procs = [
-                subprocess.Popen(
-                    [
-                        sys.executable, os.path.abspath(__file__), "--worker",
-                        "--rendezvous", server.address, "--rank", str(i),
-                        "--model", args.model, "--compression", compression,
-                        "--rounds", str(args.rounds),
-                        "--peers", str(args.peers),
-                        "--timeout", str(round_timeout),
-                    ],
-                    stdout=subprocess.PIPE,
-                    text=True,
-                    env=env,
-                )
-                for i in range(args.peers)
-            ]
-            try:
-                outs = [p.communicate(timeout=proc_timeout)[0] for p in procs]
-            except subprocess.TimeoutExpired:
-                for p in procs:
-                    p.kill()
-                for p in procs:  # reap; drain pipes so fds don't leak
-                    try:
-                        p.communicate(timeout=10)
-                    except Exception:
-                        pass
-                print(f"{compression:>14}: TIMEOUT")
-                _append_row({
-                    "model": args.model, "peers": args.peers,
-                    "codec": compression, "error": "timeout",
-                })
-                continue
-            line = next(
-                (l for o in outs for l in o.splitlines()
-                 if l.startswith("RESULT")),
-                None,
-            )
-            if line is None or any(p.returncode for p in procs):
-                print(f"{compression:>14}: FAILED")
-                _append_row({
-                    "model": args.model, "peers": args.peers,
-                    "codec": compression, "error": "worker failure",
-                })
-                continue
-            group_n = int(line.split()[-1].split("=")[1])
-            if group_n < args.peers:
-                print(f"{compression:>14}: SOLO/PARTIAL GROUP n={group_n}")
-                _append_row({
-                    "model": args.model, "peers": args.peers,
-                    "codec": compression,
-                    "error": f"matchmade group {group_n} < {args.peers}",
-                })
-                continue
-            tline = next(
-                (l for o in outs for l in o.splitlines()
-                 if l.startswith("TIMINGS")),
-                None,
-            )
-            timings = json.loads(tline.split(None, 1)[1]) if tline else {}
-            times = [float(x) for x in line.split()[1:-1]]
-            best = min(times)
-            eff = nbytes / best / 1e9
-            row = {
-                "model": args.model, "mb_fp32": round(nbytes / 1e6),
-                "peers": args.peers, "codec": compression,
-                "rounds_s": [round(t, 3) for t in times],
-                "best_s": round(best, 3),
-                "median_s": round(statistics.median(times), 3),
-                "eff_gbps": round(eff, 3),
-                "loopback_ceiling_gbps": round(ceiling, 3),
-                "normalized_eff": round(eff / ceiling, 4),
-                "last_round_timings": timings,
-            }
-            _append_row(row)
-            print(
-                f"{compression:>14}: {best * 1e3:8.0f} ms/round best  "
-                f"({eff:5.2f} GB/s eff, ceiling {ceiling:5.2f} GB/s, "
-                f"normalized {eff / ceiling:5.1%})"
-            )
+        for bw_spec in args.bandwidth.split(","):
+            cap_bps = _parse_bandwidth(bw_spec)
+            run_sweep(args, server, nbytes, base_env, cap_bps)
     finally:
         server.stop()
+
+
+def run_sweep(args, server, nbytes, base_env, cap_bps: float) -> None:
+    # generous per-round budget on a throttled box: quantile encode of a
+    # 4 GB buffer on one core is minutes, not seconds. Under an egress cap
+    # the fp32 wire alone needs ~nbytes/cap per phase; budget 4x that.
+    round_timeout = max(600.0, nbytes / 20e6)
+    if cap_bps > 0:
+        round_timeout = max(round_timeout, 4.0 * nbytes / cap_bps)
+    proc_timeout = args.rounds * round_timeout + 300.0
+    env = dict(base_env)
+    if cap_bps > 0:
+        env["ODTP_BULK_BANDWIDTH_BPS"] = str(int(cap_bps))
+        print(f"-- egress cap {cap_bps * 8 / 1e6:.0f} Mbps per worker --")
+    else:
+        env.pop("ODTP_BULK_BANDWIDTH_BPS", None)
+    cap_note = (
+        {"bandwidth_mbps": round(cap_bps * 8 / 1e6)} if cap_bps > 0 else {}
+    )
+    for compression in args.codecs.split(","):
+        ceiling = loopback_ceiling_gbps()
+        procs = [
+            subprocess.Popen(
+                [
+                    sys.executable, os.path.abspath(__file__), "--worker",
+                    "--rendezvous", server.address, "--rank", str(i),
+                    "--model", args.model, "--compression", compression,
+                    "--rounds", str(args.rounds),
+                    "--peers", str(args.peers),
+                    "--timeout", str(round_timeout),
+                ],
+                stdout=subprocess.PIPE,
+                text=True,
+                env=env,
+            )
+            for i in range(args.peers)
+        ]
+        try:
+            outs = [p.communicate(timeout=proc_timeout)[0] for p in procs]
+        except subprocess.TimeoutExpired:
+            for p in procs:
+                p.kill()
+            for p in procs:  # reap; drain pipes so fds don't leak
+                try:
+                    p.communicate(timeout=10)
+                except Exception:
+                    pass
+            print(f"{compression:>14}: TIMEOUT")
+            _append_row({
+                "model": args.model, "peers": args.peers,
+                "codec": compression, "error": "timeout", **cap_note,
+            })
+            continue
+        line = next(
+            (l for o in outs for l in o.splitlines()
+             if l.startswith("RESULT")),
+            None,
+        )
+        if line is None or any(p.returncode for p in procs):
+            print(f"{compression:>14}: FAILED")
+            _append_row({
+                "model": args.model, "peers": args.peers,
+                "codec": compression, "error": "worker failure", **cap_note,
+            })
+            continue
+        group_n = int(line.split()[-1].split("=")[1])
+        if group_n < args.peers:
+            print(f"{compression:>14}: SOLO/PARTIAL GROUP n={group_n}")
+            _append_row({
+                "model": args.model, "peers": args.peers,
+                "codec": compression,
+                "error": f"matchmade group {group_n} < {args.peers}",
+                **cap_note,
+            })
+            continue
+        tline = next(
+            (l for o in outs for l in o.splitlines()
+             if l.startswith("TIMINGS")),
+            None,
+        )
+        timings = json.loads(tline.split(None, 1)[1]) if tline else {}
+        times = [float(x) for x in line.split()[1:-1]]
+        best = min(times)
+        eff = nbytes / best / 1e9
+        # normalize against whichever is binding: the box's socket ceiling
+        # or the emulated link cap
+        norm_base = min(ceiling, cap_bps / 1e9) if cap_bps > 0 else ceiling
+        row = {
+            "model": args.model, "mb_fp32": round(nbytes / 1e6),
+            "peers": args.peers, "codec": compression,
+            "rounds_s": [round(t, 3) for t in times],
+            "best_s": round(best, 3),
+            "median_s": round(statistics.median(times), 3),
+            "eff_gbps": round(eff, 3),
+            "loopback_ceiling_gbps": round(ceiling, 3),
+            "normalized_eff": round(eff / norm_base, 4),
+            "last_round_timings": timings,
+            **cap_note,
+        }
+        _append_row(row)
+        print(
+            f"{compression:>14}: {best * 1e3:8.0f} ms/round best  "
+            f"({eff:5.2f} GB/s eff, ceiling {ceiling:5.2f} GB/s, "
+            f"normalized {eff / norm_base:5.1%})"
+        )
 
 
 if __name__ == "__main__":

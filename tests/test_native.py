@@ -70,6 +70,105 @@ def test_blockwise_partial_last_block():
     assert np.abs(out - a).max() <= np.abs(a).max() * 0.02
 
 
+def _without_native():
+    """Context values to temporarily force the numpy fallback."""
+    import opendiloco_tpu.native as native_mod
+
+    return native_mod
+
+
+def test_uniform8_native_matches_fallback(arrs):
+    """Native uniform8 quantize/dequant/accumulate match the numpy
+    fallback (same rounding, same lo/span)."""
+    a, b = arrs
+    payload, lo, span = native.quantize_uniform8(a)
+    nm = _without_native()
+    lib, tried = nm._lib, nm._tried
+    nm._lib, nm._tried = None, True
+    try:
+        payload_ref, lo_ref, span_ref = native.quantize_uniform8(a)
+        dec_ref = native.dequantize_uniform8(payload_ref, lo_ref, span_ref, a.size)
+    finally:
+        nm._lib, nm._tried = lib, tried
+    if not native.available():
+        pytest.skip("native lib not built")
+    assert payload == payload_ref
+    assert abs(lo - lo_ref) < 1e-6 and abs(span - span_ref) < 1e-6
+    dec = native.dequantize_uniform8(payload, lo, span, a.size)
+    np.testing.assert_allclose(dec, dec_ref, rtol=1e-6)
+    # fused accumulate == decode + add
+    dst = b.copy()
+    native.dequant_uniform8_accumulate(payload, lo, span, dst)
+    np.testing.assert_allclose(dst, b + dec, rtol=1e-6, atol=1e-6)
+    # decode straight into a destination slice
+    out = np.empty(a.size + 8, np.float32)[4:-4]
+    native.dequantize_uniform8(payload, lo, span, a.size, out=out)
+    np.testing.assert_array_equal(out, dec)
+
+
+def test_lut256_native_matches_fallback(arrs):
+    a, b = arrs
+    rng = np.random.default_rng(3)
+    lut = rng.normal(size=256).astype(np.float32)
+    idx = rng.integers(0, 256, a.size).astype(np.uint8)
+    got = native.lut256_gather(idx.tobytes(), lut, a.size)
+    np.testing.assert_array_equal(got, lut[idx])
+    dst = b.copy()
+    native.lut256_accumulate(idx.tobytes(), lut, dst)
+    np.testing.assert_allclose(dst, b + lut[idx], rtol=1e-6)
+    out = np.empty(a.size, np.float32)
+    native.lut256_gather(idx.tobytes(), lut, a.size, out=out)
+    np.testing.assert_array_equal(out, got)
+
+
+def test_decode_into_matches_decode():
+    """Every codec's decode_into writes exactly decode()'s values into the
+    destination view (the butterfly result path relies on this)."""
+    from opendiloco_tpu.diloco.compression import _CODECS
+
+    rng = np.random.default_rng(4)
+    arr = rng.normal(size=5000).astype(np.float32)
+    for name, codec in _CODECS.items():
+        payload, meta = codec.encode(arr)
+        ref = codec.decode(payload, arr.shape, meta).reshape(-1)
+        dst = np.full(arr.size, np.nan, np.float32)
+        codec.decode_into(payload, meta, dst)
+        np.testing.assert_allclose(dst, ref, rtol=1e-6, atol=1e-7, err_msg=name)
+
+
+def test_decode_rejects_short_payloads_and_bad_out():
+    """The C kernels read exactly n elements: a truncated payload must
+    raise, never read out of bounds; decode destinations must be 1-D
+    contiguous f32 (the fallbacks' reshape would silently copy)."""
+    rng = np.random.default_rng(6)
+    a = rng.normal(size=1000).astype(np.float32)
+    p, lo, span = native.quantize_uniform8(a)
+    with pytest.raises(ValueError, match="payload holds"):
+        native.dequantize_uniform8(p[:500], lo, span, a.size)
+    with pytest.raises(ValueError, match="payload holds"):
+        native.dequant_uniform8_accumulate(p[:500], lo, span, a.copy())
+    with pytest.raises(ValueError, match="contiguous"):
+        native.dequantize_uniform8(
+            p, lo, span, 500, out=np.empty(1000, np.float32)[::2]
+        )
+    f16 = native.f32_to_f16_bytes(a)
+    with pytest.raises(ValueError, match="payload holds"):
+        native.f16_bytes_to_f32(f16[:100], a.size)
+    with pytest.raises(ValueError, match="payload holds"):
+        native.f16_accumulate(f16[:100], a.copy())
+    q, s = native.quantize_blockwise(a, 512)
+    with pytest.raises(ValueError, match="payload holds"):
+        native.dequantize_blockwise(q[:10], s, a.size, 512)
+    with pytest.raises(ValueError, match="scales"):
+        native.dequantize_blockwise(q, s[:4], a.size, 512)
+    lut = rng.normal(size=256).astype(np.float32)
+    idx = rng.integers(0, 256, a.size).astype(np.uint8)
+    with pytest.raises(ValueError, match="payload holds"):
+        native.lut256_gather(idx.tobytes()[:10], lut, a.size)
+    with pytest.raises(ValueError, match="codebook"):
+        native.lut256_gather(idx.tobytes(), lut[:100], a.size)
+
+
 def test_quantile_edges_native_matches_numpy():
     """The C quantile-codebook build is bit-compatible with the numpy
     fallback (same strided sample, same linear interpolation)."""

@@ -143,6 +143,35 @@ def test_allreduce_mean(rendezvous, n, compression):
             b.close()
 
 
+@pytest.mark.parametrize(
+    "compression", ["uniform8bit", "blockwise8bit", "quantile8bit", "fp16"]
+)
+def test_allreduce_bit_identical_across_peers(rendezvous, compression):
+    """With a LOSSY codec every peer must still reconstruct bit-identical
+    results: each averaged part is encoded once and its owner adopts the
+    decoded wire value too (hivemind's averaged tensors have the same
+    property). Without this, workers' masters drift apart by quantization
+    noise every round."""
+    n = 3
+    backends = make_backends(rendezvous, n, compression=compression)
+    try:
+        rng = np.random.default_rng(7)
+        data = [
+            [rng.normal(scale=0.1, size=(1000,)).astype(np.float32),
+             rng.normal(scale=0.1, size=(31, 9)).astype(np.float32)]
+            for _ in range(n)
+        ]
+        results = concurrent_allreduce(backends, data)
+        ref, _ = results[0]
+        for out, group in results:
+            assert group == n
+            for o, r in zip(out, ref):
+                np.testing.assert_array_equal(o, r)
+    finally:
+        for b in backends:
+            b.close()
+
+
 def test_allreduce_survives_peer_drop(rendezvous):
     """A registered-but-dead peer delays the round by the matchmaking window
     only; survivors complete with the smaller group."""
@@ -536,6 +565,44 @@ def test_bulk_striped_transfer_roundtrip(monkeypatch):
         sender.send("127.0.0.1", server.port, "push", {"k": 2}, small)
         assert done.wait(20.0)
         np.testing.assert_array_equal(got[1][2], small)
+    finally:
+        sender.close()
+        server.stop()
+
+
+def test_bulk_bandwidth_cap_shapes_egress(monkeypatch):
+    """ODTP_BULK_BANDWIDTH_BPS token-buckets the payload egress: a capped
+    transfer takes at least bytes/rate seconds and the bytes still arrive
+    exactly (the bench's WAN-link emulation)."""
+    from opendiloco_tpu.diloco import bulk as bulk_mod
+
+    got = []
+    done = __import__("threading").Event()
+
+    def deliver(msg, meta, payload):
+        got.append(payload.copy())
+        done.set()
+
+    server = bulk_mod.BulkServer(deliver, host="127.0.0.1")
+    sender = bulk_mod.BulkSender()
+    try:
+        rng = np.random.default_rng(5)
+        data = rng.integers(0, 255, 8 << 20, np.uint8)  # 8 MB
+        # unthrottled first: establishes the connection + warm path
+        sender.send("127.0.0.1", server.port, "push", {}, data)
+        assert done.wait(20.0)
+        done.clear()
+        monkeypatch.setenv("ODTP_BULK_BANDWIDTH_BPS", str(32 << 20))  # 32 MB/s
+        t0 = time.perf_counter()
+        sender.send("127.0.0.1", server.port, "push", {}, data)
+        assert done.wait(30.0)
+        dt = time.perf_counter() - t0
+        # 8 MB at 32 MB/s >= 0.25s minus the bucket's burst allowance
+        assert dt > 0.12, dt
+        np.testing.assert_array_equal(got[1], data)
+        # cap lifts when the knob is cleared (bucket rebuilt on change)
+        monkeypatch.delenv("ODTP_BULK_BANDWIDTH_BPS")
+        assert bulk_mod._bucket() is None
     finally:
         sender.close()
         server.stop()
