@@ -180,16 +180,20 @@ constexpr double PEER_TTL = 60.0;
 struct Peer {
     std::string id, host, raw_progress = "null";
     int port = 0;
+    // worker-embedded rendezvous port (protocol twin of rendezvous.py
+    // PeerInfo.rdv_port): lets the swarm re-form on a worker after every
+    // daemon dies
+    int rdv_port = 0;
     double last_seen = 0;
     bool serves_state = false;
 
     std::string to_json() const {
-        char buf[256];
+        char buf[320];
         snprintf(buf, sizeof buf,
                  "{\"peer_id\":\"%s\",\"host\":\"%s\",\"port\":%d,"
-                 "\"serves_state\":%s,\"progress\":",
+                 "\"rdv_port\":%d,\"serves_state\":%s,\"progress\":",
                  json_escape(id).c_str(), json_escape(host).c_str(), port,
-                 serves_state ? "true" : "false");
+                 rdv_port, serves_state ? "true" : "false");
         return std::string(buf) + raw_progress + "}";
     }
 };
@@ -277,6 +281,9 @@ int adopt_peer_list(const std::string& raw_array) {
         double kport = 0;
         get_number(pj, "port", &kport);
         kp.port = (int)kport;
+        double krdv = 0;
+        get_number(pj, "rdv_port", &krdv);
+        kp.rdv_port = (int)krdv;
         std::string prog;
         if (get_raw(pj, "progress", &prog)) kp.raw_progress = prog;
         std::string serves;
@@ -380,6 +387,9 @@ void handle(int fd, const std::string& header) {
         double port = 0;
         get_number(scalars, "port", &port);
         p.port = (int)port;
+        double rdv = 0;
+        get_number(scalars, "rdv_port", &rdv);
+        p.rdv_port = (int)rdv;
         p.last_seen = now_s();
         g_peers[p.id] = p;
         fprintf(stderr, "[odtp-rendezvousd] peer %s joined from %s:%d\n",
@@ -414,6 +424,9 @@ void handle(int fd, const std::string& header) {
             double port = 0;
             if (get_string(meta, "host", &host) && get_number(meta, "port", &port)) {
                 Peer p; p.id = id; p.host = host; p.port = (int)port;
+                double rdv = 0;
+                get_number(meta, "rdv_port", &rdv);
+                p.rdv_port = (int)rdv;
                 g_peers[id] = p;
                 it = g_peers.find(id);
             }

@@ -175,9 +175,6 @@ def egress_bucket() -> Optional[_TokenBucket]:
 _THROTTLE_CHUNK = 1 << 20
 
 
-_bucket = egress_bucket  # internal alias
-
-
 def _send_payload(sock: socket.socket, data) -> None:
     """Payload sendall with the optional egress cap applied per-chunk."""
     bucket = egress_bucket()

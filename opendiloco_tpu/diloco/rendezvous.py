@@ -36,6 +36,10 @@ class PeerInfo:
     last_seen: float = field(default_factory=time.monotonic)
     progress: Optional[dict] = None
     serves_state: bool = False
+    # the worker's embedded rendezvous port (0 = none): lets the swarm
+    # re-form on a worker-hosted rendezvous after every daemon dies — the
+    # hivemind property that every peer IS a DHT node
+    rdv_port: int = 0
 
     def to_json(self) -> dict:
         return {
@@ -44,6 +48,7 @@ class PeerInfo:
             "port": self.port,
             "progress": self.progress,
             "serves_state": self.serves_state,
+            "rdv_port": self.rdv_port,
         }
 
 
@@ -213,6 +218,7 @@ class RendezvousServer:
                 int(p.get("port", 0)),
                 progress=p.get("progress"),
                 serves_state=bool(p.get("serves_state", False)),
+                rdv_port=int(p.get("rdv_port", 0) or 0),
             )
             adopted += 1
         return adopted
@@ -255,7 +261,12 @@ class RendezvousServer:
             return
         try:
             if msg == "register":
-                info = PeerInfo(meta["peer_id"], meta["host"], meta["port"])
+                info = PeerInfo(
+                    meta["peer_id"],
+                    meta["host"],
+                    meta["port"],
+                    rdv_port=int(meta.get("rdv_port", 0) or 0),
+                )
                 self.peers[info.peer_id] = info
                 log.info("peer %s joined from %s:%d", info.peer_id, info.host, info.port)
                 # registry replication: a failing-over worker carries the
@@ -290,7 +301,12 @@ class RendezvousServer:
                 if pid not in self.peers and "host" in meta:
                     # TTL-expired peers re-register transparently (a slow
                     # first jit compile must not blacklist a worker)
-                    self.peers[pid] = PeerInfo(pid, meta["host"], meta["port"])
+                    self.peers[pid] = PeerInfo(
+                        pid,
+                        meta["host"],
+                        meta["port"],
+                        rdv_port=int(meta.get("rdv_port", 0) or 0),
+                    )
                     log.info("peer %s re-registered via progress", pid)
                 if pid in self.peers:
                     self.peers[pid].last_seen = time.monotonic()

@@ -113,6 +113,12 @@ class TcpBackend(OuterBackend):
         ]
         self._rdv_idx = 0
         self._rdv_last_probe = 0.0
+        # worker-hosted rendezvous addresses adopted during a total daemon
+        # outage. These are EPHEMERAL (they die with the hosting worker and
+        # their ports get recycled by the OS) and must never enter the
+        # daemon-membership gossip: they are excluded from known_daemons
+        # announces and pruned as soon as any real daemon serves again.
+        self._worker_rdv_addrs: set[tuple[str, int]] = set()
         self._RDV_FAILBACK_S = float(os.environ.get("ODTP_RDV_FAILBACK_S", 60.0))
         self.host = host
         self.port = port
@@ -120,6 +126,19 @@ class TcpBackend(OuterBackend):
         self.codec: Codec = get_codec(compression)
         self.matchmaking_time = matchmaking_time
         self.rpc_timeout = rpc_timeout
+
+        # every worker is also a rendezvous node (hivemind's every-peer-is-
+        # a-DHT-node property, train_fsdp.py:205-212): an embedded server,
+        # advertised through the registry as rdv_port, lets the swarm
+        # re-form on the lowest-peer-id worker after EVERY daemon dies.
+        # ODTP_WORKER_RENDEZVOUS=0 opts out.
+        self._rdv_fallback = None
+        if os.environ.get("ODTP_WORKER_RENDEZVOUS", "1") not in ("0", "false"):
+            from opendiloco_tpu.diloco.rendezvous import RendezvousServer
+
+            self._rdv_fallback = RendezvousServer(
+                host=host, port=0, identity=f"worker-{self._peer_id}"
+            ).start_in_thread()
 
         self._state_provider: Optional[Callable[[], dict]] = None
         # persistent peer connections: (host, port) -> (reader, writer);
@@ -168,6 +187,10 @@ class TcpBackend(OuterBackend):
         self._thread = threading.Thread(target=self._thread_main, daemon=True)
         self._thread.start()
         if not self._started.wait(15) or self._startup_error:
+            # a failed constructor is never close()d: release the embedded
+            # rendezvous thread + socket or supervisor retry loops leak both
+            if self._rdv_fallback is not None:
+                self._rdv_fallback.stop()
             raise RuntimeError(f"TcpBackend failed to start: {self._startup_error}")
 
     # -- event loop thread -------------------------------------------------
@@ -217,11 +240,21 @@ class TcpBackend(OuterBackend):
             "peer_id": self._peer_id,
             "host": self.host,
             "port": self.port,
+            # the embedded rendezvous port rides the registry so every peer
+            # knows where this worker can serve rendezvous if the daemons die
+            "rdv_port": self._rdv_fallback.port if self._rdv_fallback else 0,
             # workers carry the daemon membership the same way they carry
             # the peer registry: every announce tells the daemon which other
             # daemons this worker can reach, so membership learned anywhere
-            # propagates everywhere
-            "known_daemons": [f"{h}:{p}" for h, p in self.rendezvous_list],
+            # propagates everywhere. Worker-hosted fallback addresses are
+            # NOT daemons: gossiping one would lodge a dead ephemeral port
+            # in every daemon and worker forever once the hosting worker
+            # exits (peers reach them via the registry's rdv_port instead)
+            "known_daemons": [
+                f"{h}:{p}"
+                for h, p in self.rendezvous_list
+                if (h, p) not in self._worker_rdv_addrs
+            ],
         }
 
     def _note_daemons(self, meta: dict, source=None) -> None:
@@ -255,6 +288,8 @@ class TcpBackend(OuterBackend):
                 continue
             if h in ("127.0.0.1", "localhost") and not talking_to_loopback:
                 continue
+            if addr in self._worker_rdv_addrs:
+                continue  # ephemeral worker-hosted, never daemon membership
             if addr not in self.rendezvous_list:
                 self.rendezvous_list.append(addr)
                 log.info("learned rendezvous daemon %s:%d at runtime", *addr)
@@ -347,7 +382,10 @@ class TcpBackend(OuterBackend):
         while attempts < len(self.rendezvous_list):
             addr = self.rendezvous_list[self._rdv_idx]
             try:
-                return await request(*addr, msg, meta, payload, timeout=timeout)
+                resp = await request(*addr, msg, meta, payload, timeout=timeout)
+                if self._worker_rdv_addrs and addr not in self._worker_rdv_addrs:
+                    self._prune_worker_rdv(keep=addr)
+                return resp
             # EOFError covers asyncio.IncompleteReadError: a daemon dying
             # WHILE this worker is parked in join_group closes the stream
             # mid-read (clean FIN, not ECONNRESET) -- that must fail over,
@@ -375,7 +413,67 @@ class TcpBackend(OuterBackend):
                 except Exception as reg_err:
                     last_err = reg_err
                     continue
+
+        # every configured daemon is down: fall back to WORKER-hosted
+        # rendezvous. All peers sort the same registry by peer_id, so the
+        # swarm converges on the lowest-id live worker's embedded server;
+        # the announce replicates this worker's registry into it, so
+        # matchmaking never closes a solo round. The successful address is
+        # appended to the failover list — the periodic failback probe still
+        # prefers the real daemons (lower index) once any revives.
+        # Gated on a non-empty registry view: a worker that NEVER reached a
+        # daemon has no swarm to re-form and must fail loudly at startup,
+        # not bootstrap a lonely one-peer swarm against itself.
+        for addr in (
+            self._worker_rendezvous_candidates() if self._peers_view else []
+        ):
+            try:
+                await self._announce_to(addr, timeout)
+                resp = await request(*addr, msg, meta, payload, timeout=timeout)
+            except (OSError, asyncio.TimeoutError, EOFError, WireError) as e:
+                last_err = e
+                continue
+            self._worker_rdv_addrs.add(addr)
+            if addr not in self.rendezvous_list:
+                self.rendezvous_list.append(addr)
+            self._rdv_idx = self.rendezvous_list.index(addr)
+            self._rdv_last_probe = time.monotonic()
+            log.warning(
+                "all rendezvous daemons down; swarm re-formed on "
+                "worker-hosted rendezvous %s:%d",
+                *addr,
+            )
+            return resp
         raise last_err if last_err else OSError("no rendezvous reachable")
+
+    def _prune_worker_rdv(self, keep: tuple[str, int]) -> None:
+        """A real daemon is serving again: drop adopted worker-hosted
+        addresses from the failover list -- their ports are ephemeral (they
+        die with the hosting worker and the OS recycles them), so keeping
+        them would eventually aim announce sweeps at an unrelated process.
+        ``keep`` is the daemon that just answered; re-aim _rdv_idx at it."""
+        self.rendezvous_list = [
+            a for a in self.rendezvous_list if a not in self._worker_rdv_addrs
+        ]
+        self._worker_rdv_addrs.clear()
+        self._rdv_idx = self.rendezvous_list.index(keep)
+
+    def _worker_rendezvous_candidates(self) -> list[tuple[str, int]]:
+        """Peer-hosted rendezvous addresses from the carried registry (plus
+        this worker's own embedded server), sorted by peer_id so every
+        worker tries them in the same order and the swarm converges."""
+        by_id: dict[str, tuple[str, int]] = {}
+        for pid, p in self._peers_view.items():
+            rp = int(p.get("rdv_port") or 0)
+            if rp and p.get("host"):
+                by_id[pid] = (p["host"], rp)
+        if self._rdv_fallback is not None:
+            by_id[self._peer_id] = (self.host, self._rdv_fallback.port)
+        return [
+            addr
+            for _, addr in sorted(by_id.items())
+            if addr not in self.rendezvous_list
+        ]
 
     def _run(self, coro, timeout: Optional[float] = None):
         import concurrent.futures
@@ -568,7 +666,10 @@ class TcpBackend(OuterBackend):
                     )
         # the RPC path must drain the same egress budget as the bulk plane:
         # small frames (below the bulk threshold) and bulk-fallback sends
-        # would otherwise bypass the emulated link cap
+        # would otherwise bypass the emulated link cap. After a FAILED bulk
+        # attempt this double-charges whatever the stripes already drained —
+        # deliberately conservative: an emulated link may only ever
+        # under-report throughput, never flatter it
         from opendiloco_tpu.diloco.bulk import egress_bucket
 
         bucket = egress_bucket()
@@ -1008,6 +1109,8 @@ class TcpBackend(OuterBackend):
             self._bulk_server.stop()
         if self._bulk_sender is not None:
             self._bulk_sender.close()
+        if self._rdv_fallback is not None:
+            self._rdv_fallback.stop()
         if self._loop and self._server:
             self._loop.call_soon_threadsafe(self._close_conn_pool)
             self._loop.call_soon_threadsafe(self._server.close)

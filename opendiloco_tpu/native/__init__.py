@@ -108,15 +108,19 @@ def _i8p(a: np.ndarray):
     return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int8))
 
 
-def _check_out(out: np.ndarray) -> None:
-    """Decode destinations must be 1-D contiguous float32: the C kernels
-    write raw pointers, and the numpy fallbacks' reshape(-1) would silently
-    copy (and discard the result) for non-contiguous ND views."""
+def _check_out(out: np.ndarray, n: int) -> None:
+    """Decode destinations must be 1-D contiguous float32 of exactly n
+    elements: the C kernels write n floats through a raw pointer (an
+    undersized buffer would be heap corruption, not an exception), and the
+    numpy fallbacks' reshape(-1) would silently copy (and discard the
+    result) for non-contiguous ND views."""
     if out.dtype != np.float32 or out.ndim != 1 or not out.flags.c_contiguous:
         raise ValueError(
             "out must be a contiguous 1-D float32 array, got "
             f"dtype={out.dtype} ndim={out.ndim} contiguous={out.flags.c_contiguous}"
         )
+    if out.size != n:
+        raise ValueError(f"out holds {out.size} elements, need exactly {n}")
 
 
 def _check_len(have: int, need: int, what: str) -> None:
@@ -185,7 +189,7 @@ def f16_bytes_to_f32(
     if out is None:
         out = np.empty(n, np.float32)
     else:
-        _check_out(out)
+        _check_out(out, n)
     if lib is None:
         out[:] = np.frombuffer(payload, np.float16)[:n]
         return out
@@ -236,7 +240,7 @@ def dequantize_blockwise(
     if out is None:
         out = np.empty(n, np.float32)
     else:
-        _check_out(out)
+        _check_out(out, n)
     if lib is None:
         pad = (-n) % block
         qp = np.pad(q[:n].astype(np.float32), (0, pad)).reshape(-1, block)
@@ -288,7 +292,13 @@ def quantize_uniform8(a: np.ndarray) -> tuple[bytes, float, float]:
         lo = float(a.min()) if a.size else 0.0
         hi = float(a.max()) if a.size else 0.0
         span = (hi - lo) or 1.0
-        q = np.clip(np.round((a - lo) / span * 255.0), 0, 255).astype(np.uint8)
+        # same expression ORDER as the C kernel ((x-lo) * (255/span), f32):
+        # a different order can differ by 1 ulp at .5 rounding boundaries
+        # and flip a bucket, breaking native-vs-fallback bit-equality
+        inv = np.float32(255.0) / np.float32(span)
+        q = np.clip(
+            np.round((a - np.float32(lo)) * inv), 0, 255
+        ).astype(np.uint8)
         return q.tobytes(), lo, span
     q = np.empty(a.size, np.uint8)
     lo_out = np.empty(1, np.float32)
@@ -310,7 +320,7 @@ def dequantize_uniform8(
     if out is None:
         out = np.empty(n, np.float32)
     else:
-        _check_out(out)
+        _check_out(out, n)
     if not _has(lib, "odtp_dequantize_uniform8"):
         np.multiply(q[:n].astype(np.float32), span / 255.0, out=out)
         out += lo
@@ -357,7 +367,7 @@ def lut256_gather(
     if out is None:
         out = np.empty(n, np.float32)
     else:
-        _check_out(out)
+        _check_out(out, n)
     if not _has(lib, "odtp_lut256_gather"):
         np.take(lut, idx[:n], out=out)
         return out

@@ -341,6 +341,87 @@ def test_rendezvous_dies_mid_matchmaking_registry_replicates(impl):
         secondary.stop()
 
 
+@pytest.mark.parametrize("impl", ["python", "native"])
+def test_all_daemons_die_swarm_reforms_on_worker_rendezvous(impl):
+    """Kill EVERY rendezvous daemon mid-run. Each worker embeds a
+    rendezvous server and advertises it through the registry (rdv_port), so
+    the swarm re-forms on the lowest-peer-id worker's server and the next
+    round still completes over both peers — hivemind's every-peer-is-a-
+    DHT-node property (train_fsdp.py:205-212), previously the one gap."""
+    import signal
+
+    from opendiloco_tpu.diloco.backend import PeerProgress
+
+    if impl == "native":
+        if not os.path.exists(_NATIVE_DAEMON):
+            pytest.skip("native daemon not built (make -C native)")
+        primary, secondary = _NativeDaemon(), _NativeDaemon()
+
+        def kill_all_daemons():
+            for d in (primary, secondary):
+                d.proc.send_signal(signal.SIGKILL)
+                d.proc.wait(timeout=5)
+
+        def stop_all_daemons():
+            # normally already SIGKILLed; reap survivors if the test failed
+            # before kill_all_daemons ran
+            for d in (primary, secondary):
+                if d.proc.poll() is None:
+                    d.proc.kill()
+                    d.proc.wait(timeout=5)
+    else:
+        primary = RendezvousServer(host="127.0.0.1", port=0).start_in_thread()
+        secondary = RendezvousServer(host="127.0.0.1", port=0).start_in_thread()
+
+        def kill_all_daemons():
+            primary.stop()
+            secondary.stop()
+
+        stop_all_daemons = kill_all_daemons
+    peers = [primary.address, secondary.address]
+    backends = [
+        TcpBackend(peers, peer_id=f"ad-{i}", matchmaking_time=2.0,
+                   rpc_timeout=5.0)
+        for i in range(2)
+    ]
+    try:
+        # production pushes progress every step: this is what refreshes each
+        # worker's carried registry (incl. every peer's rdv_port)
+        for b in backends:
+            b.report_progress(
+                PeerProgress(b.peer_id, 0, 0, 0.0, time.time())
+            )
+        data = [[np.full(8, float(i + 1), np.float32)] for i in range(2)]
+        for out, group in concurrent_allreduce(backends, data, timeout=60.0):
+            assert group == 2
+            np.testing.assert_allclose(out[0], 1.5)
+
+        kill_all_daemons()  # the ENTIRE daemon fabric dies
+
+        for out, group in concurrent_allreduce(backends, data, timeout=120.0):
+            assert group == 2  # re-formed, never a solo split
+            np.testing.assert_allclose(out[0], 1.5)
+        # all workers converged on the SAME worker-hosted rendezvous, which
+        # is one of the embedded servers
+        current = {b.rendezvous for b in backends}
+        assert len(current) == 1
+        embedded = {
+            ("127.0.0.1", b._rdv_fallback.port) for b in backends
+        }
+        assert current <= embedded
+        # the adopted worker-hosted address is ephemeral and must never
+        # enter daemon-membership gossip: a dead worker's recycled port
+        # would otherwise be advertised to the whole fabric forever
+        for b in backends:
+            known = b._register_meta()["known_daemons"]
+            for h, p in embedded:
+                assert f"{h}:{p}" not in known
+    finally:
+        for b in backends:
+            b.close()
+        stop_all_daemons()
+
+
 def test_round_buffers_recycle_across_rounds():
     """The flatten/accumulate/reassemble buffers are pooled per backend:
     round N+1 recycles round N's result buffer (its views become invalid
@@ -602,7 +683,7 @@ def test_bulk_bandwidth_cap_shapes_egress(monkeypatch):
         np.testing.assert_array_equal(got[1], data)
         # cap lifts when the knob is cleared (bucket rebuilt on change)
         monkeypatch.delenv("ODTP_BULK_BANDWIDTH_BPS")
-        assert bulk_mod._bucket() is None
+        assert bulk_mod.egress_bucket() is None
     finally:
         sender.close()
         server.stop()
