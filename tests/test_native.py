@@ -191,3 +191,51 @@ def test_quantile_edges_native_matches_numpy():
             native_mod._lib, native_mod._tried = lib, tried
         np.testing.assert_allclose(got, ref, atol=1e-6)
         assert np.all(np.diff(got) >= 0)  # edges are sorted
+
+
+def test_quantile_assign_matches_searchsorted_adversarial():
+    """The prefix-table/AVX2 bucketizer is bit-identical to
+    np.searchsorted(side='right') -- the documented contract -- on inputs
+    built to stress every special case it hand-reasons about: -0.0 vs +0.0
+    at the cross-prefix boundary, denormals, values exactly equal to
+    edges (ties go up), +/-inf, NaN values (bucket 0), and the small-n
+    direct-search path."""
+    from opendiloco_tpu import native
+
+    if not native.available():
+        pytest.skip("native lib not built")
+    rng = np.random.default_rng(7)
+    base = rng.standard_normal(200_003).astype(np.float32)
+    specials = np.array(
+        [0.0, -0.0, np.inf, -np.inf, 1e-38, -1e-38, 1e-45, -1e-45,
+         np.float32(1e38), np.float32(-1e38)],
+        np.float32,
+    )
+    cases = [
+        base,                                           # table path
+        base[:1009],                                    # small-n direct path
+        np.concatenate([base, np.tile(specials, 211)]),
+        np.full(20_000, np.float32(-2.5)),              # all-equal
+        np.linspace(-1, 1, 50_000, dtype=np.float32),
+        (rng.standard_normal(30_000) * 1e-40).astype(np.float32),  # denorm
+    ]
+    for arr in cases:
+        inner = native.quantile_edges(arr)[1:-1]
+        # exact-tie stress: re-assign the edge values themselves too
+        for x in (arr, inner.copy()):
+            got = native.quantile_assign(x, inner)
+            want = np.clip(
+                np.searchsorted(inner, x, side="right"), 0, 255
+            ).astype(np.uint8)
+            want[np.isnan(x)] = 0  # NaN: every >= compare is false
+            np.testing.assert_array_equal(got, want)
+    # NaN VALUES (not edges): bucket 0 on both table and direct paths
+    nanny = base.copy()
+    nanny[::97] = np.nan
+    inner = native.quantile_edges(base)[1:-1]
+    got = native.quantile_assign(nanny, inner)
+    want = np.clip(
+        np.searchsorted(inner, nanny, side="right"), 0, 255
+    ).astype(np.uint8)
+    want[np.isnan(nanny)] = 0
+    np.testing.assert_array_equal(got, want)
