@@ -332,7 +332,9 @@ def forward(
     """input_ids [B, T] int32 -> logits [B, T, V] float32.
 
     return_hidden=True returns (final_hidden [B, T, D], head [D, V]) instead
-    of logits -- the hook for fused lm-head losses (ops/fused_xent.py).
+    of logits -- the hook for fused lm-head losses (ops/fused_xent.py);
+    with return_moe_aux=True it returns (final_hidden, head, moe_aux) so
+    those losses can thread the router aux term.
 
     return_aux=True additionally returns activation-probe metrics
     {"attn_out_norm": [L], "lm_head_norm": scalar} (the reference's
@@ -394,7 +396,9 @@ def forward(
         else cparams["lm_head"]
     )
     if return_hidden:
-        return h, head
+        # composes with return_moe_aux so fused lm-head losses can thread
+        # the router aux loss (trainer._loss_fn)
+        return (h, head, moe_aux) if return_moe_aux else (h, head)
     logits = (h @ head).astype(jnp.float32)
     if return_aux:
         aux = {

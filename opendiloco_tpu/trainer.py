@@ -143,15 +143,13 @@ def _resolve_perf_defaults(
     if tc.fused_loss is None:
         # auto-on only where the sweep measured a win: pallas attention on a
         # non-sequence-parallel mesh (xla+fused measured slower than xla
-        # alone). MoE keeps the standard loss (the fused kernel does not
-        # thread the router aux loss -- the explicit-True path raises for
-        # this combo); sequence-parallel meshes keep it too: the fused
-        # kernel is not sequence-sharded and would gather the full
-        # [B*T, d] activations per device
+        # alone). Sequence-parallel meshes keep the standard loss: the
+        # fused kernel is not sequence-sharded and would gather the full
+        # [B*T, d] activations per device. (MoE composes: the router aux
+        # rides return_hidden and is added after the fused xent.)
         attn = changes.get("attn_impl", tc.attn_impl)
         changes["fused_loss"] = (
             on_tpu
-            and not model_cfg.num_experts
             and attn == "pallas"
             and getattr(plan, "sp_axis", None) is None
         )
@@ -203,11 +201,6 @@ class InnerTrainer:
                     "yet (the router aux loss is not threaded through the "
                     "pipeline)"
                 )
-        if model_cfg.num_experts and tc.fused_loss:
-            raise ValueError(
-                "fused_loss does not thread the MoE router aux loss yet; "
-                "drop one of them"
-            )
         if plan.ep_axis:
             ep_n = plan.mesh.shape[plan.ep_axis]
             if model_cfg.num_experts == 0:
@@ -350,8 +343,9 @@ class InnerTrainer:
     def _loss_fn(self, params: dict, input_ids: jax.Array, labels: jax.Array):
         if self.plan.pp_axis:
             return self._pp_loss(params, input_ids, labels)
+        moe = bool(self.model_cfg.num_experts)
         if self.tc.fused_loss:
-            hidden, head = forward(
+            out = forward(
                 params,
                 input_ids,
                 self.model_cfg,
@@ -359,11 +353,17 @@ class InnerTrainer:
                 attn_impl=self.tc.attn_impl,
                 remat=self.tc.remat,
                 return_hidden=True,
+                return_moe_aux=moe,
                 ring_mesh=self.plan.mesh,
                 ring_axis=self.plan.sp_axis or "sp",
             )
+            if moe:
+                hidden, head, moe_aux = out
+                return self._fused_lm_loss(hidden, head, labels) + (
+                    self.model_cfg.router_aux_coef * moe_aux
+                )
+            hidden, head = out
             return self._fused_lm_loss(hidden, head, labels)
-        moe = bool(self.model_cfg.num_experts)
         out = forward(
             params,
             input_ids,

@@ -99,14 +99,39 @@ def test_moe_capacity_drop_passes_residual():
     assert not np.allclose(np.asarray(out_big), np.asarray(out_tiny))
 
 
-def test_moe_rejected_with_pp_and_fused():
+def test_moe_rejected_with_pp():
     cfg = _cfg(4)
     tc = TrainerConfig(precision="fp32", remat=False, total_steps=10, warmup_steps=2)
     with pytest.raises(ValueError, match="MoE"):
         InnerTrainer(cfg, tc, build_mesh("NO_SHARD", pp_size=2))
-    tc_fused = TrainerConfig(
-        precision="fp32", remat=False, total_steps=10, warmup_steps=2,
-        fused_loss=True,
+
+
+def test_moe_fused_loss_matches_standard():
+    """fused lm-head+xent composes with MoE: the router aux loss rides
+    return_hidden (models/llama.py:forward) and is added after the fused
+    xent, so the total loss (and one train step) must match the standard
+    path to numerical tolerance."""
+    cfg = _cfg(4)
+    ids = np.random.default_rng(0).integers(
+        0, cfg.vocab_size, (8, 32), dtype=np.int32
     )
-    with pytest.raises(ValueError, match="fused_loss"):
-        InnerTrainer(cfg, tc_fused, build_mesh("NO_SHARD"))
+
+    def one_step(fused):
+        tc = TrainerConfig(
+            precision="fp32", remat=False, total_steps=10, warmup_steps=2,
+            attn_impl="xla", fused_loss=fused,
+        )
+        trainer = InnerTrainer(cfg, tc, build_mesh("NO_SHARD"))
+        state = trainer.init_state(jax.random.key(5))
+        batch = trainer.shard_batch(ids, ids.copy(), accum=1)
+        state, m = trainer.train_step(state, batch)
+        return float(m["loss"]), jax.device_get(state["params"])
+
+    loss_std, p_std = one_step(False)
+    loss_fused, p_fused = one_step(True)
+    assert abs(loss_std - loss_fused) < 1e-4, (loss_std, loss_fused)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5),
+        p_std,
+        p_fused,
+    )

@@ -202,15 +202,15 @@ def test_auto_perf_defaults_resolve_to_xla_off_tpu(tiny_cfg):
     trainer = InnerTrainer(tiny_cfg, tc, build_mesh("NO_SHARD"))
     assert trainer.tc.fused_loss is True
 
-    # auto never turns fused_loss on for MoE (kernel lacks router aux loss)
+    # off-TPU auto keeps fused off for MoE too (same sweep-measured rule)
     moe_cfg = dataclasses.replace(tiny_cfg, num_experts=2)
     trainer = InnerTrainer(moe_cfg, TrainerConfig(), build_mesh("NO_SHARD"))
     assert trainer.tc.fused_loss is False
 
 
 def test_auto_perf_defaults_on_tpu_device_kind(tiny_cfg):
-    # drive the resolver with a faked TPU device kind: dense models get
-    # pallas + fused; ring attention and MoE keep the standard loss
+    # drive the resolver with a faked TPU device kind: dense AND MoE models
+    # get pallas + fused; ring attention keeps the standard loss
     import dataclasses
     from types import SimpleNamespace
 
@@ -244,9 +244,11 @@ def test_auto_perf_defaults_on_tpu_device_kind(tiny_cfg):
     tc = _resolve_perf_defaults(TrainerConfig(), tiny_cfg, sppp_plan)
     assert tc.attn_impl == "pallas" and tc.fused_loss is False
 
+    # MoE composes with the fused kernel (the router aux rides
+    # return_hidden): auto-on like dense models
     moe_cfg = dataclasses.replace(tiny_cfg, num_experts=2)
     tc = _resolve_perf_defaults(TrainerConfig(), moe_cfg, plan)
-    assert tc.attn_impl == "pallas" and tc.fused_loss is False
+    assert tc.attn_impl == "pallas" and tc.fused_loss is True
 
     # a real plan's mesh exposes the same .devices.flat[0] protocol
     assert hasattr(real_plan.mesh.devices.flat[0], "device_kind")
