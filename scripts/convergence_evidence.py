@@ -1,0 +1,185 @@
+#!/usr/bin/env python
+"""On-chip DiLoCo-vs-DDP convergence artifact (VERDICT r3 ask #7).
+
+The reference validated its normative driver by training on real C4
+(train_diloco_torch.py:204-237, 336-353); this environment has zero
+network egress, so real C4 is unobtainable -- documented in PARITY.md.
+This script banks the strongest artifact the box allows: on whatever
+platform JAX resolves (the real TPU chip inside a tunnel window; CPU
+otherwise), train 2-worker DiLoCo (25 local steps between outer syncs)
+and same-total-batch single-worker DDP from the SAME init on the SAME
+deterministic sequence-pattern stream, and record both loss curves plus
+a shared held-out eval. Mirrors the CPU oracle
+tests/test_diloco.py::test_diloco_converges_within_band_of_ddp.
+
+Appends/overwrites CONVERGENCE.json at the repo root, flushing
+incrementally; "complete": true only lands after the final eval, so the
+tunnel watcher can retry a window that died mid-run.
+"""
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+_OUT = os.path.join(REPO, "CONVERGENCE.json")
+
+N_STEPS = int(os.environ.get("ODTP_CONV_STEPS", 300))
+LOCAL_STEPS = 25
+BS = 16  # per DiLoCo worker; DDP runs 2*BS
+SEQ = 64
+
+
+def batches(seed, vocab, n, global_bs, seq=SEQ):
+    """Learnable deterministic stream: each row is a consecutive-token
+    ramp from a random start (same generator as the CPU oracle)."""
+    rng = np.random.default_rng(seed)
+    for _ in range(n):
+        starts = rng.integers(0, vocab, (global_bs, 1))
+        ids = ((starts + np.arange(seq)) % vocab).astype(np.int32)
+        yield ids, ids.copy()
+
+
+def _flush(doc):
+    tmp = _OUT + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, indent=1)
+    os.replace(tmp, _OUT)
+
+
+def main():
+    import jax
+
+    from opendiloco_tpu.config import DilocoConfig
+    from opendiloco_tpu.diloco import DiLoCoOptimizer, LoopbackWorld
+    from opendiloco_tpu.models.hf_io import get_model
+    from opendiloco_tpu.parallel.mesh import build_mesh
+    from opendiloco_tpu.trainer import InnerTrainer, TrainerConfig
+
+    cfg, _ = get_model("2m")
+    doc = {
+        "model": "2m",
+        "platform": jax.devices()[0].platform,
+        "device": str(jax.devices()[0]),
+        "n_steps": N_STEPS,
+        "local_steps": LOCAL_STEPS,
+        "batch_per_worker": BS,
+        "seq": SEQ,
+        "ts_start": time.time(),
+        "complete": False,
+    }
+    _flush(doc)
+
+    def make_trainer():
+        tc = TrainerConfig(
+            lr=1e-3,
+            warmup_steps=10,
+            total_steps=N_STEPS,
+            precision="fp32",
+            remat=False,
+        )
+        return InnerTrainer(cfg, tc, build_mesh("NO_SHARD"))
+
+    # --- 2-worker DiLoCo over loopback, threads like the oracle test ----
+    world = LoopbackWorld(2)
+    backends = world.make_backends()
+    diloco_losses = [[], []]
+    diloco_params = [None, None]
+    errors = []
+
+    def worker(rank):
+        try:
+            trainer = make_trainer()
+            state = trainer.init_state(jax.random.key(7))
+            opt = DiLoCoOptimizer(
+                trainer,
+                backends[rank],
+                DilocoConfig(
+                    local_steps=LOCAL_STEPS,
+                    outer_nesterov=True,
+                    backend="loopback",
+                    timeout_waiting_for_peers=120.0,
+                    averaging_timeout=300.0,
+                ),
+                state,
+                batch_size=BS,
+            )
+            for ids, labels in batches(1000 + rank, cfg.vocab_size, N_STEPS, BS):
+                state, m = opt.step(
+                    state, trainer.shard_batch(ids, labels, accum=1)
+                )
+                diloco_losses[rank].append(round(float(m["loss"]), 5))
+            diloco_params[rank] = jax.device_get(state["params"])
+        except Exception as e:  # pragma: no cover - banked as evidence
+            errors.append(f"worker {rank}: {e!r}")
+
+    t0 = time.time()
+    threads = [threading.Thread(target=worker, args=(r,)) for r in (0, 1)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    doc["diloco_wall_s"] = round(time.time() - t0, 1)
+    if errors:
+        doc["error"] = "; ".join(errors)
+        _flush(doc)
+        raise SystemExit(doc["error"])
+    doc["diloco_losses"] = diloco_losses[0]
+    _flush(doc)
+
+    # --- DDP at the same total batch: both shards concatenated ----------
+    trainer = make_trainer()
+    state = trainer.init_state(jax.random.key(7))  # same init
+    ddp_losses = []
+    t0 = time.time()
+    for (i0, l0), (i1, l1) in zip(
+        batches(1000, cfg.vocab_size, N_STEPS, BS),
+        batches(1001, cfg.vocab_size, N_STEPS, BS),
+    ):
+        batch = trainer.shard_batch(
+            np.concatenate([i0, i1]), np.concatenate([l0, l1]), accum=1
+        )
+        state, m = trainer.train_step(state, batch)
+        ddp_losses.append(round(float(m["loss"]), 5))
+    doc["ddp_wall_s"] = round(time.time() - t0, 1)
+    doc["ddp_losses"] = ddp_losses
+    _flush(doc)
+
+    # --- shared held-out eval -------------------------------------------
+    eval_ids, eval_labels = next(batches(9999, cfg.vocab_size, 1, 64))
+    ev = {
+        "ddp": float(trainer.eval_loss(state["params"], eval_ids, eval_labels)),
+        "diloco_w0": float(
+            trainer.eval_loss(
+                jax.device_put(
+                    diloco_params[0], trainer.state_shardings["params"]
+                ),
+                eval_ids,
+                eval_labels,
+            )
+        ),
+    }
+    ev["init"] = float(np.log(cfg.vocab_size))
+    ev["ratio"] = ev["diloco_w0"] / ev["ddp"] if ev["ddp"] else None
+    doc["eval"] = {k: round(v, 5) for k, v in ev.items()}
+    doc["ts_end"] = time.time()
+    doc["complete"] = True
+    _flush(doc)
+    print(
+        f"CONVERGENCE complete on {doc['platform']}: "
+        f"ddp {ev['ddp']:.4f} diloco {ev['diloco_w0']:.4f} "
+        f"(init {ev['init']:.2f})"
+    )
+
+
+if __name__ == "__main__":
+    platform = os.environ.get("OPENDILOCO_TPU_PLATFORM")
+    if platform:
+        import jax
+
+        jax.config.update("jax_platforms", platform)
+    main()

@@ -29,3 +29,12 @@ if ! grep -q '"model": "1b"' BENCH_LIVE.json 2>/dev/null; then
   OPENDILOCO_TPU_BENCH_MODEL=1b timeout 1200 python bench.py > /tmp/bench_1b.out 2>&1
   echo "bench 1b rc=$?"
 fi
+
+# on-chip DiLoCo-vs-DDP convergence curves (VERDICT r3 ask #7; real C4 is
+# unobtainable with zero egress -- see scripts/convergence_evidence.py)
+# (a CPU-platform artifact is a placeholder: re-run until it's on-chip)
+if ! (grep -q '"complete": true' CONVERGENCE.json 2>/dev/null \
+      && ! grep -q '"platform": "cpu"' CONVERGENCE.json 2>/dev/null); then
+  timeout 1500 python scripts/convergence_evidence.py > /tmp/convergence.out 2>&1
+  echo "convergence rc=$?"
+fi
