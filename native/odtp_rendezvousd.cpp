@@ -175,7 +175,11 @@ std::string json_escape(const std::string& s) {
 // daemon state
 // ---------------------------------------------------------------------------
 
-constexpr double PEER_TTL = 60.0;
+double g_peer_ttl = 60.0;  // --ttl overrides (tests shrink it)
+// TTL-expired peers that may be mid-re-join: while any exist, matchmaking
+// rounds run their full window (no early close). Cleared on re-register or
+// when a full-window round closes without the peer.
+std::map<std::string, double> g_tombstones;
 
 struct Peer {
     std::string id, host, raw_progress = "null";
@@ -200,7 +204,9 @@ struct Peer {
 
 struct Round {
     double deadline = 0;
+    double opened = 0;
     int cap = 0;  // 0 = one global group; k = partition into groups <= k
+    bool no_early_close = false;  // stale registry: wait the full window
     std::set<std::string> joiners;
     std::vector<std::pair<int, std::string>> waiters;  // (fd, peer_id)
 };
@@ -248,9 +254,10 @@ void adopt_daemons(const std::string& raw_array, const char* source) {
 void expire_peers() {
     double now = now_s();
     for (auto it = g_peers.begin(); it != g_peers.end();) {
-        if (now - it->second.last_seen > PEER_TTL) {
+        if (now - it->second.last_seen > g_peer_ttl) {
             fprintf(stderr, "[odtp-rendezvousd] expiring dead peer %s\n",
                     it->first.c_str());
+            g_tombstones[it->first] = now;
             it = g_peers.erase(it);
         } else ++it;
     }
@@ -327,6 +334,14 @@ void close_round(const std::string& key) {
     auto it = g_rounds.find(key);
     if (it == g_rounds.end()) return;
     Round& rnd = it->second;
+    // tombstoned peers that had this FULL matchmaking window to re-join
+    // and did not: the swarm has demonstrably moved on without them. A
+    // tombstone created after the round opened only had part of the
+    // window and keeps its grace.
+    for (auto t = g_tombstones.begin(); t != g_tombstones.end();)
+        if (!rnd.joiners.count(t->first) && t->second <= rnd.opened)
+            t = g_tombstones.erase(t);
+        else ++t;
     std::vector<std::string> ids(rnd.joiners.begin(), rnd.joiners.end());
     std::sort(ids.begin(), ids.end());
 
@@ -392,6 +407,7 @@ void handle(int fd, const std::string& header) {
         p.rdv_port = (int)rdv;
         p.last_seen = now_s();
         g_peers[p.id] = p;
+        g_tombstones.erase(p.id);
         fprintf(stderr, "[odtp-rendezvousd] peer %s joined from %s:%d\n",
                 p.id.c_str(), p.host.c_str(), p.port);
         // registry replication (protocol twin of rendezvous.py): a
@@ -413,6 +429,8 @@ void handle(int fd, const std::string& header) {
         std::string id;
         get_string(meta, "peer_id", &id);
         g_peers.erase(id);
+        // a clean departure is positive proof the peer is not mid-re-join
+        g_tombstones.erase(id);
         queue_reply(fd, "ok", "{}");
     } else if (type == "progress") {
         std::string id;
@@ -428,6 +446,7 @@ void handle(int fd, const std::string& header) {
                 get_number(meta, "rdv_port", &rdv);
                 p.rdv_port = (int)rdv;
                 g_peers[id] = p;
+                g_tombstones.erase(id);
                 it = g_peers.find(id);
             }
         }
@@ -475,11 +494,51 @@ void handle(int fd, const std::string& header) {
         double window = 5.0;
         get_number(meta, "matchmaking_time", &window);
         auto pit = g_peers.find(id);
-        if (pit != g_peers.end()) pit->second.last_seen = now_s();
+        // stale = ANY registration (the joiner's or a partner's) already
+        // outlived the TTL without being reaped: the registry cannot be
+        // trusted for an early close this round. Checked BEFORE the
+        // joiner's refresh -- a fresh peer joining first must not close a
+        // solo round while its expired partner is still re-joining.
+        bool stale_joiner = !g_tombstones.empty();
+        if (!stale_joiner)
+            for (auto& [pid2, p2] : g_peers)
+                if (now_s() - p2.last_seen > g_peer_ttl) {
+                    stale_joiner = true;
+                    break;
+                }
+        if (pit == g_peers.end()) {
+            // TTL lapsed mid-round (slow-link rounds can outlast the TTL):
+            // re-register transparently from the join meta (protocol twin
+            // of rendezvous.py _join_group)
+            std::string host;
+            double port = 0;
+            if (get_string(meta, "host", &host) &&
+                get_number(meta, "port", &port)) {
+                Peer p;
+                p.id = id;
+                p.host = host;
+                p.port = (int)port;
+                double rdv = 0;
+                get_number(meta, "rdv_port", &rdv);
+                p.rdv_port = (int)rdv;
+                g_peers[id] = p;
+                pit = g_peers.find(id);
+                stale_joiner = true;
+                fprintf(stderr,
+                        "[odtp-rendezvousd] peer %s re-registered via "
+                        "join_group\n",
+                        id.c_str());
+            }
+        }
+        if (pit != g_peers.end()) {
+            pit->second.last_seen = now_s();
+            g_tombstones.erase(id);  // the joiner itself is back
+        }
 
         auto& rnd = g_rounds[key];  // creates on first join
         if (rnd.deadline == 0) {
-            rnd.deadline = now_s() + window;
+            rnd.opened = now_s();
+            rnd.deadline = rnd.opened + window;
             double cap = 0;
             get_number(meta, "group_cap", &cap);
             rnd.cap = (int)cap;
@@ -488,10 +547,16 @@ void handle(int fd, const std::string& header) {
         rnd.waiters.emplace_back(fd, id);
         g_conns[fd].waiting_round = true;
 
+        // a re-registered joiner means the registry is stale (its peers
+        // likely expired too): only the window timer may close this round,
+        // or the first joiner back would be matchmade into a solo group
+        if (stale_joiner) rnd.no_early_close = true;
+
         expire_peers();
-        bool all_in = true;
-        for (auto& [pid, _] : g_peers)
-            if (!rnd.joiners.count(pid)) { all_in = false; break; }
+        bool all_in = !rnd.no_early_close;
+        if (all_in)
+            for (auto& [pid, _] : g_peers)
+                if (!rnd.joiners.count(pid)) { all_in = false; break; }
         if (all_in) close_round(key);
     } else {
         queue_reply(fd, "error", "{\"error\":\"unknown message\"}");
@@ -584,6 +649,7 @@ int main(int argc, char** argv) {
         if (!strcmp(argv[i], "--identity-file")) identity_file = argv[i + 1];
         if (!strcmp(argv[i], "--advertise")) advertise = argv[i + 1];
         if (!strcmp(argv[i], "--join")) join = argv[i + 1];
+        if (!strcmp(argv[i], "--ttl")) g_peer_ttl = atof(argv[i + 1]);
     }
     std::string identity = "odtp-rendezvousd";
     if (identity_file) {

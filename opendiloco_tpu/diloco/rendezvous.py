@@ -65,6 +65,9 @@ class _GroupRound:
         self.closed = False
         self.group: list[dict] = []
         self.groups: dict[str, list[dict]] = {}  # per-peer when capped
+        # set when a joiner had to be transparently re-registered: the
+        # registry is stale, so only the window timer may close the round
+        self.no_early_close = False
 
     def group_for(self, peer_id: str) -> list[dict]:
         if self.cap:
@@ -86,6 +89,11 @@ class RendezvousServer:
         self.identity = identity or uuid.uuid4().hex[:16]
         self.peers: dict[str, PeerInfo] = {}
         self.rounds: dict[str, _GroupRound] = {}
+        # TTL-expired peers that may be mid-re-join: while any exist,
+        # matchmaking rounds run their full window (no early close).
+        # Cleared on re-register or when a full-window round closes
+        # without the peer.
+        self.tombstones: dict[str, float] = {}
         # dynamic daemon membership: other rendezvous daemons this one knows
         # of (addr string -> first_seen). Learned from `daemon_hello` (a new
         # daemon announcing itself via --join) and from workers' announces
@@ -249,6 +257,12 @@ class RendezvousServer:
         for pid in dead:
             log.warning("expiring dead peer %s", pid)
             del self.peers[pid]
+            # tombstone: an expired peer may be mid-re-join (slow-link
+            # rounds outlast the TTL), so matchmaking withholds early
+            # closes until it re-registers OR a full-window round closes
+            # without it -- proof the swarm moved on (see _join_group /
+            # _close_round)
+            self.tombstones[pid] = now
         return self.peers
 
     async def _handle(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
@@ -268,6 +282,7 @@ class RendezvousServer:
                     rdv_port=int(meta.get("rdv_port", 0) or 0),
                 )
                 self.peers[info.peer_id] = info
+                self.tombstones.pop(info.peer_id, None)
                 log.info("peer %s joined from %s:%d", info.peer_id, info.host, info.port)
                 # registry replication: a failing-over worker carries the
                 # swarm's registry (see TcpBackend._announce_to) so this
@@ -295,6 +310,9 @@ class RendezvousServer:
                 )
             elif msg == "unregister":
                 self.peers.pop(meta["peer_id"], None)
+                # a clean departure is positive proof the peer is not
+                # mid-re-join: no matchmaking grace needed
+                self.tombstones.pop(meta["peer_id"], None)
                 await send_frame(writer, "ok", {})
             elif msg == "progress":
                 pid = meta["peer_id"]
@@ -307,6 +325,7 @@ class RendezvousServer:
                         meta["port"],
                         rdv_port=int(meta.get("rdv_port", 0) or 0),
                     )
+                    self.tombstones.pop(pid, None)
                     log.info("peer %s re-registered via progress", pid)
                 if pid in self.peers:
                     self.peers[pid].last_seen = time.monotonic()
@@ -377,8 +396,31 @@ class RendezvousServer:
         key = str(meta["round"])
         window = float(meta.get("matchmaking_time", 5.0))
         pid = meta["peer_id"]
+        # stale = ANY registration (the joiner's or a partner's) already
+        # outlived the TTL, whether still present or already reaped into a
+        # tombstone: the registry cannot be trusted for an early close
+        # this round. Checked BEFORE the joiner's refresh -- a fresh peer
+        # joining first must not close a solo round while its expired
+        # partner is still re-joining.
+        now = time.monotonic()
+        stale_joiner = bool(self.tombstones) or any(
+            now - p.last_seen > PEER_TTL for p in self.peers.values()
+        )
+        if pid not in self.peers and "host" in meta:
+            # TTL lapsed mid-round (a slow-link outer round can outlast the
+            # TTL): re-register transparently so the joiner is never
+            # matchmade out of its own group
+            self.peers[pid] = PeerInfo(
+                pid,
+                meta["host"],
+                int(meta.get("port", 0)),
+                rdv_port=int(meta.get("rdv_port", 0) or 0),
+            )
+            log.info("peer %s re-registered via join_group", pid)
+            stale_joiner = True
         if pid in self.peers:
             self.peers[pid].last_seen = time.monotonic()
+            self.tombstones.pop(pid, None)  # the joiner itself is back
 
         rnd = self.rounds.get(key)
         if rnd is None or rnd.closed:
@@ -387,7 +429,15 @@ class RendezvousServer:
             asyncio.create_task(self._close_round_later(rnd))
         if pid in self.peers:
             rnd.joiners[pid] = self.peers[pid]
-        if set(rnd.joiners) >= set(self._live_peers()):
+        if stale_joiner:
+            # the registry is known-stale (this joiner had expired, its
+            # peers likely did too): closing as soon as "every live peer
+            # joined" would matchmake a solo group. Wait the full window so
+            # the other expired peers can re-join.
+            rnd.no_early_close = True
+        if not rnd.no_early_close and set(rnd.joiners) >= set(
+            self._live_peers()
+        ):
             self._close_round(rnd)
 
         await rnd.event.wait()
@@ -400,6 +450,15 @@ class RendezvousServer:
 
     def _close_round(self, rnd: _GroupRound) -> None:
         rnd.closed = True
+        # tombstoned peers that had this FULL matchmaking window to re-join
+        # and did not: the swarm has demonstrably moved on without them.
+        # A tombstone created after the round opened only had part of the
+        # window -- it keeps its grace until a round that opened after it
+        # closes without the peer.
+        for pid in list(self.tombstones):
+            if pid not in rnd.joiners and self.tombstones[pid] <= rnd.opened:
+                log.info("peer %s did not re-join; forgetting", pid)
+                del self.tombstones[pid]
         rnd.group = sorted(
             (p.to_json() for p in rnd.joiners.values()), key=lambda p: p["peer_id"]
         )

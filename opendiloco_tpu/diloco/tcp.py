@@ -235,7 +235,10 @@ class TcpBackend(OuterBackend):
     def rendezvous(self) -> tuple[str, int]:
         return self.rendezvous_list[self._rdv_idx]
 
-    def _register_meta(self) -> dict:
+    def _identity_meta(self) -> dict:
+        """The registration identity triple+1: what a daemon needs to
+        (re-)register this worker. Shared by register/progress announces
+        AND the join_group meta (TTL-lapse transparent re-registration)."""
         return {
             "peer_id": self._peer_id,
             "host": self.host,
@@ -243,6 +246,11 @@ class TcpBackend(OuterBackend):
             # the embedded rendezvous port rides the registry so every peer
             # knows where this worker can serve rendezvous if the daemons die
             "rdv_port": self._rdv_fallback.port if self._rdv_fallback else 0,
+        }
+
+    def _register_meta(self) -> dict:
+        return {
+            **self._identity_meta(),
             # workers carry the daemon membership the same way they carry
             # the peer registry: every announce tells the daemon which other
             # daemons this worker can reach, so membership learned anywhere
@@ -876,10 +884,13 @@ class TcpBackend(OuterBackend):
         _, meta, _ = await self._rdv_request(
             "join_group",
             {
-                "peer_id": self._peer_id,
                 "round": join_key,
                 "matchmaking_time": self.matchmaking_time,
                 "group_cap": group_cap,
+                # a joiner whose registration TTL lapsed mid-round (one
+                # outer round can legitimately outlast the TTL on a slow
+                # link) re-registers transparently from this identity
+                **self._identity_meta(),
             },
             timeout=max(self.matchmaking_time * 4, self.rpc_timeout),
         )
@@ -892,7 +903,14 @@ class TcpBackend(OuterBackend):
             # stale registry excluded us (e.g. TTL expiry) -- this includes
             # an EMPTY group, which must NOT pass as a solo round: that
             # would silently desync the master. Re-announce and retry.
-            self._push_progress()
+            # (Async announce, NOT _push_progress: a sync _run from the
+            # event-loop thread blocks the loop on a future the loop itself
+            # must run -- it can only time out, wedging every peer's frames
+            # for rpc_timeout*3 while never actually re-announcing.)
+            try:
+                await self._announce_to(self.rendezvous, self.rpc_timeout)
+            except Exception:
+                pass  # the retry's join_group meta re-registers anyway
             raise AllReduceError(f"matchmade group {group} does not contain self")
         if n == 1:
             return [a.copy() for a in arrays], 1

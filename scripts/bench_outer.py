@@ -247,6 +247,7 @@ def run_sweep(args, server, nbytes, base_env, cap_bps: float) -> None:
                     "--timeout", str(round_timeout),
                 ],
                 stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,  # tracebacks land in the detail
                 text=True,
                 env=env,
             )
@@ -278,6 +279,10 @@ def run_sweep(args, server, nbytes, base_env, cap_bps: float) -> None:
             _append_row({
                 "model": args.model, "peers": args.peers,
                 "codec": compression, "error": "worker failure", **cap_note,
+                # last lines of each worker so a failed row is diagnosable
+                "detail": [
+                    " | ".join(o.splitlines()[-3:])[-400:] for o in outs
+                ],
             })
             continue
         group_n = int(line.split()[-1].split("=")[1])

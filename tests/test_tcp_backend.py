@@ -422,6 +422,65 @@ def test_all_daemons_die_swarm_reforms_on_worker_rendezvous(impl):
         stop_all_daemons()
 
 
+@pytest.mark.parametrize("impl", ["python", "native"])
+def test_ttl_expiry_mid_round_reregisters_via_join(impl, monkeypatch):
+    """A slow-link outer round can legitimately outlast the registration
+    TTL (e.g. raw fp32 at 100 Mbps takes ~100 s vs the 60 s TTL). The next
+    join_group must transparently re-register the joiner from its meta --
+    previously both workers were matchmade out of their own group
+    ('matchmade group [] does not contain self') and the round died after
+    retries."""
+    from opendiloco_tpu.diloco import rendezvous as rdv_mod
+
+    if impl == "native":
+        if not os.path.exists(_NATIVE_DAEMON):
+            pytest.skip("native daemon not built (make -C native)")
+        server = _NativeDaemon("--ttl", "1.0")
+    else:
+        monkeypatch.setattr(rdv_mod, "PEER_TTL", 1.0)
+        srv = rdv_mod.RendezvousServer(host="127.0.0.1", port=0)
+        srv.start_in_thread()
+        server = srv
+    addr = (
+        server.address
+        if isinstance(server.address, str)
+        else f"{server.address[0]}:{server.address[1]}"
+    )
+    backends = [
+        TcpBackend([addr], peer_id=f"ttl-{i}", matchmaking_time=2.0,
+                   rpc_timeout=5.0)
+        for i in range(2)
+    ]
+    try:
+        data = [[np.full(8, float(i + 1), np.float32)] for i in range(2)]
+        for out, group in concurrent_allreduce(backends, data, timeout=60.0):
+            assert group == 2
+            np.testing.assert_allclose(out[0], 1.5)
+        time.sleep(2.5)  # both registrations TTL-expire server-side
+        for out, group in concurrent_allreduce(backends, data, timeout=60.0):
+            assert group == 2  # re-registered via join meta, never solo
+            np.testing.assert_allclose(out[0], 1.5)
+        # asymmetric: only worker 1 expires, worker 0 stays fresh (its
+        # progress push may even reap 1 server-side). Worker 0 joining
+        # first must NOT be early-closed into a solo group while its
+        # partner is still re-joining (reap-grace window).
+        from opendiloco_tpu.diloco.backend import PeerProgress
+
+        deadline = time.monotonic() + 2.5
+        while time.monotonic() < deadline:
+            backends[0].report_progress(
+                PeerProgress(backends[0].peer_id, 0, 0, 0.0, time.time())
+            )
+            time.sleep(0.4)
+        for out, group in concurrent_allreduce(backends, data, timeout=60.0):
+            assert group == 2  # never a solo split
+            np.testing.assert_allclose(out[0], 1.5)
+    finally:
+        for b in backends:
+            b.close()
+        server.stop()
+
+
 def test_round_buffers_recycle_across_rounds():
     """The flatten/accumulate/reassemble buffers are pooled per backend:
     round N+1 recycles round N's result buffer (its views become invalid
