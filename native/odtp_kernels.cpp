@@ -124,6 +124,42 @@ void odtp_f16_accumulate_f32(const uint16_t* src, float* dst, size_t n) {
     for (ptrdiff_t i = 0; i < (ptrdiff_t)n; ++i) dst[i] += f16_to_f32_scalar(src[i]);
 }
 
+// single-pass |max| (scaled-fp16 encode prescan; no temporary abs array).
+// NaNs are skipped -- a NaN pseudo-gradient is already broken upstream.
+float odtp_absmax_f32(const float* src, size_t n) {
+    float m = 0.f;
+#pragma omp parallel for reduction(max : m) schedule(static)
+    for (ptrdiff_t i = 0; i < (ptrdiff_t)n; ++i) {
+        float a = std::fabs(src[i]);
+        if (a > m) m = a;
+    }
+    return m;
+}
+
+// fused scaled-fp16 paths: one pass, zero temporaries. Encode DIVIDES by
+// the scale (bit-parity with the numpy fallback's arr / scale); decode
+// multiplies it back.
+void odtp_f32_to_f16_scaled(const float* src, float scale, uint16_t* dst,
+                            size_t n) {
+#pragma omp parallel for schedule(static)
+    for (ptrdiff_t i = 0; i < (ptrdiff_t)n; ++i)
+        dst[i] = f32_to_f16_scalar(src[i] / scale);
+}
+
+void odtp_f16_to_f32_scaled(const uint16_t* src, float scale, float* dst,
+                            size_t n) {
+#pragma omp parallel for schedule(static)
+    for (ptrdiff_t i = 0; i < (ptrdiff_t)n; ++i)
+        dst[i] = f16_to_f32_scalar(src[i]) * scale;
+}
+
+void odtp_f16_accumulate_scaled_f32(const uint16_t* src, float scale,
+                                    float* dst, size_t n) {
+#pragma omp parallel for schedule(static)
+    for (ptrdiff_t i = 0; i < (ptrdiff_t)n; ++i)
+        dst[i] += f16_to_f32_scalar(src[i]) * scale;
+}
+
 // blockwise absmax int8 quantization (one fp32 scale per `block` values)
 void odtp_quantize_blockwise_i8(const float* src, int8_t* q, float* scales,
                                 size_t n, size_t block) {

@@ -85,6 +85,16 @@ def get_lib() -> Optional[ctypes.CDLL]:
         lib.odtp_lut256_accumulate.argtypes = [u8p, f32p, f32p, st]
     except AttributeError:
         pass
+    try:  # version-3 kernels (fused scaled-fp16 paths)
+        lib.odtp_absmax_f32.argtypes = [f32p, st]
+        lib.odtp_absmax_f32.restype = ctypes.c_float
+        lib.odtp_f32_to_f16_scaled.argtypes = [f32p, ctypes.c_float, u16p, st]
+        lib.odtp_f16_to_f32_scaled.argtypes = [u16p, ctypes.c_float, f32p, st]
+        lib.odtp_f16_accumulate_scaled_f32.argtypes = [
+            u16p, ctypes.c_float, f32p, st,
+        ]
+    except AttributeError:
+        pass
     for fn in (lib.odtp_sendall, lib.odtp_recvall):
         fn.argtypes = [ctypes.c_int, ctypes.c_void_p, st]
         fn.restype = ctypes.c_int
@@ -206,6 +216,73 @@ def f16_accumulate(payload: bytes, dst: np.ndarray) -> None:
         return
     src = np.frombuffer(payload, np.uint16)
     lib.odtp_f16_accumulate_f32(_u16p(src), _f32p(dst), dst.size)
+
+
+def absmax(a: np.ndarray) -> float:
+    """max(|a|) in one pass with no temporary abs array (NaNs skipped)."""
+    lib = get_lib()
+    a = np.ascontiguousarray(a, np.float32).reshape(-1)
+    if not _has(lib, "odtp_absmax_f32"):
+        return float(np.max(np.abs(a))) if a.size else 0.0
+    return float(lib.odtp_absmax_f32(_f32p(a), a.size))
+
+
+def f32_to_f16_scaled_bytes(a: np.ndarray, scale: float) -> bytes:
+    """f16(a / scale) fused into one pass (scaled-fp16 encode); bit-equal
+    to the fallback's explicit division."""
+    lib = get_lib()
+    a = np.ascontiguousarray(a, np.float32).reshape(-1)
+    if not _has(lib, "odtp_f32_to_f16_scaled"):
+        return (a / np.float32(scale)).astype(np.float16).tobytes()
+    out = np.empty(a.size, np.uint16)
+    lib.odtp_f32_to_f16_scaled(
+        _f32p(a), ctypes.c_float(scale), _u16p(out), a.size
+    )
+    return out.tobytes()
+
+
+def f16_bytes_to_f32_scaled(
+    payload: bytes, scale: float, n: int, out: Optional[np.ndarray] = None
+) -> np.ndarray:
+    """decode_f16(payload) * scale in one fused pass."""
+    lib = get_lib()
+    src = np.frombuffer(payload, np.uint16)
+    _check_len(src.size, n, "f16_bytes_to_f32_scaled")
+    if out is None:
+        out = np.empty(n, np.float32)
+    else:
+        _check_out(out, n)
+    if not _has(lib, "odtp_f16_to_f32_scaled"):
+        np.multiply(
+            np.frombuffer(payload, np.float16)[:n].astype(np.float32),
+            np.float32(scale),
+            out=out,
+        )
+        return out
+    lib.odtp_f16_to_f32_scaled(_u16p(src), ctypes.c_float(scale), _f32p(out), n)
+    return out
+
+
+def f16_accumulate_scaled(payload: bytes, scale: float, dst: np.ndarray) -> None:
+    """dst += decode_f16(payload) * scale in one fused pass."""
+    lib = get_lib()
+    _check_len(len(payload) // 2, dst.size, "f16_accumulate_scaled")
+    if (
+        not _has(lib, "odtp_f16_accumulate_scaled_f32")
+        or dst.dtype != np.float32
+        or not dst.flags.c_contiguous
+    ):
+        dst += (
+            np.frombuffer(payload, np.float16)[: dst.size]
+            .astype(np.float32)
+            .reshape(dst.shape)
+            * np.float32(scale)
+        )
+        return
+    src = np.frombuffer(payload, np.uint16)
+    lib.odtp_f16_accumulate_scaled_f32(
+        _u16p(src), ctypes.c_float(scale), _f32p(dst), dst.size
+    )
 
 
 def quantize_blockwise(a: np.ndarray, block: int) -> tuple[bytes, bytes]:

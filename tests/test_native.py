@@ -106,6 +106,41 @@ def test_uniform8_native_matches_fallback(arrs):
     np.testing.assert_array_equal(out, dec)
 
 
+def test_scaled_f16_native_matches_fallback(arrs):
+    """The fused scaled-fp16 kernels (absmax, divide-and-convert encode,
+    scaled decode, scaled accumulate) are bit-identical to the numpy
+    fallback -- the wire-compatibility contract between peers built with
+    and without libodtp.so."""
+    if not native.available():
+        pytest.skip("native lib not built")
+    a, b = arrs
+    s = native.absmax(a)
+    payload = native.f32_to_f16_scaled_bytes(a, s)
+    dec = native.f16_bytes_to_f32_scaled(payload, s, a.size)
+    dst = b.copy()
+    native.f16_accumulate_scaled(payload, s, dst)
+
+    nm = _without_native()
+    lib, tried = nm._lib, nm._tried
+    nm._lib, nm._tried = None, True
+    try:
+        s_ref = native.absmax(a)
+        payload_ref = native.f32_to_f16_scaled_bytes(a, s_ref)
+        dec_ref = native.f16_bytes_to_f32_scaled(payload_ref, s_ref, a.size)
+        dst_ref = b.copy()
+        native.f16_accumulate_scaled(payload_ref, s_ref, dst_ref)
+    finally:
+        nm._lib, nm._tried = lib, tried
+    assert s == s_ref  # float32 max is exact, no rounding slack
+    assert payload == payload_ref
+    np.testing.assert_array_equal(dec, dec_ref)
+    np.testing.assert_array_equal(dst, dst_ref)
+    # decode straight into a destination slice
+    out = np.empty(a.size + 8, np.float32)[4:-4]
+    native.f16_bytes_to_f32_scaled(payload, s, a.size, out=out)
+    np.testing.assert_array_equal(out, dec)
+
+
 def test_lut256_native_matches_fallback(arrs):
     a, b = arrs
     rng = np.random.default_rng(3)
