@@ -367,7 +367,7 @@ def forward(
         # activation probes are not threaded through the pipeline
         from opendiloco_tpu.parallel.pipeline import pipeline_hidden
 
-        h = pipeline_hidden(
+        h, moe_aux = pipeline_hidden(
             cparams,
             h,
             positions,
@@ -379,7 +379,6 @@ def forward(
             axis=pp_axis,
         )
         attn_norms = jnp.zeros((cfg.num_hidden_layers,), jnp.float32)
-        moe_aux = jnp.float32(0.0)
     else:
         rope = _rope_tables(positions, cfg.head_dim, cfg.rope_theta)
         block = lambda h, layer: _decoder_block(

@@ -51,11 +51,18 @@ def pipeline_hidden(
     """Run the decoder stack as a pp-staged pipeline.
 
     cparams["layers"]: stacked [L, ...] pytree (sharded over ``axis`` at the
-    jit level); h0: embedded inputs [B, T, D]; returns final hidden [B, T, D]
-    (pre-final-norm). B must divide by ``microbatches``. ``attn_fn`` is the
-    per-block attention callable built by ``llama.forward`` (ring attention
-    is invalid here -- it nests its own shard_map; the trainer rejects the
-    combination at construction).
+    jit level); h0: embedded inputs [B, T, D]; returns (final hidden
+    [B, T, D] (pre-final-norm), moe_aux scalar). B must divide by
+    ``microbatches``. ``attn_fn`` is the per-block attention callable built
+    by ``llama.forward`` (ring attention is invalid here -- it nests its
+    own shard_map; the trainer rejects the combination at construction).
+
+    moe_aux is the router aux loss averaged over layers AND microbatches
+    (psum'd across stages). With microbatches=1 it equals the unpipelined
+    value exactly; with M>1 the router's batch statistics are computed per
+    microbatch, so the aux is the mean of M microbatch-local values --
+    the standard GPipe semantics for batch-statistic losses. 0.0 for
+    dense models.
     """
     B, T, D = h0.shape
     M = microbatches
@@ -74,7 +81,7 @@ def pipeline_hidden(
         jax.shard_map,
         mesh=mesh,
         in_specs=(layer_specs, P(), P()),
-        out_specs=P(),
+        out_specs=(P(), P()),
         axis_names={axis},
     )
     def _pipeline(layers_local, hs, mb_positions):
@@ -88,16 +95,20 @@ def pipeline_hidden(
                 cfg, attn_fn, h, layer, pos, rope
             )
             block = _maybe_remat(block, remat)
-            y, _ = jax.lax.scan(block, x, layers_local)
-            return y
+            y, (_, layer_auxs) = jax.lax.scan(block, x, layers_local)
+            return y, jnp.sum(layer_auxs)
 
         def tick(carry, t):
-            cur, outs = carry
+            cur, outs, aux = carry
             mb = jnp.clip(t - r, 0, M - 1)  # this stage's microbatch index
             # stage 0 feeds fresh microbatches; later stages consume the
             # activation handed over at the previous tick
             x = jnp.where(r == 0, hs[jnp.clip(t, 0, M - 1)], cur)
-            y = stage(x, mb_positions[mb])
+            y, aux_sum = stage(x, mb_positions[mb])
+            # fill/drain ticks run on clipped garbage inputs: their router
+            # aux must not count
+            valid = (t - r >= 0) & (t - r <= M - 1)
+            aux = aux + jnp.where(valid, aux_sum, 0.0)
             out_idx = t - (n - 1)
             take = (r == n - 1) & (out_idx >= 0)
             slot = jnp.clip(out_idx, 0, M - 1)
@@ -105,19 +116,24 @@ def pipeline_hidden(
                 jnp.where(take, y, outs[slot]), indices_are_sorted=True
             )
             nxt = jax.lax.ppermute(y, axis, perm)
-            return (nxt, outs), None
+            return (nxt, outs, aux), None
 
         zeros = jnp.zeros_like(hs[0])
         outs0 = jnp.zeros_like(hs)
-        cur0, outs0 = jax.lax.pcast((zeros, outs0), axis, to="varying")
-        (cur, outs), _ = jax.lax.scan(
-            tick, (cur0, outs0), jnp.arange(M + n - 1)
+        cur0, outs0, aux0 = jax.lax.pcast(
+            (zeros, outs0, jnp.float32(0.0)), axis, to="varying"
+        )
+        (cur, outs, aux), _ = jax.lax.scan(
+            tick, (cur0, outs0, aux0), jnp.arange(M + n - 1)
         )
         # only the last stage holds real outputs; replicate them
         outs = jax.lax.psum(
             jnp.where(r == n - 1, outs, jnp.zeros_like(outs)), axis
         )
-        return outs
+        # each stage summed the aux of its own layers over its M valid
+        # microbatch runs: psum -> total over all L layers x M microbatches
+        aux = jax.lax.psum(aux, axis) / (cfg.num_hidden_layers * M)
+        return outs, aux
 
-    outs = _pipeline(cparams["layers"], hs, mb_positions)
-    return outs.reshape(B, T, D)
+    outs, moe_aux = _pipeline(cparams["layers"], hs, mb_positions)
+    return outs.reshape(B, T, D), moe_aux

@@ -195,12 +195,6 @@ class InnerTrainer:
                     "nests its own shard_map); use attn_impl xla/pallas "
                     "with pp, or sp without pp"
                 )
-            if model_cfg.num_experts:
-                raise ValueError(
-                    "MoE models are not supported with pipeline parallelism "
-                    "yet (the router aux loss is not threaded through the "
-                    "pipeline)"
-                )
         if plan.ep_axis:
             ep_n = plan.mesh.shape[plan.ep_axis]
             if model_cfg.num_experts == 0:
@@ -341,67 +335,53 @@ class InnerTrainer:
         )
 
     def _loss_fn(self, params: dict, input_ids: jax.Array, labels: jax.Array):
+        """Dispatch on mesh shape only; the moe/fused branching is shared.
+
+        pp meshes stage the decoder stack over the pp axis
+        (parallel/pipeline.py) with embed / final norm / head replicated;
+        non-pp meshes thread the ring-attention mesh instead. fused_loss
+        composes with both (they hand back hidden states), and the MoE
+        router aux rides return_moe_aux either way (through the pipeline's
+        per-stage accumulators under pp)."""
         if self.plan.pp_axis:
-            return self._pp_loss(params, input_ids, labels)
+            fwd_kwargs = dict(
+                pp_mesh=self.plan.mesh,
+                pp_axis=self.plan.pp_axis,
+                pp_microbatches=self.tc.pp_microbatches,
+            )
+        else:
+            fwd_kwargs = dict(
+                ring_mesh=self.plan.mesh,
+                ring_axis=self.plan.sp_axis or "sp",
+            )
         moe = bool(self.model_cfg.num_experts)
+        aux = lambda a: self.model_cfg.router_aux_coef * a
+        fwd_kwargs.update(
+            compute_dtype=self.tc.compute_dtype,
+            attn_impl=self.tc.attn_impl,
+            remat=self.tc.remat,
+        )
         if self.tc.fused_loss:
             out = forward(
                 params,
                 input_ids,
                 self.model_cfg,
-                compute_dtype=self.tc.compute_dtype,
-                attn_impl=self.tc.attn_impl,
-                remat=self.tc.remat,
                 return_hidden=True,
                 return_moe_aux=moe,
-                ring_mesh=self.plan.mesh,
-                ring_axis=self.plan.sp_axis or "sp",
+                **fwd_kwargs,
             )
             if moe:
                 hidden, head, moe_aux = out
-                return self._fused_lm_loss(hidden, head, labels) + (
-                    self.model_cfg.router_aux_coef * moe_aux
-                )
+                return self._fused_lm_loss(hidden, head, labels) + aux(moe_aux)
             hidden, head = out
             return self._fused_lm_loss(hidden, head, labels)
         out = forward(
-            params,
-            input_ids,
-            self.model_cfg,
-            compute_dtype=self.tc.compute_dtype,
-            attn_impl=self.tc.attn_impl,
-            remat=self.tc.remat,
-            ring_mesh=self.plan.mesh,
-            ring_axis=self.plan.sp_axis or "sp",
-            return_moe_aux=moe,
+            params, input_ids, self.model_cfg, return_moe_aux=moe, **fwd_kwargs
         )
         if moe:
             logits, moe_aux = out
-            return causal_lm_loss(logits, labels) + (
-                self.model_cfg.router_aux_coef * moe_aux
-            )
+            return causal_lm_loss(logits, labels) + aux(moe_aux)
         return causal_lm_loss(out, labels)
-
-    def _pp_loss(self, params: dict, input_ids: jax.Array, labels: jax.Array):
-        """Pipeline-parallel loss: decoder stack staged over the pp axis
-        (parallel/pipeline.py); embed / final norm / head run replicated.
-        fused_loss composes: the pipeline hands back hidden states, so the
-        fused lm-head+xent kernel applies unchanged."""
-        pp_kwargs = dict(
-            compute_dtype=self.tc.compute_dtype,
-            attn_impl=self.tc.attn_impl,
-            remat=self.tc.remat,
-            pp_mesh=self.plan.mesh,
-            pp_axis=self.plan.pp_axis,
-            pp_microbatches=self.tc.pp_microbatches,
-        )
-        if self.tc.fused_loss:
-            hidden, head = forward(
-                params, input_ids, self.model_cfg, return_hidden=True, **pp_kwargs
-            )
-            return self._fused_lm_loss(hidden, head, labels)
-        logits = forward(params, input_ids, self.model_cfg, **pp_kwargs)
-        return causal_lm_loss(logits, labels)
 
     def _train_step_impl(self, state: dict, batch: dict):
         """batch arrays are [accum, global_microbatch, seq]."""

@@ -99,11 +99,59 @@ def test_moe_capacity_drop_passes_residual():
     assert not np.allclose(np.asarray(out_big), np.asarray(out_tiny))
 
 
-def test_moe_rejected_with_pp():
+def test_moe_pp_loss_matches_sequential():
+    """MoE composes with pipeline parallelism: the router aux rides the
+    pipeline's per-stage accumulators (parallel/pipeline.py). With
+    microbatches=1 the total loss (xent + aux) is exactly the unpipelined
+    value; with M>1 the aux becomes the mean of microbatch-local router
+    statistics (standard GPipe semantics) and training still runs."""
     cfg = _cfg(4)
-    tc = TrainerConfig(precision="fp32", remat=False, total_steps=10, warmup_steps=2)
-    with pytest.raises(ValueError, match="MoE"):
-        InnerTrainer(cfg, tc, build_mesh("NO_SHARD", pp_size=2))
+    ids = np.random.default_rng(2).integers(
+        0, cfg.vocab_size, (8, 32), dtype=np.int32
+    )
+
+    def one_loss(pp, mb, ep=1):
+        plan = build_mesh("NO_SHARD", pp_size=pp, ep_size=ep)
+        tc = TrainerConfig(
+            precision="fp32", remat=False, total_steps=10, warmup_steps=2,
+            attn_impl="xla", pp_microbatches=mb,
+        )
+        trainer = InnerTrainer(cfg, tc, plan)
+        state = trainer.init_state(jax.random.key(11))
+        batch = trainer.shard_batch(ids, ids.copy(), accum=1)
+        _, m = trainer.train_step(state, batch)
+        return float(m["loss"])
+
+    ref = one_loss(pp=1, mb=1)
+    # microbatches=1: per-batch router statistics identical -> exact
+    np.testing.assert_allclose(one_loss(pp=2, mb=1), ref, atol=2e-5)
+
+    # microbatched pp x ep: hidden states (and xent) are exact; the aux is
+    # the MEAN over per-microbatch router statistics. Build that reference
+    # from unpipelined forwards so a normalization bug (e.g. /L instead of
+    # /(L*M)) cannot pass
+    from opendiloco_tpu.models.llama import causal_lm_loss
+
+    tc = TrainerConfig(
+        precision="fp32", remat=False, total_steps=10, warmup_steps=2,
+        attn_impl="xla",
+    )
+    trainer = InnerTrainer(cfg, tc, build_mesh("NO_SHARD"))
+    params = jax.device_get(trainer.init_state(jax.random.key(11))["params"])
+    jids = jnp.asarray(ids)
+    logits = forward(params, jids, cfg, compute_dtype=jnp.float32, remat=False)
+    xent = float(causal_lm_loss(logits, jids))
+    auxs = [
+        float(
+            forward(
+                params, mb_ids, cfg, compute_dtype=jnp.float32, remat=False,
+                return_moe_aux=True,
+            )[1]
+        )
+        for mb_ids in (jids[:4], jids[4:])
+    ]
+    ref2 = xent + cfg.router_aux_coef * float(np.mean(auxs))
+    np.testing.assert_allclose(one_loss(pp=2, mb=2, ep=2), ref2, atol=1e-4)
 
 
 def test_moe_fused_loss_matches_standard():
