@@ -56,6 +56,27 @@ def base_args(tmp_path, logger_file, extra=None) -> list[str]:
 
 
 
+def spawn_rendezvous_daemon() -> tuple[subprocess.Popen, str]:
+    """Launch one Python rendezvous daemon on an ephemeral port and harvest
+    its announced host:port (chaos tests share this so daemon launch/parse
+    changes happen in one place, like spawn_worker for workers)."""
+    d = subprocess.Popen(
+        [
+            sys.executable, "-m", "opendiloco_tpu.diloco.rendezvous",
+            "--host", "127.0.0.1", "--port", "0",
+        ],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env={**os.environ, "PYTHONPATH": REPO + os.pathsep + os.environ.get("PYTHONPATH", "")},
+        cwd=REPO,
+    )
+    # skip log lines; fail loudly on daemon death
+    while True:
+        line = d.stdout.readline()
+        assert line, "rendezvous daemon died before announcing its port"
+        if "initial_peers =" in line:
+            return d, line.strip().split()[-1].replace("0.0.0.0", "127.0.0.1")
+
+
 def spawn_worker(args) -> subprocess.Popen:
     """Launch one training worker process on the CPU mesh (multi-worker
     tests share this so env/launch changes happen in one place)."""
@@ -424,29 +445,7 @@ def test_rendezvous_sigkill_failover_training_completes(tmp_path):
     import signal
     import time as _time
 
-    daemons = [
-        subprocess.Popen(
-            [
-                sys.executable, "-m", "opendiloco_tpu.diloco.rendezvous",
-                "--host", "127.0.0.1", "--port", str(port),
-            ],
-            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
-            env={**os.environ, "PYTHONPATH": REPO + os.pathsep + os.environ.get("PYTHONPATH", "")},
-            cwd=REPO,
-        )
-        for port in (0, 0)
-    ]
-    # harvest the announced ports (skip log lines; fail loudly on daemon death)
-    addrs = []
-    for d in daemons:
-        while True:
-            line = d.stdout.readline()
-            assert line, "rendezvous daemon died before announcing its port"
-            if "initial_peers =" in line:
-                addrs.append(
-                    line.strip().split()[-1].replace("0.0.0.0", "127.0.0.1")
-                )
-                break
+    daemons, addrs = zip(*(spawn_rendezvous_daemon() for _ in range(2)))
     peers = ",".join(addrs)
 
     procs, logs = [], []
@@ -493,6 +492,64 @@ def test_rendezvous_sigkill_failover_training_completes(tmp_path):
         for d in daemons:
             if d.poll() is None:
                 d.kill()
+
+
+@pytest.mark.slow
+def test_all_daemons_sigkill_training_reforms_on_worker(tmp_path):
+    """The ONLY rendezvous daemon is SIGKILLed mid-training: the swarm must
+    re-form on a worker-hosted embedded rendezvous (every worker is also a
+    rendezvous node, like every hivemind peer is a DHT node) and finish
+    every step with both peers -- never a solo split, never a crash."""
+    import signal
+    import time as _time
+
+    daemon, peers = spawn_rendezvous_daemon()
+
+    procs, logs = [], []
+    try:
+        for rank in range(2):
+            logf = tmp_path / f"alldead{rank}.pkl"
+            logs.append(logf)
+            args = base_args(
+                tmp_path,
+                logf,
+                [
+                    "--total-steps", "60",
+                    "--diloco.local-steps", "4",
+                    "--diloco.initial-peers", peers,
+                    "--diloco.world-rank", str(rank),
+                    "--diloco.galaxy-size", "2",
+                    "--diloco.matchmaking-time", "1.0",
+                    "--diloco.averaging-timeout", "30",
+                    "--diloco.backend", "tcp",
+                    "--diloco.skip-load-from-peers",
+                    "--no-ckpt.interval",
+                ],
+            )
+            procs.append(spawn_worker(args))
+        _time.sleep(30)  # compile + the first outer rounds on the daemon
+        alive_at_kill = all(p.poll() is None for p in procs)
+        daemon.send_signal(signal.SIGKILL)  # the ENTIRE daemon fabric dies
+        outs = [p.communicate(timeout=600) for p in procs]
+        for p, (out, err) in zip(procs, outs):
+            assert p.returncode == 0, err[-3000:]
+        for logf in logs:
+            rows = read_metrics(logf)
+            assert len(rows) == 60
+            assert all(np.isfinite(r["Loss"]) for r in rows)
+            assert rows[-1]["outer_epoch"] == 15
+            assert rows[-1]["num_peers"] == 2  # never split into solo groups
+        if alive_at_kill:
+            assert any(
+                "re-formed on worker-hosted rendezvous" in (e or "")
+                for _, e in outs
+            )
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        if daemon.poll() is None:
+            daemon.kill()
 
 
 @pytest.mark.slow
