@@ -506,7 +506,17 @@ def main(argv: Optional[list[str]] = None) -> None:
         "from remote daemons, so an unadvertised daemon is only "
         "discoverable on its own host",
     )
+    ap.add_argument(
+        "--ttl",
+        type=float,
+        default=None,
+        help="seconds without contact before a peer is considered dead "
+        "(default 60; the C++ daemon takes the same flag)",
+    )
     args = ap.parse_args(argv)
+    if args.ttl is not None:
+        global PEER_TTL
+        PEER_TTL = args.ttl
 
     identity = None
     if args.identity_file:
