@@ -104,6 +104,7 @@ def worker_main() -> None:
     ap.add_argument("--rounds", type=int, required=True)
     ap.add_argument("--peers", type=int, required=True)
     ap.add_argument("--timeout", type=float, default=600.0)
+    ap.add_argument("--sweep-start", type=float, default=0.0)
     args = ap.parse_args()
 
     from opendiloco_tpu.diloco.backend import PeerProgress
@@ -114,7 +115,10 @@ def worker_main() -> None:
         [args.rendezvous],
         peer_id=f"bench-{args.rank}",
         compression=args.compression,
-        matchmaking_time=1.0,
+        # the window must cover the slowest peer's join on a box where all
+        # peers contend for one core; 1 s split 8-peer runs into partial
+        # groups
+        matchmaking_time=max(2.0, 0.75 * args.peers),
     )
     # a worker that starts its round before the others register gets a SOLO
     # matchmaking group (n=1, no wire traffic -- a meaningless number); the
@@ -122,11 +126,33 @@ def worker_main() -> None:
     backend.report_progress(
         PeerProgress(f"bench-{args.rank}", 0, 0, 0.0, time.time())
     )
-    deadline = time.time() + 120
-    # peer_progress() re-polls the rendezvous when its cache is stale;
-    # num_peers() alone would spin on a frozen snapshot
-    while len(backend.peer_progress()) < args.peers and time.time() < deadline:
+    # setup (jax import + model-sized leaf generation) serializes on a
+    # 1-core box, so assembly time scales with the peer count; falling
+    # through to a solo/partial round would bench nothing, so fail loudly
+    # instead (the parent records a diagnosable worker-failure row).
+    # Only progress reported AFTER this sweep started counts: a previous
+    # killed sweep's workers never unregistered, and their stale entries
+    # (same bench-N ids, up to PEER_TTL old) would otherwise satisfy the
+    # count while the real peers are still importing jax
+    def fresh_peers():
+        return sum(
+            1
+            for pr in backend.peer_progress()
+            if pr.timestamp >= args.sweep_start
+        )
+
+    deadline = time.time() + 60 + 60 * args.peers
+    while fresh_peers() < args.peers and time.time() < deadline:
         time.sleep(0.3)
+    assembled = fresh_peers()
+    if assembled < args.peers:
+        print(
+            f"FATAL: only {assembled}/{args.peers} peers assembled before "
+            "the deadline",
+            flush=True,
+        )
+        backend.close()
+        sys.exit(3)
     times = []
     n = 0
     for _ in range(args.rounds):
@@ -224,7 +250,10 @@ def run_sweep(args, server, nbytes, base_env, cap_bps: float) -> None:
     round_timeout = max(600.0, nbytes / 20e6)
     if cap_bps > 0:
         round_timeout = max(round_timeout, 4.0 * nbytes / cap_bps)
-    proc_timeout = args.rounds * round_timeout + 300.0
+    # includes the workers' own peer-scaled assembly deadline: the parent
+    # must outwait a worker's fail-loud exit, not preempt it with a kill
+    # (which loses the diagnosable output AND leaves stale registrations)
+    proc_timeout = args.rounds * round_timeout + 300.0 + 60 + 60 * args.peers
     env = dict(base_env)
     if cap_bps > 0:
         env["ODTP_BULK_BANDWIDTH_BPS"] = str(int(cap_bps))
@@ -245,6 +274,7 @@ def run_sweep(args, server, nbytes, base_env, cap_bps: float) -> None:
                     "--rounds", str(args.rounds),
                     "--peers", str(args.peers),
                     "--timeout", str(round_timeout),
+                    "--sweep-start", str(time.time()),
                 ],
                 stdout=subprocess.PIPE,
                 stderr=subprocess.STDOUT,  # tracebacks land in the detail
