@@ -39,6 +39,13 @@ ALL_CODECS = [
 _OUT = os.path.join(REPO, "OUTER_BENCH.json")
 
 
+def expected_group(peers: int, group_cap: int) -> int:
+    """Matchmade group size a healthy bench round must reach. The parent
+    rejects peers % group_cap != 0, so capped groups are exactly the cap
+    (a designed-but-solo remainder group would bench nothing)."""
+    return group_cap or peers
+
+
 def make_leaves(model: str, rank: int):
     """Model-shaped fp32 leaves, generated directly in fp32 (a float64
     intermediate at 1b scale costs 8 GB and minutes on one core)."""
@@ -105,6 +112,7 @@ def worker_main() -> None:
     ap.add_argument("--peers", type=int, required=True)
     ap.add_argument("--timeout", type=float, default=600.0)
     ap.add_argument("--sweep-start", type=float, default=0.0)
+    ap.add_argument("--group-cap", type=int, default=0)
     args = ap.parse_args()
 
     from opendiloco_tpu.diloco.backend import PeerProgress
@@ -157,9 +165,11 @@ def worker_main() -> None:
     n = 0
     for _ in range(args.rounds):
         t0 = time.perf_counter()
-        out, n = backend.all_reduce(data, timeout=args.timeout)
+        out, n = backend.all_reduce(
+            data, timeout=args.timeout, group_cap=args.group_cap
+        )
         times.append(time.perf_counter() - t0)
-        if n < args.peers:
+        if n < expected_group(args.peers, args.group_cap):
             break  # solo/partial round: the row must not pass as a result
     timings = {
         k: round(v, 3)
@@ -170,6 +180,12 @@ def worker_main() -> None:
         print("RESULT " + " ".join(f"{t:.4f}" for t in times) + f" n={n}",
               flush=True)
         print("TIMINGS " + json.dumps(timings), flush=True)
+    if n < expected_group(args.peers, args.group_cap):
+        # EVERY worker reports its own partial round (with group_cap only
+        # rank 0's group would otherwise be validated); rank 0 printed its
+        # RESULT first so the parent can still classify its row
+        print(f"PARTIAL n={n} in rank {args.rank}", flush=True)
+        sys.exit(4)
 
 
 def _append_row(row: dict) -> None:
@@ -205,6 +221,10 @@ def main() -> None:
     ap.add_argument("--peers", type=int, default=2)
     ap.add_argument("--model", default="150m")
     ap.add_argument("--rounds", type=int, default=3)
+    ap.add_argument("--group-cap", type=int, default=0,
+                    help="gossip mode: partition matchmade joiners into "
+                    "groups of at most this size (0 = one global group); "
+                    "--peers must divide evenly")
     ap.add_argument("--codecs", default=",".join(ALL_CODECS),
                     help="comma list from: " + ",".join(ALL_CODECS))
     ap.add_argument(
@@ -215,6 +235,14 @@ def main() -> None:
         "wire beats raw fp32 even after paying encode/decode",
     )
     args = ap.parse_args()
+    if args.group_cap and args.peers % args.group_cap:
+        # the rendezvous would hand the remainder a smaller (possibly solo)
+        # group by design -- which benches nothing; require even gossip
+        # groups instead of recording nondeterministic partial-round errors
+        ap.error(
+            f"--peers {args.peers} must divide evenly by "
+            f"--group-cap {args.group_cap}"
+        )
 
     from opendiloco_tpu.diloco.rendezvous import RendezvousServer
     from opendiloco_tpu.models.hf_io import load_config
@@ -275,6 +303,7 @@ def run_sweep(args, server, nbytes, base_env, cap_bps: float) -> None:
                     "--peers", str(args.peers),
                     "--timeout", str(round_timeout),
                     "--sweep-start", str(time.time()),
+                    "--group-cap", str(args.group_cap),
                 ],
                 stdout=subprocess.PIPE,
                 stderr=subprocess.STDOUT,  # tracebacks land in the detail
@@ -304,6 +333,26 @@ def run_sweep(args, server, nbytes, base_env, cap_bps: float) -> None:
              if l.startswith("RESULT")),
             None,
         )
+        # classify a partial round (any worker's) before generic failure:
+        # workers exit 4 on a partial group but rank 0 still prints RESULT
+        want = expected_group(args.peers, args.group_cap)
+        group_n = int(line.split()[-1].split("=")[1]) if line else 0
+        partial = any(
+            l.startswith("PARTIAL") for o in outs for l in o.splitlines()
+        )
+        if line is not None and (group_n < want or partial):
+            print(f"{compression:>14}: SOLO/PARTIAL GROUP n={group_n}")
+            _append_row({
+                "model": args.model, "peers": args.peers,
+                "codec": compression,
+                "error": (
+                    f"matchmade group {group_n} < {want}"
+                    if group_n < want
+                    else "partial group in a non-rank-0 worker"
+                ),
+                **cap_note,
+            })
+            continue
         if line is None or any(p.returncode for p in procs):
             print(f"{compression:>14}: FAILED")
             _append_row({
@@ -313,16 +362,6 @@ def run_sweep(args, server, nbytes, base_env, cap_bps: float) -> None:
                 "detail": [
                     " | ".join(o.splitlines()[-3:])[-400:] for o in outs
                 ],
-            })
-            continue
-        group_n = int(line.split()[-1].split("=")[1])
-        if group_n < args.peers:
-            print(f"{compression:>14}: SOLO/PARTIAL GROUP n={group_n}")
-            _append_row({
-                "model": args.model, "peers": args.peers,
-                "codec": compression,
-                "error": f"matchmade group {group_n} < {args.peers}",
-                **cap_note,
             })
             continue
         tline = next(
@@ -340,6 +379,7 @@ def run_sweep(args, server, nbytes, base_env, cap_bps: float) -> None:
         row = {
             "model": args.model, "mb_fp32": round(nbytes / 1e6),
             "peers": args.peers, "codec": compression,
+            **({"group_cap": args.group_cap} if args.group_cap else {}),
             "rounds_s": [round(t, 3) for t in times],
             "best_s": round(best, 3),
             "median_s": round(statistics.median(times), 3),
