@@ -94,6 +94,38 @@ class DilocoConfig(BaseModel):
     #               pseudo-gradient immediately, corrected on arrival
     overlap_comm: Literal["none", "delayed", "eager"] = "none"
 
+    # Streaming DiLoCo-style fragment sync (arxiv 2501.18512): partition
+    # the parameter leaves into N size-balanced fragments and sync ONE
+    # fragment per outer boundary (fragment = epoch mod N). Each fragment
+    # gets outer updates every N epochs on its own staggered clock; the
+    # un-synced leaves keep training locally. Peak per-boundary bandwidth
+    # drops ~N-fold. 0/1 = off (reference full-sync semantics).
+    streaming_fragments: int = 0
+
+    @model_validator(mode="after")
+    def _streaming_constraints(self):
+        if self.streaming_fragments > 1:
+            if self.outer_mode != "allreduce":
+                raise ValueError(
+                    "streaming_fragments requires outer_mode='allreduce' "
+                    "(gossip mixes full masters per pair)"
+                )
+            if self.overlap_comm != "none":
+                raise ValueError(
+                    "streaming_fragments does not compose with overlap_comm "
+                    "yet; fragment rounds are already ~N-fold shorter"
+                )
+            if self.average_state_every:
+                raise ValueError(
+                    "streaming_fragments makes average_state_every "
+                    "unnecessary AND destructive: masters cannot drift "
+                    "(every fragment update is the same all-reduced "
+                    "result on every peer), while a full master reset "
+                    "would erase the un-synced fragments' local progress "
+                    "without it ever forming a pseudo-gradient"
+                )
+        return self
+
     @model_validator(mode="after")
     def _gossip_constraints(self):
         if self.outer_mode == "gossip" and self.overlap_comm != "none":

@@ -70,6 +70,35 @@ class DiLoCoOptimizer:
         )
 
         self._schema = schema_fingerprint(state["params"])
+        # streaming fragment sync (arxiv 2501.18512): size-balanced
+        # contiguous partition of leaf indices, derived from the (shared)
+        # schema so every peer computes the identical partition with no
+        # coordination; fragment synced at epoch e is e mod N
+        self._fragments: Optional[list[list[int]]] = None
+        if cfg.streaming_fragments > 1:
+            n_frag = min(cfg.streaming_fragments, len(self.master))
+            total = sum(m.size for m in self.master)
+            target = total / n_frag
+            frags: list[list[int]] = []
+            cur: list[int] = []
+            acc = 0
+            for i, m in enumerate(self.master):
+                cur.append(i)
+                acc += m.size
+                remaining = len(self.master) - i - 1
+                still_needed = n_frag - len(frags) - 1  # after closing cur
+                # close when the fragment is full OR the leaves left are
+                # only just enough to give every remaining fragment one --
+                # EXACTLY n_frag non-empty fragments, best-effort balance
+                # even when a huge leaf sits at the tail
+                if still_needed > 0 and (
+                    acc >= target or remaining == still_needed
+                ):
+                    frags.append(cur)
+                    cur, acc = [], 0
+            frags.append(cur)
+            assert len(frags) == n_frag and all(frags)
+            self._fragments = frags
         self.epoch = 0  # completed outer steps
         self.local_step = 0  # inner steps within current epoch
         self.samples_in_epoch = 0
@@ -538,14 +567,25 @@ class DiLoCoOptimizer:
 
         # overlap the D2H transfer with the straggler wait (SURVEY hard-part
         # 2): the params are final at the boundary, so fetch them while
-        # polling slow peers instead of after
+        # polling slow peers instead of after. Streaming fragments fetch
+        # ONLY this boundary's fragment -- the off-wire transfer savings
+        # must match the on-wire ones
+        frag: Optional[list[int]] = None
+        device_leaves = jax.tree.leaves(state["params"])
+        if self._fragments is not None:
+            frag = self._fragments[self.epoch % len(self._fragments)]
         fetch_result: list = []
 
         def _fetch():
+            src = (
+                device_leaves
+                if frag is None
+                else [device_leaves[i] for i in frag]
+            )
             fetch_result.append(
                 [
                     np.asarray(x, dtype=np.float32)
-                    for x in jax.tree.leaves(jax.device_get(state["params"]))
+                    for x in jax.device_get(src)
                 ]
             )
 
@@ -563,9 +603,19 @@ class DiLoCoOptimizer:
         fetcher.join()
         device_flat = fetch_result[0]
 
-        # pseudo-gradient = master - current device params (persistent slot
-        # buffer: the blocking path consumes it synchronously, slot 0 only)
-        pseudo_grad = self._pseudo_grad_into(device_flat, slot=0)
+        if frag is not None:
+            # streaming sync: only this boundary's fragment forms a
+            # pseudo-gradient and rides the wire (fragment-sized arrays,
+            # not the persistent full-model slots)
+            pseudo_grad = [
+                native.sub(self.master[i], d)
+                for i, d in zip(frag, device_flat)
+            ]
+        else:
+            # pseudo-gradient = master - current device params (persistent
+            # slot buffer: the blocking path consumes it synchronously,
+            # slot 0 only)
+            pseudo_grad = self._pseudo_grad_into(device_flat, slot=0)
 
         t1 = time.monotonic()
         if self.cfg.outer_mode == "gossip":
@@ -606,7 +656,10 @@ class DiLoCoOptimizer:
         # live array must stay bit-stable once published
         new_master = [m.copy() for m in self.master]
         new_opt = self.outer_opt.clone()
-        new_opt.step(new_master, averaged)
+        if frag is not None:
+            new_opt.step_indices(new_master, averaged, frag)
+        else:
+            new_opt.step(new_master, averaged)
         self.master = new_master
         self.outer_opt = new_opt
 
@@ -623,7 +676,22 @@ class DiLoCoOptimizer:
             self.master = [np.array(a, dtype=np.float32) for a in averaged_state]
             log.info("averaged full state over %d peers at epoch %d", n, self.epoch)
 
-        state = self._write_master_to_device(state)  # [H2D]
+        if frag is not None:
+            # streaming semantics: only the synced fragment resets to the
+            # (freshly outer-stepped) master; every other leaf KEEPS its
+            # local training progress AND stays on-device (the live jax
+            # arrays pass through device_put untouched, so the H2D moves
+            # one fragment, not the model). Its master stays frozen until
+            # its own sync boundary comes around.
+            merged = list(device_leaves)
+            for i in frag:
+                merged[i] = self.master[i]
+            state["params"] = jax.device_put(
+                jax.tree.unflatten(self.treedef, merged),
+                self.trainer.state_shardings["params"],
+            )
+        else:
+            state = self._write_master_to_device(state)  # [H2D]
 
         with self._serve_lock:
             self.epoch += 1

@@ -33,13 +33,28 @@ class OuterSGD:
 
     def step(self, params: list[np.ndarray], grads: list[np.ndarray]) -> None:
         """In-place update of ``params`` given pseudo-gradients ``grads``."""
+        self.step_indices(params, grads, range(len(params)))
+
+    def step_indices(
+        self,
+        params: list[np.ndarray],
+        grads: list[np.ndarray],
+        idxs,
+    ) -> None:
+        """In-place update of ``params[i] for i in idxs`` given pseudo-
+        gradients aligned to ``idxs``. The ONE copy of the numerically
+        load-bearing SGD rule: the full-sync ``step`` delegates here with
+        all indices; the streaming-fragment outer step passes its
+        fragment (each fragment runs the same rule on its own staggered
+        clock; untouched leaves keep their momentum frozen)."""
         if self.momentum == 0.0:
-            for p, g in zip(params, grads):
-                p -= self.lr * g
+            for j, i in enumerate(idxs):
+                params[i] -= self.lr * grads[j]
             return
         if self.bufs is None:
             self.bufs = [np.zeros_like(p) for p in params]
-        for p, g, buf in zip(params, grads, self.bufs):
+        for j, i in enumerate(idxs):
+            p, g, buf = params[i], grads[j], self.bufs[i]
             np.multiply(buf, self.momentum, out=buf)
             buf += g
             if self.nesterov:

@@ -229,6 +229,109 @@ def run_diloco_workers(tiny_cfg, n_workers, n_steps, local_steps, compression="n
     return results
 
 
+def test_streaming_fragments_sync_one_fragment_per_boundary(tiny_cfg):
+    """Streaming DiLoCo fragment sync (arxiv 2501.18512): each outer
+    boundary all-reduces ONE size-balanced leaf fragment (epoch mod N).
+    Asserts the three defining properties over 4 boundaries x 2 workers:
+    masters stay identical across workers (every master update is an
+    all-reduced fragment update), each boundary's wire traffic is ~1/N of
+    the model, and after the final boundary the just-synced fragment's
+    device leaves equal the master while the other fragment's leaves kept
+    diverging local progress."""
+    n_workers, local_steps, n_steps = 2, 4, 16  # 4 boundaries
+    world = LoopbackWorld(n_workers)
+    backends = world.make_backends()
+    results = [None] * n_workers
+    wire_bytes: list[list[int]] = [[] for _ in range(n_workers)]
+    errors = []
+
+    def worker(rank):
+        try:
+            trainer = make_trainer(tiny_cfg)
+            state = trainer.init_state(jax.random.key(7))
+            cfg = DilocoConfig(
+                local_steps=local_steps,
+                outer_nesterov=True,
+                backend="loopback",
+                timeout_waiting_for_peers=30.0,
+                averaging_timeout=60.0,
+                streaming_fragments=2,
+            )
+            be = backends[rank]
+            inner_all_reduce = be.all_reduce
+
+            def spy_all_reduce(arrays, **kw):
+                wire_bytes[rank].append(sum(a.nbytes for a in arrays))
+                return inner_all_reduce(arrays, **kw)
+
+            be.all_reduce = spy_all_reduce
+            opt = DiLoCoOptimizer(trainer, be, cfg, state, batch_size=8)
+            for ids, labels in batches(1000 + rank, tiny_cfg.vocab_size, n_steps):
+                state, m = opt.step(
+                    state, trainer.shard_batch(ids, labels, accum=1)
+                )
+                assert np.isfinite(m["loss"])
+            results[rank] = (
+                opt,
+                [
+                    np.asarray(x, np.float32)
+                    for x in jax.tree.leaves(jax.device_get(state["params"]))
+                ],
+            )
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    threads = [
+        threading.Thread(target=worker, args=(r,)) for r in range(n_workers)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=300)
+    assert not errors, errors
+
+    (opt0, dev0), (opt1, dev1) = results
+    frags = opt0._fragments
+    assert frags == opt1._fragments and len(frags) == 2
+    total = sum(m.size for m in opt0.master)
+    sizes = [sum(opt0.master[i].size for i in f) for f in frags]
+    assert all(0.2 * total < s < 0.8 * total for s in sizes), sizes
+
+    # masters never diverge: every update is an all-reduced fragment step
+    for a, b in zip(opt0.master, opt1.master):
+        np.testing.assert_array_equal(a, b)
+
+    # each boundary moved ~one fragment, not the model: per-round wire
+    # bytes match the fragment sizes exactly, alternating 0,1,0,1
+    frag_bytes = [
+        sum(opt0.master[i].nbytes for i in f) for f in frags
+    ]
+    for rank in range(n_workers):
+        assert wire_bytes[rank] == [
+            frag_bytes[e % 2] for e in range(4)
+        ], wire_bytes[rank]
+
+    # final boundary (epoch 3) synced fragment 1: those device leaves sit
+    # exactly on the shared master; fragment 0's leaves kept local progress
+    # since their epoch-2 reset and so differ across workers
+    for i in frags[1]:
+        np.testing.assert_array_equal(dev0[i], opt0.master[i])
+        np.testing.assert_array_equal(dev1[i], opt1.master[i])
+    assert any(
+        not np.array_equal(dev0[i], dev1[i]) for i in frags[0]
+    ), "un-synced fragment should carry diverging local progress"
+
+
+def test_streaming_fragments_config_constraints():
+    with pytest.raises(Exception, match="allreduce"):
+        DilocoConfig(streaming_fragments=2, outer_mode="gossip")
+    with pytest.raises(Exception, match="overlap"):
+        DilocoConfig(streaming_fragments=2, overlap_comm="delayed")
+    with pytest.raises(Exception, match="average_state_every"):
+        DilocoConfig(streaming_fragments=2, average_state_every=4)
+    DilocoConfig(streaming_fragments=4)  # valid
+
+
 def test_two_workers_resync_and_learn(tiny_cfg):
     results = run_diloco_workers(tiny_cfg, 2, n_steps=8, local_steps=4)
     (l0, p0), (l1, p1) = results
