@@ -16,6 +16,7 @@ OPENDILOCO_TPU_BENCH_FUSED / OPENDILOCO_TPU_BENCH_REMAT
 (true|false|dots).
 """
 
+import glob
 import json
 import os
 import time
@@ -112,6 +113,18 @@ def model_flops_per_token(cfg, seq: int) -> float:
     return 6 * n_matmul + attn
 
 
+def _attach_tunnel_evidence(extra: dict) -> None:
+    """Point the reader at the committed watcher evidence for WHY no live
+    row exists (e.g. TUNNEL_LOG_r04.log: 555 probes over ~18.5h, zero
+    alive windows in round 4). Attached to every no-live-measurement
+    emission -- banked fallback AND the zero row."""
+    logs = sorted(
+        glob.glob(os.path.join(os.path.dirname(_BANK_PATH), "TUNNEL_LOG_*.log"))
+    )
+    if logs:
+        extra["tunnel_evidence"] = os.path.basename(logs[-1])
+
+
 def _emit(error: str = None) -> bool:
     """Print the one JSON line. Returns True iff a nonzero value was emitted."""
     # exactly one JSON line, even when the watchdog fires while the main
@@ -168,6 +181,7 @@ def _emit(error: str = None) -> bool:
                 extra["note"] = banked["note"]
             if error:
                 extra["error"] = error
+            _attach_tunnel_evidence(extra)
             print(
                 json.dumps(
                     {
@@ -181,6 +195,8 @@ def _emit(error: str = None) -> bool:
                 flush=True,
             )
             return True
+        zero_extra = {"error": error or "no variant completed"}
+        _attach_tunnel_evidence(zero_extra)
         print(
             json.dumps(
                 {
@@ -188,7 +204,7 @@ def _emit(error: str = None) -> bool:
                     "value": 0,
                     "unit": "tokens/sec/chip",
                     "vs_baseline": 0,
-                    "extra": {"error": error or "no variant completed"},
+                    "extra": zero_extra,
                 }
             ),
             flush=True,
