@@ -7,7 +7,6 @@ IS the dense network (router softmax over one logit = 1.0)."""
 import jax
 import jax.numpy as jnp
 import numpy as np
-import pytest
 
 from opendiloco_tpu.models.llama import (
     LlamaConfig,
