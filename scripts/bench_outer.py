@@ -119,14 +119,23 @@ def worker_main() -> None:
     from opendiloco_tpu.diloco.tcp import TcpBackend
 
     data = make_leaves(args.model, args.rank)
+    # the window must cover the slowest peer's join on a box where all
+    # peers contend for one core; 1 s split 8-peer runs into partial
+    # groups. Under an egress cap the join frames also queue behind the
+    # previous round's residual throttled bytes (8 peers at 100 Mbps
+    # matchmade 6/8 with the uncapped window), so widen by the time a
+    # part-sized residual takes to drain at the cap. Generosity is free:
+    # the rendezvous closes the window EARLY once every live peer joined.
+    window = max(2.0, 0.75 * args.peers)
+    cap_bps = float(os.environ.get("ODTP_BULK_BANDWIDTH_BPS", 0) or 0)
+    if cap_bps > 0:
+        nbytes = sum(a.nbytes for a in data)
+        window += min(60.0, 4.0 * nbytes / max(args.peers, 1) / cap_bps)
     backend = TcpBackend(
         [args.rendezvous],
         peer_id=f"bench-{args.rank}",
         compression=args.compression,
-        # the window must cover the slowest peer's join on a box where all
-        # peers contend for one core; 1 s split 8-peer runs into partial
-        # groups
-        matchmaking_time=max(2.0, 0.75 * args.peers),
+        matchmaking_time=window,
     )
     # a worker that starts its round before the others register gets a SOLO
     # matchmaking group (n=1, no wire traffic -- a meaningless number); the
