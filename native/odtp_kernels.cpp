@@ -297,7 +297,9 @@ void odtp_lut256_accumulate(const uint8_t* idx, const float* lut, float* dst,
 #endif
 }
 
-int odtp_version() { return 2; }
+// Bumped once per exported symbol-group addition: 1 = base codecs,
+// 2 = fused decode-accumulate, 3 = absmax + fused scaled-fp16 paths.
+int odtp_version() { return 3; }
 
 }  // extern "C"
 

@@ -362,7 +362,14 @@ def _has(lib, name: str) -> bool:
 
 def quantize_uniform8(a: np.ndarray) -> tuple[bytes, float, float]:
     """Linear lo/span uint8 quantization -> (payload, lo, span); min/max
-    reduction and quantize both native single passes when built."""
+    reduction and quantize both native single passes when built.
+
+    NaN caveat (mirrors ``absmax``): the C kernel's min/max reduction skips
+    NaNs (finite lo/span, NaN elements clamp arbitrarily), while the numpy
+    fallback's ``a.min()/a.max()`` propagate NaN into lo/span and hence the
+    whole payload. NaN gradients are already a broken upstream state (the
+    fp16 scaler skips the step), so the two paths are only bit-identical on
+    finite inputs -- which is what the parity tests assert."""
     a = np.ascontiguousarray(a, np.float32).reshape(-1)
     lib = get_lib()
     if not _has(lib, "odtp_quantize_uniform8"):
