@@ -78,8 +78,11 @@ def ckpt_dir(path: str, step: int, diloco_rank: Optional[int] = None) -> str:
 
 
 def check_checkpoint_path_access(path: str, rank: int = 0) -> None:
-    """Fail fast on unwritable checkpoint destinations (ckpt_utils.py:182-193)."""
-    probe = f"{path.rstrip('/')}/.write_probe_{rank}"
+    """Fail fast on unwritable checkpoint destinations (ckpt_utils.py:182-193).
+    The probe is scoped by (diloco rank, process index): the processes of a
+    multihost slice all probe the same directory concurrently, and a shared
+    name races create-vs-remove."""
+    probe = f"{path.rstrip('/')}/.write_probe_{rank}_{_process_index()}"
     with _fs_open(probe, "w") as f:
         f.write("ok")
     if _is_remote(probe):

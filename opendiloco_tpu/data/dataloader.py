@@ -73,18 +73,35 @@ class _ProducerError:
 
 class FakeTokenizedDataset:
     """Deterministic infinite stream of random token sequences
-    (reference: utils.py:155-167)."""
+    (reference: utils.py:155-167).
 
-    def __init__(self, seq_length: int, vocab_size: int, seed: int = 0):
+    Counter-based: sample ``i`` of a seed is a pure function of ``(seed,
+    i)``. ``start``/``stride`` let multihost processes interleave one
+    shared stream (process ``p`` of ``n`` yields samples ``p, p+n, ...``)
+    so the assembled global batch holds the same sample set regardless of
+    the process topology — which is what makes single-host vs multihost
+    loss trajectories comparable in tests."""
+
+    def __init__(
+        self,
+        seq_length: int,
+        vocab_size: int,
+        seed: int = 0,
+        start: int = 0,
+        stride: int = 1,
+    ):
         assert vocab_size > 3, "vocab_size must be greater than 3"
         self.seq_length = seq_length
         self.vocab_size = vocab_size
         self.seed = seed
-        self.samples_seen = 0
+        self.start = start
+        self.stride = stride
+        self.samples_seen = 0  # local count; global index = start + i*stride
 
     def __iter__(self) -> Iterator[dict[str, np.ndarray]]:
         while True:
-            rng = np.random.default_rng((self.seed, self.samples_seen))
+            idx = self.start + self.samples_seen * self.stride
+            rng = np.random.default_rng((self.seed, idx))
             ids = rng.integers(3, self.vocab_size, self.seq_length).astype(np.int32)
             self.samples_seen += 1
             yield {"input_ids": ids, "labels": ids.copy()}
@@ -300,12 +317,15 @@ def get_dataloader(
         import jax
 
         # a different seed stream acts as the held-out split; multihost
-        # processes must generate distinct shards of the global batch
+        # processes interleave ONE shared stream (stride by process) so the
+        # global batch is identical whatever the process topology
         offset = 0 if split == "train" else 10_000_019
         ds = FakeTokenizedDataset(
             seq_length,
             vocab_size,
-            seed=seed + world_rank + offset + 100_003 * jax.process_index(),
+            seed=seed + world_rank + offset,
+            start=jax.process_index(),
+            stride=jax.process_count(),
         )
     elif streaming:
         import jax

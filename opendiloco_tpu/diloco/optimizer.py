@@ -30,6 +30,7 @@ from opendiloco_tpu import native
 from opendiloco_tpu.config import DilocoConfig
 from opendiloco_tpu.diloco.backend import OuterBackend, PeerProgress, wait_for_peers
 from opendiloco_tpu.diloco.outer_optimizer import OuterSGD
+from opendiloco_tpu.parallel.world import HostWorld
 from opendiloco_tpu.trainer import InnerTrainer
 from opendiloco_tpu.utils.debug import schema_fingerprint
 from opendiloco_tpu.utils.logger import get_text_logger
@@ -48,22 +49,38 @@ class DiLoCoOptimizer:
     def __init__(
         self,
         trainer: InnerTrainer,
-        backend: OuterBackend,
+        backend: Optional[OuterBackend],
         cfg: DilocoConfig,
         state: dict,
         batch_size: int,
+        world: Optional[HostWorld] = None,
     ):
         self.trainer = trainer
-        self.backend = backend
+        # world-messenger split (reference train_fsdp.py:183,205-212): only
+        # the messenger process of a multihost slice owns a WAN backend;
+        # follower processes meet it at mesh collectives (parallel/world.py)
+        self.world = world if world is not None else HostWorld()
+        if self.world.is_messenger and backend is None:
+            raise ValueError("the world-messenger process needs a backend")
+        self.backend = backend if self.world.is_messenger else None
+        if self.world.process_count > 1 and cfg.overlap_comm != "none":
+            raise ValueError(
+                "overlap-comm under --multihost is not supported: whether an "
+                "in-flight round has landed is a host-local fact, and acting "
+                "on it would desync the slice's collective order; run "
+                "overlap-comm none"
+            )
         self.cfg = cfg
         self.batch_size = batch_size
         self.target_samples = batch_size * cfg.local_steps
 
         # host master copy (float32). Flatten once; treedef is stable.
-        params_np = jax.device_get(state["params"])
-        flat, self.treedef = jax.tree.flatten(params_np)
+        # Under multihost the gather is a mesh collective: every process of
+        # the slice holds the identical full replica.
+        flat_dev, self.treedef = jax.tree.flatten(state["params"])
         self.master: list[np.ndarray] = [
-            np.array(x, dtype=np.float32) for x in flat
+            np.array(x, dtype=np.float32)
+            for x in self.world.gather_params(flat_dev)
         ]
         self.outer_opt = OuterSGD(
             lr=cfg.outer_lr, momentum=cfg.outer_momentum, nesterov=cfg.outer_nesterov
@@ -97,7 +114,16 @@ class DiLoCoOptimizer:
                     frags.append(cur)
                     cur, acc = [], 0
             frags.append(cur)
-            assert len(frags) == n_frag and all(frags)
+            # cross-peer-critical: every peer must derive the SAME n_frag
+            # non-empty fragments or the fragment all-reduces desync. A bare
+            # assert would vanish under `python -O`, so raise explicitly.
+            if len(frags) != n_frag or not all(frags):
+                raise ValueError(
+                    f"streaming-fragment partition produced "
+                    f"{sum(1 for f in frags if f)} non-empty of {len(frags)} "
+                    f"fragments, need exactly {n_frag} from "
+                    f"{len(self.master)} leaves"
+                )
             self._fragments = frags
         self.epoch = 0  # completed outer steps
         self.local_step = 0  # inner steps within current epoch
@@ -133,7 +159,8 @@ class DiLoCoOptimizer:
         # still streaming from
         self._pg_slot = 0
 
-        backend.serve_state(self._state_for_peers)
+        if self.backend is not None:
+            self.backend.serve_state(self._state_for_peers)
 
     def _pseudo_grad_into(self, boundary: list, slot: int) -> list[np.ndarray]:
         """master - boundary, written into the persistent slot buffers."""
@@ -195,10 +222,52 @@ class DiLoCoOptimizer:
             },
         }
 
+    def _broadcast_remote_state(self, remote: Optional[dict]) -> Optional[dict]:
+        """Fan a fetched swarm state from the messenger to every process of
+        the slice (collective: all processes call, followers pass None).
+        Small header by value; master/momentum arrays over the mesh."""
+        w = self.world
+        header = None
+        if remote is not None:
+            opt = remote["outer_opt"]
+            header = {
+                "epoch": int(remote["epoch"]),
+                "opt_scalars": {
+                    k: opt[k] for k in ("lr", "momentum", "nesterov")
+                },
+                "has_bufs": opt.get("bufs") is not None,
+            }
+        header = w.broadcast_obj(header)
+        if header is None:
+            return None
+        tmpl = [np.zeros(m.shape, np.float32) for m in self.master]
+        master = w.broadcast_arrays(
+            [np.asarray(m, np.float32) for m in remote["master"]]
+            if remote is not None
+            else tmpl
+        )
+        bufs = None
+        if header["has_bufs"]:
+            bufs = w.broadcast_arrays(
+                [np.asarray(b, np.float32) for b in remote["outer_opt"]["bufs"]]
+                if remote is not None
+                else tmpl
+            )
+        return {
+            "master": master,
+            "epoch": header["epoch"],
+            "outer_opt": {**header["opt_scalars"], "bufs": bufs},
+        }
+
     def load_state_from_peers(self, state: dict) -> Optional[dict]:
-        """Adopt a peer's master params/epoch; returns updated device state."""
+        """Adopt a peer's master params/epoch; returns updated device state.
+        Multihost: a collective — every process of the slice must call."""
         self.drop_pending()  # adopting remote state supersedes in-flight comm
-        remote = self.backend.fetch_state()
+        remote = (
+            self.backend.fetch_state() if self.world.is_messenger else None
+        )
+        if self.world.process_count > 1:
+            remote = self._broadcast_remote_state(remote)
         if remote is None:
             return None
         with self._serve_lock:
@@ -224,17 +293,30 @@ class DiLoCoOptimizer:
         """True when another peer is >=2 epochs ahead: our pseudo-gradients
         would poison the average (desync detection, hivemind_diloco.py:528-531).
         One epoch of skew is normal near boundaries."""
+        if self.backend is None:
+            return False
         for p in self.backend.peer_progress():
             if p.peer_id != self.backend.peer_id and p.epoch >= self.epoch + 2:
                 return True
         return False
+
+    def _desynced(self) -> bool:
+        """The desync decision, agreed across the slice: only the messenger
+        sees peer progress, so its verdict is broadcast (one tiny collective
+        per epoch start under multihost, a passthrough single-host). Every
+        process must reach this in lockstep — it is called at local_step 0,
+        which advances identically everywhere."""
+        behind = self._behind_swarm() if self.world.is_messenger else False
+        if self.world.process_count > 1:
+            behind = bool(self.world.broadcast_obj(behind))
+        return behind
 
     def step(self, state: dict, batch: dict) -> tuple[dict, dict]:
         """One inner optimizer step; triggers the outer step at the epoch
         boundary. Returns (state, metrics)."""
         if self._pending is not None:
             state = self._poll_pending(state, block=False)
-        if self.local_step == 0 and self._behind_swarm():
+        if self.local_step == 0 and self._desynced():
             # discard the stale local phase and adopt the swarm state before
             # burning compute on an epoch the group has moved past
             updated = self.load_state_from_peers(state)
@@ -253,7 +335,9 @@ class DiLoCoOptimizer:
         # (always report at the epoch boundary so matchmaking sees fresh state)
         now = time.monotonic()
         at_boundary = self.local_step >= self.cfg.local_steps
-        if at_boundary or now - getattr(self, "_last_report", 0.0) > 0.5:
+        if self.backend is not None and (
+            at_boundary or now - getattr(self, "_last_report", 0.0) > 0.5
+        ):
             self._last_report = now
             elapsed = max(now - self._epoch_t0, 1e-6)
             self.backend.report_progress(
@@ -525,10 +609,7 @@ class DiLoCoOptimizer:
                 in_shardings=(sh, sh),
                 out_shardings=sh,
             )
-        delta = jax.device_put(
-            jax.tree.unflatten(self.treedef, delta_flat),
-            self.trainer.state_shardings["params"],
-        )
+        delta = self._leaves_to_device(delta_flat)
         state = dict(state)
         state["params"] = self._apply_delta(state["params"], delta)
         return state
@@ -536,6 +617,73 @@ class DiLoCoOptimizer:
     # ------------------------------------------------------------------
     # outer step (reference: _update_global_epoch, hivemind_diloco.py:570-679)
     # ------------------------------------------------------------------
+
+    def _wan_all_reduce(
+        self,
+        arrays: list[np.ndarray],
+        *,
+        timeout: float,
+        epoch: Optional[int] = None,
+        tag: Optional[str] = None,
+        group_cap: Optional[int] = None,
+    ) -> tuple[list[np.ndarray], int, int]:
+        """The WAN leg of an outer round: ``backend.all_reduce`` on the
+        messenger, then a mesh broadcast of the averaged result to the
+        follower processes — the TPU shape of the reference's
+        post-outer-step fan-out (train_fsdp.py:410-413, NCCL broadcast
+        from each worker's rank 0).
+
+        Returns ``(averaged, group_size, live_peers)``; ``live_peers`` is
+        the swarm's current peer count (the gossip health signal — pair
+        size says nothing about the swarm). Multihost: a collective; every
+        process calls with same-shaped ``arrays`` (follower inputs are
+        shape templates — they computed the identical pseudo-gradient from
+        their replicated master, so the arrays are already in hand). A
+        messenger-side failure is re-broadcast so the whole slice raises
+        in lockstep instead of followers hanging at the fan-out."""
+        kw: dict[str, Any] = {"timeout": timeout}
+        if epoch is not None:
+            kw["epoch"] = epoch
+        if tag is not None:
+            kw["tag"] = tag
+        if group_cap is not None:
+            kw["group_cap"] = group_cap
+        if self.world.process_count == 1:
+            avg, n = self.backend.all_reduce(arrays, **kw)
+            return avg, n, self.backend.num_peers()
+        exc: Optional[BaseException] = None
+        avg, n, live = None, 0, 0
+        if self.world.is_messenger:
+            try:
+                avg, n = self.backend.all_reduce(arrays, **kw)
+                # own the data before the fan-out: the backend's result
+                # views live in pooled buffers the next call reclaims
+                # (np.array COPIES; asarray on an already-f32 view wouldn't)
+                avg = [np.array(a, dtype=np.float32) for a in avg]
+                live = self.backend.num_peers()
+            except BaseException as e:
+                exc = e
+        header = self.world.broadcast_obj(
+            {
+                "err": None if exc is None else f"{type(exc).__name__}: {exc}",
+                "n": n,
+                "live": live,
+            }
+            if self.world.is_messenger
+            else None
+        )
+        if exc is not None:
+            raise exc
+        if header["err"] is not None:
+            raise RuntimeError(
+                f"messenger outer all-reduce failed: {header['err']}"
+            )
+        avg = self.world.broadcast_arrays(
+            avg
+            if self.world.is_messenger
+            else [np.zeros(a.shape, np.float32) for a in arrays]
+        )
+        return avg, int(header["n"]), int(header["live"])
 
     def outer_step(self, state: dict) -> tuple[dict, dict]:
         if self._pending is not None:  # a blocking round supersedes overlap
@@ -582,23 +730,24 @@ class DiLoCoOptimizer:
                 if frag is None
                 else [device_leaves[i] for i in frag]
             )
-            fetch_result.append(
-                [
-                    np.asarray(x, dtype=np.float32)
-                    for x in jax.device_get(src)
-                ]
-            )
+            # multihost: a mesh all-gather — every process's fetcher thread
+            # issues the same collective, and each joins before the fan-out
+            # broadcast below, so the per-process collective order is fixed
+            fetch_result.append(self.world.gather_params(src))
 
         fetcher = threading.Thread(target=_fetch)
         fetcher.start()
-        wait_for_peers(
-            self.backend,
-            target_samples=self.target_samples,
-            own_epoch=self.epoch,
-            strategy=self.cfg.all_reduce_strategy,
-            timeout_waiting_for_peers=self.cfg.timeout_waiting_for_peers,
-            log=log,
-        )
+        if self.world.is_messenger:
+            # followers skip the straggler wait: they have no peer view,
+            # and they re-join the messenger at the fan-out collective
+            wait_for_peers(
+                self.backend,
+                target_samples=self.target_samples,
+                own_epoch=self.epoch,
+                strategy=self.cfg.all_reduce_strategy,
+                timeout_waiting_for_peers=self.cfg.timeout_waiting_for_peers,
+                log=log,
+            )
         wait_s = time.monotonic() - t0
         fetcher.join()
         device_flat = fetch_result[0]
@@ -624,7 +773,7 @@ class DiLoCoOptimizer:
             # per-worker masters from drifting apart while no round ever
             # waits on the whole galaxy
             k = len(self.master)
-            avg, group_size = self.backend.all_reduce(
+            avg, group_size, live_peers = self._wan_all_reduce(
                 self.master + pseudo_grad,
                 timeout=self.cfg.averaging_timeout,
                 tag="gossip",
@@ -635,9 +784,9 @@ class DiLoCoOptimizer:
             averaged = avg[k:]
             # pair size says nothing about the swarm: peer-drop detection
             # (incl. fail_rank_drop) runs on the live-peer count instead
-            self._check_group_size(self.backend.num_peers())
+            self._check_group_size(live_peers)
         else:
-            averaged, group_size = self.backend.all_reduce(
+            averaged, group_size, _ = self._wan_all_reduce(
                 pseudo_grad, timeout=self.cfg.averaging_timeout, epoch=self.epoch
             )
             self._check_group_size(group_size)
@@ -667,7 +816,7 @@ class DiLoCoOptimizer:
         # average_state_every, hivemind_diloco.py:634-638): corrects any
         # drift the lossy pseudo-gradient compression accumulates
         if self._is_state_avg_epoch():
-            averaged_state, n = self.backend.all_reduce(
+            averaged_state, n, _ = self._wan_all_reduce(
                 self.master, timeout=self.cfg.averaging_timeout, tag="state"
             )
             # np.array COPIES: the result views live in a pooled backend
@@ -686,10 +835,7 @@ class DiLoCoOptimizer:
             merged = list(device_leaves)
             for i in frag:
                 merged[i] = self.master[i]
-            state["params"] = jax.device_put(
-                jax.tree.unflatten(self.treedef, merged),
-                self.trainer.state_shardings["params"],
-            )
+            state["params"] = self._leaves_to_device(merged)
         else:
             state = self._write_master_to_device(state)  # [H2D]
 
@@ -711,11 +857,19 @@ class DiLoCoOptimizer:
         self.last_outer_metrics = outer_metrics
         return state, outer_metrics
 
-    def _write_master_to_device(self, state: dict) -> dict:
-        params = jax.tree.unflatten(self.treedef, self.master)
-        state["params"] = jax.device_put(
-            params, self.trainer.state_shardings["params"]
+    def _leaves_to_device(self, leaves: list) -> dict:
+        """Flat host leaves -> sharded device params. Under multihost every
+        process holds identical host values (replicated master discipline)
+        and fills only its addressable shards; live jax.Arrays (streaming
+        fragments' unsynced leaves) pass through untouched."""
+        params = jax.tree.unflatten(self.treedef, leaves)
+        shardings = self.trainer.state_shardings["params"]
+        return jax.tree.map(
+            lambda a, s: self.world.to_global(a, s), params, shardings
         )
+
+    def _write_master_to_device(self, state: dict) -> dict:
+        state["params"] = self._leaves_to_device(self.master)
         return state
 
     # ------------------------------------------------------------------

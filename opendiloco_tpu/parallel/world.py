@@ -1,0 +1,151 @@
+"""Host-level "world messenger" for composing the multihost inner mesh
+with the DiLoCo outer loop.
+
+Reference structure (open_diloco/train_fsdp.py): each DiLoCo worker is a
+multi-GPU machine, but only ``local_rank == 0`` — the *world messenger* —
+joins the WAN fabric (``:183`` elects it, ``:205-212`` builds the DHT on it
+alone) and after every outer step the averaged params fan out to the other
+local ranks over NCCL (``:410-413``). SURVEY §1 calls this split between
+the intra-worker fabric and the inter-worker fabric "the key structural
+fact" of the reference.
+
+TPU-native shape: the inner worker is a whole ``jax.distributed`` slice
+(N processes, one global mesh over ICI/DCN). Exactly one process per
+worker — ``jax.process_index() == 0`` — owns the ``TcpBackend`` and talks
+to the swarm. The follower processes never see the WAN; they meet the
+messenger at two *device-mesh* collectives per outer round:
+
+  1. ``gather_params``: replicate the boundary params over the global mesh
+     (one XLA all-gather) so every process holds the full host copy, and
+  2. ``broadcast_arrays``: fan the averaged pseudo-gradient out from the
+     messenger (a ``psum`` where followers contribute zeros — the jit
+     equivalent of the reference's post-outer-step NCCL broadcast).
+
+Every process then replays the identical, deterministic (elementwise
+numpy) outer update on its own replicated host master, so each writes
+bit-identical values into its addressable shards of the global params —
+no torn state, no model-sized host pickles.
+
+``HostWorld`` is the single-process degenerate case where every op is a
+passthrough; ``DiLoCoOptimizer`` is written against this interface and
+never branches on process topology itself.
+"""
+
+from __future__ import annotations
+
+import pickle
+from typing import Any, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.experimental import multihost_utils
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+class HostWorld:
+    """Single-process world: this process IS the worker. All collectives
+    degenerate to passthroughs; ``DiLoCoOptimizer`` under this world
+    behaves exactly as it did before multihost composition existed."""
+
+    is_messenger: bool = True
+    process_count: int = 1
+
+    def gather_params(self, leaves: Sequence[Any]) -> list[np.ndarray]:
+        """Device leaves -> full float32 host copies (the D2H boundary
+        fetch of the outer loop)."""
+        return [
+            np.asarray(x, dtype=np.float32) for x in jax.device_get(list(leaves))
+        ]
+
+    def broadcast_arrays(self, arrs: list[np.ndarray]) -> list[np.ndarray]:
+        return arrs
+
+    def broadcast_obj(self, obj: Any) -> Any:
+        return obj
+
+    def to_global(self, host_arr: np.ndarray, sharding) -> jax.Array:
+        """Host array -> device array under ``sharding`` (the H2D master
+        write-back). Live jax.Arrays pass through untouched (streaming
+        fragments re-use unsynced device leaves as-is)."""
+        if isinstance(host_arr, jax.Array):
+            return host_arr
+        return jax.device_put(host_arr, sharding)
+
+
+class MeshWorld(HostWorld):
+    """Multihost world over the trainer's global mesh.
+
+    All methods are *mesh collectives*: every process of the slice must
+    call them in the same order (the DiLoCo outer loop runs in lockstep on
+    every process — same config, same step counts — so the order is
+    structural, not coordinated).
+    """
+
+    def __init__(self, mesh: jax.sharding.Mesh):
+        self.mesh = mesh
+        self.is_messenger = jax.process_index() == 0
+        self.process_count = jax.process_count()
+        self._replicate = jax.jit(
+            lambda xs: xs, out_shardings=NamedSharding(mesh, P())
+        )
+
+    def gather_params(self, leaves: Sequence[Any]) -> list[np.ndarray]:
+        """Replicate the (sharded, global) leaves over the mesh — one XLA
+        all-gather riding ICI/DCN — then read this process's now-complete
+        local copy. Transient memory: one full replica per device, the
+        same spike the reference pays for its rank-0 FSDP state gather."""
+        full = self._replicate(list(leaves))
+        return [
+            np.asarray(x.addressable_data(0), dtype=np.float32) for x in full
+        ]
+
+    def broadcast_arrays(self, arrs: list[np.ndarray]) -> list[np.ndarray]:
+        """Messenger's arrays -> every process (followers' inputs are used
+        for shape/dtype only; they contribute zeros to the psum)."""
+        out = multihost_utils.broadcast_one_to_all(
+            [np.asarray(a) for a in arrs], is_source=self.is_messenger
+        )
+        return [np.asarray(a) for a in out]
+
+    def broadcast_obj(self, obj: Any) -> Any:
+        """Small control-plane values (flags, group sizes, error strings)
+        from the messenger. Two tiny collectives (length, then payload) so
+        follower processes never need to know the pickled size up front.
+        NOT for model-sized state — use ``broadcast_arrays``."""
+        if self.is_messenger:
+            payload = np.frombuffer(
+                pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL), np.uint8
+            )
+        else:
+            payload = np.zeros(0, np.uint8)
+        n = int(
+            multihost_utils.broadcast_one_to_all(
+                np.int64(payload.size), is_source=self.is_messenger
+            )
+        )
+        if not self.is_messenger:
+            payload = np.zeros(n, np.uint8)
+        payload = multihost_utils.broadcast_one_to_all(
+            payload, is_source=self.is_messenger
+        )
+        return pickle.loads(np.asarray(payload).tobytes())
+
+    def to_global(self, host_arr, sharding) -> jax.Array:
+        if isinstance(host_arr, jax.Array):
+            return host_arr
+        a = np.asarray(host_arr)
+        # every process holds the identical full value (masters are
+        # replicated + updated deterministically); each fills only its
+        # addressable shards
+        return jax.make_array_from_callback(
+            a.shape, sharding, lambda idx: a[idx], dtype=a.dtype
+        )
+
+
+def make_world(mesh: Optional[jax.sharding.Mesh] = None) -> HostWorld:
+    """The right world for the current process topology."""
+    if jax.process_count() > 1:
+        if mesh is None:
+            raise ValueError("multihost worlds need the global mesh")
+        return MeshWorld(mesh)
+    return HostWorld()

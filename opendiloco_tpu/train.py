@@ -35,6 +35,7 @@ from opendiloco_tpu.diloco.optimizer import DiLoCoOptimizer, PeerDropError
 from opendiloco_tpu.models import hf_io
 from opendiloco_tpu.models.llama import init_params
 from opendiloco_tpu.parallel.mesh import build_mesh
+from opendiloco_tpu.parallel.world import make_world
 from opendiloco_tpu.trainer import InnerTrainer, TrainerConfig
 from opendiloco_tpu.utils.logger import get_logger, get_text_logger
 
@@ -156,11 +157,20 @@ def train(config: Config, backend: Optional[OuterBackend] = None) -> dict:
     diloco_opt: Optional[DiLoCoOptimizer] = None
     owns_backend = False
     if config.diloco is not None:
-        if backend is None:
+        # world-messenger split (reference train_fsdp.py:183,205-212): in a
+        # multihost slice only process 0 joins the WAN fabric; the other
+        # processes run the same outer loop against mesh collectives
+        world = make_world(plan.mesh)
+        if backend is None and world.is_messenger:
             backend = make_backend(config.diloco)
             owns_backend = True
         diloco_opt = DiLoCoOptimizer(
-            trainer, backend, config.diloco, state, batch_size=config.total_batch_size
+            trainer,
+            backend,
+            config.diloco,
+            state,
+            batch_size=config.total_batch_size,
+            world=world,
         )
 
     # resume (ckpt_utils.py:23-45 + train_fsdp.py:313-344)

@@ -436,6 +436,166 @@ def test_multihost_two_process_train_and_resume(tmp_path):
 
 
 @pytest.mark.slow
+def test_multihost_diloco_compose_hybrid(tmp_path):
+    """The reference's flagship topology, composed (train_fsdp.py:183
+    messenger election, :205-212 messenger-only DHT join, :410-413
+    post-outer-step fan-out; SURVEY §1 "key structural fact"): each DiLoCo
+    worker is a 2-process jax.distributed slice over a HYBRID dp=2 x fsdp=2
+    mesh, and only process 0 of each slice joins the WAN fabric. Two such
+    workers train over a real rendezvous + TCP butterfly. Oracles:
+      - exactly one registered peer per worker (outer group size 2, not 4)
+      - the loss trajectory matches the identical run with single-process
+        workers (4 local devices each): the intra-worker topology is
+        numerically invisible to the algorithm
+      - bit-exact resume from the mid-run checkpoint on the hybrid
+        multihost mesh (VERDICT r4 #8 folded in)
+    """
+    import socket
+
+    def free_port():
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    daemon, addr = spawn_rendezvous_daemon()
+    STEPS, LOCAL = 8, 4
+
+    def worker_args(rank, logf, ckpt_dir):
+        return [
+            "--path-model", "2m", "--fake-data",
+            "--seq-length", "64",
+            "--per-device-train-batch-size", "4",
+            "--total-batch-size", "16",
+            "--lr", "1e-3", "--warmup-steps", "2",
+            "--total-steps", str(STEPS),
+            "--precision", "fp32",
+            "--sharding-strategy", "HYBRID_SHARD",
+            "--dp-size", "2", "--fsdp-size", "2",
+            "--metric-logger-type", "dummy", "--project", str(logf),
+            "--ckpt.path", str(ckpt_dir), "--ckpt.interval", str(LOCAL),
+            "--diloco.local-steps", str(LOCAL),
+            "--diloco.initial-peers", addr,
+            "--diloco.world-rank", str(rank),
+            "--diloco.galaxy-size", "2",
+            "--diloco.backend", "tcp",
+            "--diloco.skip-load-from-peers",
+            "--diloco.matchmaking-time", "2.0",
+            "--diloco.averaging-timeout", "120",
+        ]
+
+    def launch_slice_proc(rank, pid, coord_port, logf, ckpt_dir, extra):
+        env = dict(os.environ)
+        env["OPENDILOCO_TPU_PLATFORM"] = "cpu"
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        args = worker_args(rank, logf, ckpt_dir) + [
+            "--multihost",
+            "--coordinator-address", f"127.0.0.1:{coord_port}",
+            "--num-processes", "2", "--process-id", str(pid),
+        ] + extra
+        return subprocess.Popen(
+            [sys.executable, "-m", "opendiloco_tpu.train", *args],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env, cwd=REPO,
+        )
+
+    def run_all(procs, timeout=1800):
+        try:
+            outs = [p.communicate(timeout=timeout)[0] for p in procs]
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+        assert all(p.returncode == 0 for p in procs), "\n".join(
+            o[-2000:] for o in outs
+        )
+        return outs
+
+    try:
+        # --- composed arm: 2 workers x 2 processes ---------------------
+        coords = [free_port(), free_port()]
+        run_all(
+            [
+                launch_slice_proc(
+                    r, p, coords[r],
+                    tmp_path / f"mh_w{r}_p{p}.pkl", tmp_path / "ckpts", [],
+                )
+                for r in range(2)
+                for p in range(2)
+            ]
+        )
+
+        # --- reference arm: same run, single-process workers -----------
+        ref = [
+            spawn_worker(
+                worker_args(r, tmp_path / f"ref_w{r}.pkl", tmp_path / "ckpts_ref")
+            )
+            for r in range(2)
+        ]
+        for p in ref:
+            out, err = p.communicate(timeout=1800)
+            assert p.returncode == 0, (out or "")[-2000:] + (err or "")[-2000:]
+    finally:
+        daemon.kill()
+
+    for r in range(2):
+        mh = read_metrics(tmp_path / f"mh_w{r}_p0.pkl")
+        assert len(mh) == STEPS
+        # one registered peer per WORKER: the outer group reaches 2 and
+        # NEVER exceeds it (per-host duplicate registration would read 4);
+        # early rows legitimately report 1 until the first round lands
+        peers_seen = [m["num_peers"] for m in mh if "num_peers" in m]
+        assert peers_seen and max(peers_seen) == 2, peers_seen
+        assert mh[-1]["num_peers"] == 2, peers_seen
+        # both slice processes observed the identical trajectory
+        mh_p1 = read_metrics(tmp_path / f"mh_w{r}_p1.pkl")
+        for a, b in zip(mh, mh_p1):
+            assert a["Loss"] == b["Loss"], (a, b)
+        # composition is numerically invisible vs single-process workers
+        by_step_ref = {
+            m["step"]: m for m in read_metrics(tmp_path / f"ref_w{r}.pkl")
+        }
+        for m in mh:
+            np.testing.assert_allclose(
+                m["Loss"], by_step_ref[m["step"]]["Loss"], atol=1e-4
+            )
+            assert m["lr"] == by_step_ref[m["step"]]["lr"]
+
+    # --- resume arm: bit-exact restart of the whole composed topology --
+    daemon2, addr2 = spawn_rendezvous_daemon()
+    addr = addr2  # worker_args closes over `addr`
+    resume_dir = str(tmp_path / "ckpts" / f"model_step_{LOCAL}")
+    try:
+        coords = [free_port(), free_port()]
+        run_all(
+            [
+                launch_slice_proc(
+                    r, p, coords[r],
+                    tmp_path / f"res_w{r}_p{p}.pkl", tmp_path / "ckpts",
+                    ["--ckpt.resume", resume_dir],
+                )
+                for r in range(2)
+                for p in range(2)
+            ]
+        )
+    finally:
+        daemon2.kill()
+
+    for r in range(2):
+        full = {
+            m["step"]: m
+            for m in read_metrics(tmp_path / f"mh_w{r}_p0.pkl")
+        }
+        res = read_metrics(tmp_path / f"res_w{r}_p0.pkl")
+        assert res and res[0]["step"] == LOCAL + 1
+        for m in res:
+            np.testing.assert_allclose(
+                m["Loss"], full[m["step"]]["Loss"], atol=1e-4
+            )
+            assert m["lr"] == full[m["step"]]["lr"]
+
+
+@pytest.mark.slow
 def test_rendezvous_sigkill_failover_training_completes(tmp_path):
     """Chaos probe for the control plane: two rendezvous daemons, two TCP
     workers; the daemon the swarm is using is SIGKILLed mid-run. Both
