@@ -85,8 +85,10 @@ def main():
         return InnerTrainer(cfg, tc, build_mesh("NO_SHARD"))
 
     # --- 2-worker DiLoCo over loopback, threads like the oracle test ----
-    def run_diloco_pair(streaming_fragments: int):
-        """Returns (per-worker losses, worker-0 final params, wall_s)."""
+    def run_diloco_pair(streaming_fragments: int, **cfg_overrides):
+        """Returns (per-worker losses, worker-0 final params, wall_s).
+        ``cfg_overrides`` select the outer-mode arm (gossip / overlap-comm);
+        every arm shares the data stream, init, and held-out eval."""
         world = LoopbackWorld(2)
         backends = world.make_backends()
         losses = [[], []]
@@ -107,6 +109,7 @@ def main():
                         timeout_waiting_for_peers=120.0,
                         averaging_timeout=300.0,
                         streaming_fragments=streaming_fragments,
+                        **cfg_overrides,
                     ),
                     state,
                     batch_size=BS,
@@ -118,6 +121,9 @@ def main():
                         state, trainer.shard_batch(ids, labels, accum=1)
                     )
                     losses[rank].append(round(float(m["loss"]), 5))
+                # overlapped arms may end with a round in flight; the
+                # harvested params must include it
+                state = opt.flush(state)
                 params[rank] = jax.device_get(state["params"])
             except Exception as e:  # pragma: no cover - banked as evidence
                 errors.append(f"worker {rank}: {e!r}")
@@ -202,6 +208,36 @@ def main():
         f"{doc['eval']['streaming_w0']:.4f} "
         f"(ratio vs ddp {doc['eval']['streaming_ratio']})"
     )
+
+    # beyond-ref outer modes (VERDICT r4 ask #5): gossip pairing
+    # (arxiv 2506.10911) and overlapped communication, delayed + eager
+    # (arxiv 2502.12996). These shipped with identity oracles only; the
+    # missing evidence is a multi-round loss curve within the DiLoCo band.
+    for arm, overrides in (
+        ("gossip", {"outer_mode": "gossip"}),
+        ("overlap_delayed", {"overlap_comm": "delayed"}),
+        ("overlap_eager", {"overlap_comm": "eager"}),
+    ):
+        try:
+            arm_l, arm_p0, doc[f"{arm}_wall_s"] = run_diloco_pair(0, **overrides)
+        except SystemExit as e:
+            # a failed additive arm must not take down the banked core
+            # artifact or the remaining arms
+            doc.setdefault("arm_errors", {})[arm] = str(e)
+            doc.pop("error", None)
+            _flush(doc)
+            continue
+        doc[f"{arm}_losses"] = arm_l[0]
+        doc["eval"][f"{arm}_w0"] = round(held_out(arm_p0), 5)
+        doc["eval"][f"{arm}_ratio"] = (
+            round(doc["eval"][f"{arm}_w0"] / ev["ddp"], 5) if ev["ddp"] else None
+        )
+        doc["ts_end"] = time.time()
+        _flush(doc)
+        print(
+            f"CONVERGENCE {arm} arm: {doc['eval'][f'{arm}_w0']:.4f} "
+            f"(ratio vs ddp {doc['eval'][f'{arm}_ratio']})"
+        )
 
 
 if __name__ == "__main__":
