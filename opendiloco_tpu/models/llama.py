@@ -20,6 +20,7 @@ from __future__ import annotations
 import dataclasses
 import json
 import math
+import os
 from typing import Any, Literal, Optional, Union
 
 import jax
@@ -385,7 +386,15 @@ def forward(
             cfg, attn_fn, h, layer, positions, rope
         )
         block = _maybe_remat(block, remat)
-        h, (attn_norms, layer_auxs) = jax.lax.scan(block, h, cparams["layers"])
+        # ODTP_SCAN_UNROLL=N unrolls the layer scan N-wide (N >= num layers
+        # removes the while loop entirely). Two uses: an XLA scheduling
+        # experiment, and scripts/aot_roofline.py -- cost analysis counts a
+        # while-loop body ONCE, so per-layer FLOPs/bytes only become visible
+        # to the compiled-HLO cost model when the stack is unrolled.
+        unroll = int(os.environ.get("ODTP_SCAN_UNROLL", "1") or "1")
+        h, (attn_norms, layer_auxs) = jax.lax.scan(
+            block, h, cparams["layers"], unroll=max(1, unroll)
+        )
         moe_aux = jnp.mean(layer_auxs)
 
     h = _rms_norm(h, cparams["final_norm"], cfg.rms_norm_eps)

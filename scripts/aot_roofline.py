@@ -1,0 +1,225 @@
+"""Offline AOT roofline: bound the remat/batch perf levers without a TPU.
+
+The tunnel to the one real chip dies for hours (TUNNEL_LOG_r04.log: 555
+probes, 0 alive), so the remat=true|dots|false and batch-size levers coded
+into bench.py have never produced a measured row. This script compiles the
+REAL training step — the same ``InnerTrainer._train_step`` bench.py times —
+deviceless for a v5e target via ``jax.experimental.topologies`` (PJRT
+topology AOT), and reads the compiled executable's own cost model:
+
+  - executed FLOPs (includes remat recompute) and HBM bytes accessed
+    from ``compiled.cost_analysis()``
+  - peak memory footprint from ``compiled.memory_analysis()`` (does the
+    variant even fit a 16 GiB chip?)
+  - a roofline step-time bound  t >= max(flops/peak_mxu, bytes/peak_bw)
+    and the predicted-MFU ceiling  model_flops / (t * peak_mxu)
+
+These are CEILINGS from XLA's cost model at nominal peak rates (197 bf16
+TFLOP/s, 819 GB/s HBM for v5e-1), not measurements — but they are
+machine-generated from the compiled HLO for the exact bench shapes, which
+turns "levers coded" into "levers bounded": they rank the variants and say
+which are compute- vs bandwidth-limited and which OOM, so live tunnel
+minutes go to the predicted winner first.
+
+Writes AOT_ROOFLINE.json (incrementally — a crash keeps finished rows).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+import traceback
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+OUT = os.path.join(REPO, "AOT_ROOFLINE.json")
+
+V5E_PEAK_FLOPS = 197e12  # bf16 MXU peak, one v5e chip
+V5E_HBM_BW = 819e9  # bytes/s
+V5E_HBM_BYTES = 16 * 1024**3
+
+
+def build_rows():
+    rows = []
+    # (model, seq, per-chip bs, accum, remat) — bench.py's exact shapes
+    # (150m: seq 1024 bs 16; 1b: bs 4 x accum 4) plus the batch levers the
+    # sweep would try on hardware
+    for model, seq, shapes in (
+        ("150m", 1024, [(16, 1), (32, 1)]),
+        ("1b", 1024, [(4, 4), (8, 2)]),
+    ):
+        for bs, accum in shapes:
+            for remat in (True, "dots", False):
+                rows.append((model, seq, bs, accum, remat))
+    return rows
+
+
+def flush(doc):
+    tmp = OUT + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, indent=1)
+    os.replace(tmp, OUT)
+
+
+def main():
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    # unroll the layer scan so the compiled HLO exposes EVERY layer's
+    # FLOPs/bytes to cost_analysis (a while-loop body is counted once;
+    # with the scan in place the 150m step reported 12x fewer FLOPs than
+    # the analytic count). 64 covers every zoo config's depth.
+    os.environ["ODTP_SCAN_UNROLL"] = "64"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    from jax.experimental import topologies
+
+    from bench import model_flops_per_token  # the one MFU accounting
+    from opendiloco_tpu.models.hf_io import get_model
+    from opendiloco_tpu.parallel.mesh import build_mesh
+    from opendiloco_tpu.trainer import InnerTrainer, TrainerConfig
+
+    doc = {
+        "device": "v5e (deviceless PJRT topology AOT)",
+        "peak_flops": V5E_PEAK_FLOPS,
+        "hbm_bw": V5E_HBM_BW,
+        "hbm_bytes": V5E_HBM_BYTES,
+        "generated": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "note": (
+            "roofline CEILINGS from the compiled HLO's cost model at nominal "
+            "peak rates, not measurements; ranks the bench.py variants and "
+            "flags OOM so live tunnel minutes go to the predicted winner"
+        ),
+        "rows": [],
+    }
+    try:
+        topo = topologies.get_topology_desc(platform="tpu", topology_name="v5e:2x2")
+    except Exception as e:
+        doc["error"] = f"topology unavailable: {type(e).__name__}: {e}"
+        flush(doc)
+        raise SystemExit(doc["error"])
+    devices = list(topo.devices)[:1]  # single-chip bench shape
+
+    cfg_cache = {}
+    for model, seq, bs, accum, remat in build_rows():
+        name = f"{model} seq{seq} bs{bs} accum{accum} remat={remat}"
+        t0 = time.time()
+        row = {
+            "model": model,
+            "seq": seq,
+            "per_chip_batch": bs,
+            "accum": accum,
+            "remat": str(remat),
+            "attn": "pallas+fused",
+        }
+        try:
+            if model not in cfg_cache:
+                cfg_cache[model] = get_model(model)[0]
+            cfg = cfg_cache[model]
+            tc = TrainerConfig(
+                lr=4e-4, warmup_steps=10, total_steps=1000,
+                precision="bf16-mixed", attn_impl="pallas", remat=remat,
+                fused_loss=True,
+            )
+            assert bs % accum == 0, (bs, accum)
+
+            def compile_step():
+                # fresh trainer per compile: jit caches lowerings, and the
+                # two compiles here must see different ODTP_SCAN_UNROLL
+                trainer = InnerTrainer(
+                    cfg, tc, build_mesh("NO_SHARD", devices=devices)
+                )
+                state_sds = jax.tree.map(
+                    lambda s, sh: jax.ShapeDtypeStruct(
+                        s.shape, s.dtype, sharding=sh
+                    ),
+                    jax.eval_shape(trainer.init_state, jax.random.key(0)),
+                    trainer.state_shardings,
+                )
+                bsh = trainer.plan.sharding(trainer.plan.batch_spec(3, accum=True))
+                batch_sds = {
+                    k: jax.ShapeDtypeStruct(
+                        (accum, bs // accum, seq), np.int32, sharding=bsh
+                    )
+                    for k in ("input_ids", "labels")
+                }
+                return trainer._train_step.lower(state_sds, batch_sds).compile()
+
+            # memory footprint from the program that actually runs (layer
+            # scan in place); FLOPs/bytes from the unrolled build, where
+            # cost_analysis sees every layer instead of one loop body
+            os.environ["ODTP_SCAN_UNROLL"] = "1"
+            mem = compile_step().memory_analysis()
+            os.environ["ODTP_SCAN_UNROLL"] = "64"
+            ca = compile_step().cost_analysis()
+
+            flops = float(ca.get("flops", 0.0))
+            byts = float(ca.get("bytes accessed", 0.0))
+            tokens = bs * seq
+            model_flops = model_flops_per_token(cfg, seq) * tokens
+            t_compute = flops / V5E_PEAK_FLOPS
+            t_mem = byts / V5E_HBM_BW
+            t_pred = max(t_compute, t_mem)
+            peak_bytes = (
+                mem.argument_size_in_bytes
+                + mem.output_size_in_bytes
+                + mem.temp_size_in_bytes
+                - mem.alias_size_in_bytes
+            )
+            row.update(
+                tokens_per_step=tokens,
+                executed_flops=flops,
+                model_flops=model_flops,
+                recompute_factor=round(flops / model_flops, 3) if model_flops else None,
+                bytes_accessed=byts,
+                t_compute_s=round(t_compute, 6),
+                t_mem_s=round(t_mem, 6),
+                bound="compute" if t_compute >= t_mem else "memory",
+                predicted_tokens_per_s=round(tokens / t_pred, 1),
+                predicted_mfu_ceiling=round(
+                    model_flops / (t_pred * V5E_PEAK_FLOPS), 4
+                ),
+                peak_memory_bytes=int(peak_bytes),
+                fits_hbm=bool(peak_bytes < 0.95 * V5E_HBM_BYTES),
+                temp_bytes=int(mem.temp_size_in_bytes),
+                compile_s=round(time.time() - t0, 1),
+            )
+            print(
+                f"{name}: mfu_ceiling={row['predicted_mfu_ceiling']} "
+                f"bound={row['bound']} fits={row['fits_hbm']} "
+                f"recompute={row['recompute_factor']}",
+                flush=True,
+            )
+        except Exception as e:
+            msg = f"{type(e).__name__}: {str(e)[:400]}"
+            if "RESOURCE_EXHAUSTED" in msg:
+                # a first-class result, not a failure: this variant cannot
+                # run on a 16 GiB chip -- don't burn tunnel minutes on it
+                row["fits_hbm"] = False
+                row["oom"] = msg
+                print(f"{name}: does NOT fit HBM", flush=True)
+            else:
+                row["error"] = msg
+                print(f"{name}: FAILED {msg}", flush=True)
+                traceback.print_exc()
+        doc["rows"].append(row)
+        flush(doc)
+
+    ok = [r for r in doc["rows"] if r.get("fits_hbm")]
+    if ok:
+        best = max(ok, key=lambda r: r["predicted_mfu_ceiling"])
+        doc["predicted_best"] = {
+            k: best[k]
+            for k in (
+                "model", "per_chip_batch", "accum", "remat",
+                "predicted_mfu_ceiling", "bound",
+            )
+        }
+    flush(doc)
+    print("wrote", OUT, flush=True)
+
+
+if __name__ == "__main__":
+    main()
