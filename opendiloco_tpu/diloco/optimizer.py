@@ -63,13 +63,6 @@ class DiLoCoOptimizer:
         if self.world.is_messenger and backend is None:
             raise ValueError("the world-messenger process needs a backend")
         self.backend = backend if self.world.is_messenger else None
-        if self.world.process_count > 1 and cfg.overlap_comm != "none":
-            raise ValueError(
-                "overlap-comm under --multihost is not supported: whether an "
-                "in-flight round has landed is a host-local fact, and acting "
-                "on it would desync the slice's collective order; run "
-                "overlap-comm none"
-            )
         self.cfg = cfg
         self.batch_size = batch_size
         self.target_samples = batch_size * cfg.local_steps
@@ -396,32 +389,42 @@ class DiLoCoOptimizer:
         self._drain_abandoned()
 
         # overlap the boundary D2H with the straggler wait (same trick as
-        # the blocking path): params are final at the boundary
+        # the blocking path): params are final at the boundary. Multihost:
+        # the gather is a mesh collective issued by every process's fetcher
+        # thread; the WAN launch below is messenger-only.
         fetch_result: list = []
 
         def _fetch():
             fetch_result.append(
-                [
-                    np.asarray(x, dtype=np.float32)
-                    for x in jax.tree.leaves(jax.device_get(state["params"]))
-                ]
+                self.world.gather_params(jax.tree.leaves(state["params"]))
             )
 
         fetcher = threading.Thread(target=_fetch)
         fetcher.start()
-        wait_for_peers(
-            self.backend,
-            target_samples=self.target_samples,
-            own_epoch=self.epoch,
-            strategy=self.cfg.all_reduce_strategy,
-            timeout_waiting_for_peers=self.cfg.timeout_waiting_for_peers,
-            log=log,
-        )
+        if self.world.is_messenger:
+            wait_for_peers(
+                self.backend,
+                target_samples=self.target_samples,
+                own_epoch=self.epoch,
+                strategy=self.cfg.all_reduce_strategy,
+                timeout_waiting_for_peers=self.cfg.timeout_waiting_for_peers,
+                log=log,
+            )
         wait_s = time.monotonic() - t0
         fetcher.join()
         boundary = fetch_result[0]
         self._pg_slot ^= 1
-        pseudo_grad = self._pseudo_grad_into(boundary, slot=self._pg_slot)
+        # the messenger puts the pseudo-gradient on the wire; in eager mode
+        # every process also computes it (identical, from the replicated
+        # master) for the local estimate below. A delayed-mode follower
+        # needs neither — the landing path works from boundary/master_snap
+        # — so it skips the full-model subtraction AND the two model-sized
+        # slot buffers (~8 GB idle at 1b scale)
+        pseudo_grad = (
+            self._pseudo_grad_into(boundary, slot=self._pg_slot)
+            if self.world.is_messenger or self.cfg.overlap_comm == "eager"
+            else None
+        )
 
         pending: dict[str, Any] = {
             "master_snap": [m.copy() for m in self.master],
@@ -429,7 +432,13 @@ class DiLoCoOptimizer:
             "boundary": boundary,
             "epoch": self.epoch,
             "t_launch": t0,
-            "future": self._spawn_all_reduce(pseudo_grad, self.epoch),
+            # followers carry no future; landing is decided by the
+            # messenger and broadcast (see _poll_pending)
+            "future": (
+                self._spawn_all_reduce(pseudo_grad, self.epoch)
+                if self.world.is_messenger
+                else None
+            ),
         }
 
         if self.cfg.overlap_comm == "eager":
@@ -517,16 +526,83 @@ class DiLoCoOptimizer:
         ).start()
         return fut
 
+    def _messenger_fanout(self, produce, shapes):
+        """THE multihost fan-out protocol (both the blocking and the
+        overlapped outer paths ride it): run ``produce() -> (arrays, meta)``
+        on the messenger, copy the result out of any pooled backend buffers,
+        broadcast a small header first — so a messenger-side failure makes
+        the whole slice raise in lockstep instead of followers hanging at
+        the array fan-out — then broadcast the arrays (followers pass
+        zero templates of ``shapes``). Returns ``(arrays, meta)``."""
+        exc: Optional[BaseException] = None
+        arrays, meta = None, {}
+        if self.world.is_messenger:
+            try:
+                arrays, meta = produce()
+                # own the data before the fan-out: backend results are
+                # views into pooled buffers the next call reclaims
+                # (np.array COPIES; asarray on an f32 view wouldn't)
+                arrays = [np.array(a, dtype=np.float32) for a in arrays]
+            except BaseException as e:
+                exc = e
+        header = self.world.broadcast_obj(
+            {
+                "err": None if exc is None else f"{type(exc).__name__}: {exc}",
+                "meta": meta,
+            }
+            if self.world.is_messenger
+            else None
+        )
+        if exc is not None:
+            raise exc
+        if header["err"] is not None:
+            raise RuntimeError(f"messenger outer round failed: {header['err']}")
+        arrays = self.world.broadcast_arrays(
+            arrays
+            if self.world.is_messenger
+            else [np.zeros(s, np.float32) for s in shapes]
+        )
+        return arrays, header["meta"]
+
+    def _overlap_result(self, pending: dict, *, block: bool):
+        """(averaged, group_size) of an in-flight round. Single-host: the
+        future's result. Multihost: the messenger resolves its future and
+        fans the result out via _messenger_fanout."""
+        fut = pending["future"]
+        timeout = None if not block else self.cfg.averaging_timeout + 60
+        if self.world.process_count == 1:
+            return fut.result(timeout=timeout)
+
+        def produce():
+            avg, n = fut.result(timeout=timeout)
+            return avg, {"n": n}
+
+        avg, meta = self._messenger_fanout(
+            produce, [m.shape for m in pending["master_snap"]]
+        )
+        return avg, int(meta["n"])
+
     def _poll_pending(self, state: dict, *, block: bool) -> dict:
         """Resolve an in-flight outer all-reduce if it completed (or wait
         for it when ``block``); applies the (corrected) outer update as a
-        device delta."""
+        device delta. Multihost: whether the round landed is the
+        messenger's host-local fact, so the verdict rides one tiny
+        collective per poll — every process reaches here in lockstep (the
+        poll sites are all step-count-deterministic)."""
         pending = self._pending
         if pending is None:
             return state
         fut = pending["future"]
-        if not block and not fut.done():
-            return state
+        if not block:
+            done = fut.done() if fut is not None else False
+            if self.world.process_count > 1:
+                done = bool(
+                    self.world.broadcast_obj(
+                        done if self.world.is_messenger else None
+                    )
+                )
+            if not done:
+                return state
         # keep _pending published until the landed master/opt are assigned:
         # the serve thread falls back to the live (still pre-round in the
         # delayed mode) master the moment _pending clears, so clearing
@@ -534,9 +610,7 @@ class DiLoCoOptimizer:
         # for onboarding peers. The finally also clears on failure, where
         # the live state is the correct thing to serve.
         try:
-            avg, group_size = fut.result(
-                timeout=None if not block else self.cfg.averaging_timeout + 60
-            )
+            avg, group_size = self._overlap_result(pending, block=block)
             self._check_group_size(group_size)
 
             master = [m.copy() for m in pending["master_snap"]]
@@ -590,7 +664,7 @@ class DiLoCoOptimizer:
         launch drains it before reusing the round key."""
         if self._pending is not None:
             fut = self._pending["future"]
-            if not fut.cancel():
+            if fut is not None and not fut.cancel():
                 self._abandoned = fut
             self._pending = None
 
@@ -651,39 +725,13 @@ class DiLoCoOptimizer:
         if self.world.process_count == 1:
             avg, n = self.backend.all_reduce(arrays, **kw)
             return avg, n, self.backend.num_peers()
-        exc: Optional[BaseException] = None
-        avg, n, live = None, 0, 0
-        if self.world.is_messenger:
-            try:
-                avg, n = self.backend.all_reduce(arrays, **kw)
-                # own the data before the fan-out: the backend's result
-                # views live in pooled buffers the next call reclaims
-                # (np.array COPIES; asarray on an already-f32 view wouldn't)
-                avg = [np.array(a, dtype=np.float32) for a in avg]
-                live = self.backend.num_peers()
-            except BaseException as e:
-                exc = e
-        header = self.world.broadcast_obj(
-            {
-                "err": None if exc is None else f"{type(exc).__name__}: {exc}",
-                "n": n,
-                "live": live,
-            }
-            if self.world.is_messenger
-            else None
-        )
-        if exc is not None:
-            raise exc
-        if header["err"] is not None:
-            raise RuntimeError(
-                f"messenger outer all-reduce failed: {header['err']}"
-            )
-        avg = self.world.broadcast_arrays(
-            avg
-            if self.world.is_messenger
-            else [np.zeros(a.shape, np.float32) for a in arrays]
-        )
-        return avg, int(header["n"]), int(header["live"])
+
+        def produce():
+            avg, n = self.backend.all_reduce(arrays, **kw)
+            return avg, {"n": n, "live": self.backend.num_peers()}
+
+        avg, meta = self._messenger_fanout(produce, [a.shape for a in arrays])
+        return avg, int(meta["n"]), int(meta["live"])
 
     def outer_step(self, state: dict) -> tuple[dict, dict]:
         if self._pending is not None:  # a blocking round supersedes overlap

@@ -594,6 +594,37 @@ def test_multihost_diloco_compose_hybrid(tmp_path):
             )
             assert m["lr"] == full[m["step"]]["lr"]
 
+    # --- overlap arm: overlapped outer comm across the slice ------------
+    # the landing step is timing-dependent by design, so no cross-topology
+    # loss oracle; the invariants are lockstep within the slice (p0 == p1
+    # at every step), one peer per worker, and a finite trained loss
+    daemon3, addr3 = spawn_rendezvous_daemon()
+    addr = addr3
+    try:
+        coords = [free_port(), free_port()]
+        run_all(
+            [
+                launch_slice_proc(
+                    r, p, coords[r],
+                    tmp_path / f"ov_w{r}_p{p}.pkl", tmp_path / "ckpts_ov",
+                    ["--diloco.overlap-comm", "delayed"],
+                )
+                for r in range(2)
+                for p in range(2)
+            ]
+        )
+    finally:
+        daemon3.kill()
+    for r in range(2):
+        ov = read_metrics(tmp_path / f"ov_w{r}_p0.pkl")
+        ov_p1 = read_metrics(tmp_path / f"ov_w{r}_p1.pkl")
+        assert len(ov) == STEPS
+        for a, b in zip(ov, ov_p1):
+            assert a["Loss"] == b["Loss"], (a, b)
+        peers_seen = [m["num_peers"] for m in ov if "num_peers" in m]
+        assert peers_seen and max(peers_seen) == 2, peers_seen
+        assert np.isfinite(ov[-1]["Loss"]) and ov[-1]["Loss"] < 7.0
+
 
 @pytest.mark.slow
 def test_rendezvous_sigkill_failover_training_completes(tmp_path):
