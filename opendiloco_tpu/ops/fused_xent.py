@@ -34,6 +34,38 @@ def _pick(n: int, pref: int) -> int:
     return 0
 
 
+# per-kernel VMEM budget. The default scoped window (~16 MB) fits the
+# d=768 kernels but every staged tile scales with d, and at 1b's d=2048
+# the dh kernel died allocating its output tile on the VMEM stack —
+# caught by the deviceless AOT compile (AOT_ROOFLINE, round 5) before
+# any hardware run could. v5e has 128 MB of VMEM; claim most of it (all
+# three pallas_calls pass vmem_limit_bytes) and only shrink blocks when
+# the estimate below still doesn't fit, so the MXU keeps wide tiles.
+_VMEM_BUDGET = 100 * 1024 * 1024
+
+
+def _vmem_caps(d: int) -> tuple[int, int]:
+    """(token-block cap, vocab-block cap) for hidden size ``d``.
+
+    Sized against the dw kernel, the hungriest of the three: double-
+    buffered (bn, d) + (d, bv) bf16 operand tiles, f32 (d, bv) scratch
+    accumulator + output tile, and f32 (bn, bv) score/dlog tiles. Caps
+    halve (powers of two only, so ``min(block, cap)`` keeps divisibility
+    into n/v) until that estimate fits _VMEM_BUDGET. d=768 (150m) and
+    d=2048 (1b) both keep the full 1024/2048 blocks (~39 MB / ~75 MB);
+    d=4096 drops the vocab block to 1024."""
+
+    def dw_bytes(bn: int, bv: int) -> int:
+        return 2 * bn * d * 2 + 2 * d * bv * 2 + 2 * d * bv * 4 + 2 * bn * bv * 4
+
+    bn, bv = 1024, 2048
+    while bv > 512 and dw_bytes(bn, bv) > _VMEM_BUDGET:
+        bv //= 2
+    while bn > 128 and dw_bytes(bn, bv) > _VMEM_BUDGET:
+        bn //= 2
+    return bn, bv
+
+
 def _mask_pad(s, j: int, block_v: int, true_v: int):
     """-inf out vocab-pad columns (tile j of a padded head)."""
     gcols = j * block_v + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
@@ -118,6 +150,9 @@ def _fwd(h, w, labels, block_n, block_v, true_v):
             pltpu.VMEM((block_n, 1), jnp.float32),
             pltpu.VMEM((block_n, 1), jnp.float32),
         ],
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=_VMEM_BUDGET,
+        ),
     )(h, w, labels.reshape(1, n))
     return nll.reshape(n), lse.reshape(n)
 
@@ -224,6 +259,9 @@ def _bwd_impl(h, w, labels, lse, g, block_n, block_v, true_v):
         out_specs=pl.BlockSpec((block_n, d), lambda i, j: (i, 0)),
         out_shape=jax.ShapeDtypeStruct((n, d), h.dtype),
         scratch_shapes=[pltpu.VMEM((block_n, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=_VMEM_BUDGET,
+        ),
     )(*args)
     dw = pl.pallas_call(
         functools.partial(_dw_kernel, block_v=block_v, true_v=true_v),
@@ -238,6 +276,9 @@ def _bwd_impl(h, w, labels, lse, g, block_n, block_v, true_v):
         out_specs=pl.BlockSpec((d, block_v), lambda j, i: (0, j)),
         out_shape=jax.ShapeDtypeStruct((d, v), jnp.float32),
         scratch_shapes=[pltpu.VMEM((d, block_v), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=_VMEM_BUDGET,
+        ),
     )(*args)
     return dh, dw
 
@@ -261,11 +302,11 @@ def _fused_fwd(h, w, labels, block_n, block_v, true_v):
 def _fused_bwd(block_n, block_v, true_v, res, g):
     h, w, labels, lse = res
     mask = (labels != IGNORE).astype(jnp.float32)
-    # the backward kernels carry [block_n, d] / [d, block_v] f32 scratch
-    # plus f32 score/prob tiles; block_n=1024 exceeds the 16MB scoped-vmem
-    # budget, so cap the token block (n is a multiple of 512 whenever
-    # block_n >= 512 was picked)
-    bn = min(block_n, 512)
+    # the backward kernels carry the f32 accumulator scratch on top of the
+    # forward's tiles; halve the token block (empirically chosen at d=768,
+    # kept proportionally across sizes — a halved power-of-two cap always
+    # divides the forward's pick)
+    bn = min(block_n, max(128, _vmem_caps(h.shape[1])[0] // 2))
     dh, dw = _bwd_impl(h, w, labels, lse, g * mask, bn, block_v, true_v)
     return dh.astype(h.dtype), dw.astype(w.dtype), None
 
@@ -294,7 +335,8 @@ def fused_linear_cross_entropy(
         safe = jnp.where(mask, labels, 0)
         nll = -jnp.take_along_axis(lp, safe[:, None], axis=1)[:, 0] * mask
         return jnp.sum(nll) / count
-    block_n = _pick(n, 1024)
+    bn_cap, bv_cap = _vmem_caps(d)
+    block_n = _pick(n, bn_cap)
     if block_n == 0:
         # token count doesn't tile (e.g. the causal shift gives B*(T-1));
         # pad rows up to the next 128 multiple with IGNORE labels -- they
@@ -304,14 +346,15 @@ def fused_linear_cross_entropy(
         h = jnp.pad(h, ((0, n_pad - n), (0, 0)))
         labels = jnp.pad(labels, (0, n_pad - n), constant_values=IGNORE)
         n = n_pad
-        block_n = _pick(n, 1024)  # nonzero: n is a multiple of 128
-    block_v = _pick(v, 2048)
+        block_n = _pick(n, bn_cap)  # nonzero: n is a multiple of 128
+    block_v = _pick(v, bv_cap)
     if block_v < 512:
         # pad the head to the smallest wide tile (least dead columns);
         # padded logits are masked to -inf in the kernels (a small pad
         # copy beats 128-wide MXU tiles)
         block_v = min(
-            (b for b in (512, 1024, 2048)), key=lambda b: -(-v // b) * b
+            (b for b in (512, 1024, 2048) if b <= bv_cap),
+            key=lambda b: -(-v // b) * b,
         )
         v_pad = -(-v // block_v) * block_v
         w_in = jnp.pad(w, ((0, 0), (0, v_pad - v)))

@@ -90,7 +90,12 @@ def main():
         "note": (
             "roofline CEILINGS from the compiled HLO's cost model at nominal "
             "peak rates, not measurements; ranks the bench.py variants and "
-            "flags OOM so live tunnel minutes go to the predicted winner"
+            "flags OOM so live tunnel minutes go to the predicted winner. "
+            "Caveat: the unrolled build used for cost_analysis lets XLA CSE "
+            "part of the remat recompute (recompute_factor < 1 means the "
+            "counted FLOPs approximate the no-remat ideal); the memory "
+            "verdicts come from the looped build that actually runs, so "
+            "fits_hbm/oom are faithful"
         ),
         "rows": [],
     }
