@@ -7,13 +7,16 @@ north-star is >=40% inner-loop MFU on llama-150m (BASELINE.json). We report
 tokens/sec/chip and vs_baseline = achieved_MFU / 0.40.
 
 Sweeps perf variants -- the measured-best pallas+fused first (hits the
-persistent compile cache, banks a nonzero number early), then the remat
-policies (False/"dots" trade memory for recompute FLOPs), then the XLA
-baseline for the comparison row -- and reports the fastest; a wedged
-accelerator or a variant that fails to compile loses that variant, not the
-whole bench. Pin a single variant with OPENDILOCO_TPU_BENCH_ATTN /
-OPENDILOCO_TPU_BENCH_FUSED / OPENDILOCO_TPU_BENCH_REMAT
-(true|false|dots).
+persistent compile cache, banks a nonzero number early), then the
+AOT-roofline pick (bs32 per chip; AOT_ROOFLINE.json predicts the ceiling
+rises 0.578 -> 0.674 there), then remat="dots" and the XLA baseline
+comparison row -- and reports the fastest. remat=False is omitted: the
+AOT memory model proves it exceeds HBM at these shapes. A wedged
+accelerator or a variant that fails to compile loses that variant, not
+the whole bench. Pin a single variant with OPENDILOCO_TPU_BENCH_ATTN /
+OPENDILOCO_TPU_BENCH_FUSED / OPENDILOCO_TPU_BENCH_REMAT (true|false|dots)
+/ OPENDILOCO_TPU_BENCH_BS (per-chip batch); unset pin knobs default to
+the headline pallas+fused config.
 """
 
 import glob
@@ -230,6 +233,7 @@ def _run_variant(
     cfg, attn: str, fused: bool, seq: int, bs: int, accum: int, remat=True,
     n_steps: int = 15,
 ):
+    """One timed variant; bs is the GLOBAL batch (per-chip x chips)."""
     import jax
 
     from opendiloco_tpu.parallel.mesh import build_mesh
@@ -309,46 +313,75 @@ def main():
         raise SystemExit(
             f"OPENDILOCO_TPU_BENCH_REMAT={env_remat!r}: must be true|false|dots"
         )
-    if env_attn or env_fused or env_remat:
-        # pinned single variant; FUSED=1 alone keeps the historical default
-        # of pallas attention (the round-1 toggle semantics)
+    env_bs = os.environ.get("OPENDILOCO_TPU_BENCH_BS")
+    if env_bs:
+        try:
+            pin_bs = int(env_bs) * n_chips  # env pins the PER-CHIP batch
+        except ValueError:
+            raise SystemExit(
+                f"OPENDILOCO_TPU_BENCH_BS={env_bs!r}: must be a per-chip "
+                "batch size (integer)"
+            )
+        if pin_bs <= 0 or pin_bs % accum:
+            raise SystemExit(
+                f"OPENDILOCO_TPU_BENCH_BS={env_bs!r}: must be positive and "
+                f"divisible by the accumulation factor {accum}"
+            )
+    if env_attn or env_fused or env_remat or env_bs:
+        # pinned single variant. Unset knobs default to the HEADLINE config
+        # (pallas attention + fused loss) so pinning one lever, e.g. BS=32,
+        # measures the configuration the roofline actually models; pass
+        # FUSED=0 explicitly for an unfused pin
         remat = {"false": False, "true": True, "dots": "dots"}[
             (env_remat or "true").lower()
         ]
         variants = [
-            (env_attn or "pallas", (env_fused or "0") in ("1", "true"), remat)
+            (
+                env_attn or "pallas",
+                (env_fused or "1") in ("1", "true"),
+                remat,
+                pin_bs if env_bs else bs,
+            )
         ]
     else:
-        # measured-best first (hits the persistent compile cache and banks a
-        # nonzero number early), then the remat levers (full remat re-runs
-        # the forward -- dropping it buys FLOPs when activations fit HBM),
-        # then the xla baseline for the comparison row; a flaky remote
-        # compile or OOM loses a variant only
+        # Measured-best first (hits the persistent compile cache, so a
+        # dying window still banks a number in its first minute), then the
+        # AOT-roofline pick (AOT_ROOFLINE.json, round 5: HBM-bound, ceiling
+        # 0.578 -> 0.674 going bs16 -> bs32 per chip -- the predicted 40%
+        # lever), then dots and the xla baseline. remat=False is OMITTED:
+        # the AOT memory model proves it does not fit HBM at these shapes
+        # (16.7G+ vs 15.75G).
         variants = [
-            ("pallas", True, True),
-            ("pallas", True, False),
-            ("pallas", True, "dots"),
-            ("xla", False, True),
+            ("pallas", True, True, bs),
+            ("pallas", True, True, 2 * bs),
+            ("pallas", True, "dots", bs),
+            ("xla", False, True, bs),
         ]
 
     # Quick first emission: time the measured-best variant with a short run
     # before the full sweep, so a tunnel that wedges mid-sweep (or the 540s
     # watchdog) still finds a fresh live number in _RESULTS and the bank.
-    q_attn, q_fused, q_remat = variants[0]
-    q_name = f"{q_attn}{'+fused' if q_fused else ''}+remat={q_remat}"
+    def _vname(attn, fused, remat, vbs):
+        name = f"{attn}{'+fused' if fused else ''}+remat={remat}"
+        # PER-CHIP batch in the label (mfu_sweep.py's convention, so
+        # BENCH_LIVE.json rows for one physical config carry one number)
+        return name if vbs == bs else f"{name}+bs{vbs // n_chips}"
+
+    q_attn, q_fused, q_remat, q_bs = variants[0]
+    q_name = _vname(q_attn, q_fused, q_remat, q_bs)
     try:
         tps = _run_variant(
-            cfg, q_attn, q_fused, seq, bs, accum, remat=q_remat, n_steps=5
+            cfg, q_attn, q_fused, seq, q_bs, accum, remat=q_remat, n_steps=5
         )
         _RESULTS[q_name] = tps
         _bank(model, q_name, tps)
     except Exception as e:
         print(f"# quick pass {q_name} failed: {e}", flush=True)
 
-    for attn, fused, remat in variants:
-        name = f"{attn}{'+fused' if fused else ''}+remat={remat}"
+    for attn, fused, remat, vbs in variants:
+        name = _vname(attn, fused, remat, vbs)
         try:
-            tps = _run_variant(cfg, attn, fused, seq, bs, accum, remat=remat)
+            tps = _run_variant(cfg, attn, fused, seq, vbs, accum, remat=remat)
             # the full 15-step measurement replaces the noisier 5-step
             # quick-pass value outright (max() would keep jitter-inflated
             # short-run readings as the headline)
