@@ -378,6 +378,9 @@ def forward(
             attn_fn=attn_fn,
             remat=remat,
             axis=pp_axis,
+            # sp+pp composition: the pipeline binds the ring axis manual
+            # too, and ring attention runs directly on the local chunks
+            sp_axis=ring_axis if attn_impl == "ring" else None,
         )
         attn_norms = jnp.zeros((cfg.num_hidden_layers,), jnp.float32)
     else:

@@ -95,6 +95,20 @@ def _block_attn(qg, k, v, q_pos, k_pos, m, l, acc, *, causal):
     return m_new, l_new, acc_new
 
 
+def _ring_vma(axis_name: str, ref) -> frozenset:
+    """Varying-manual-axes set for ring internals: the ring axis plus any
+    OUTER manual axes ``ref`` already varies over. Standalone sp meshes get
+    {sp} exactly as before; nested inside the pp pipeline's partial-manual
+    region the inputs are also pp-varying, and fresh scan carriers /
+    kernel outputs must carry the full type from step 0 or the scan's
+    carry types mismatch."""
+    try:
+        extra = getattr(jax.typeof(ref), "vma", frozenset()) or frozenset()
+    except Exception:  # pragma: no cover - tracing-context quirks
+        extra = frozenset()
+    return frozenset(extra) | {axis_name}
+
+
 def _ring_forward(q, k, v, axis_name, causal):
     """-> (out [B, Tl, Hq, D], lse [B, Hkv, G, Tq, 1] float32)."""
     b, tl, hq, d = q.shape
@@ -124,8 +138,11 @@ def _ring_forward(q, k, v, axis_name, causal):
     l0 = jnp.zeros((b, hkv, g, tl, 1), jnp.float32)
     acc0 = jnp.zeros((b, hkv, g, tl, d), jnp.float32)
     # stats become device-varying after the first accumulation step; the scan
-    # carry must have that type from the start
-    m0, l0, acc0 = jax.lax.pcast((m0, l0, acc0), axis_name, to="varying")
+    # carry must have that type from the start (including any outer manual
+    # axes when nested in the pp pipeline)
+    m0, l0, acc0 = jax.lax.pcast(
+        (m0, l0, acc0), tuple(sorted(_ring_vma(axis_name, q))), to="varying"
+    )
     (_, _, m, l, acc), _ = jax.lax.scan(
         step, (k, v, m0, l0, acc0), jnp.arange(n), length=n
     )
@@ -224,7 +241,9 @@ def _ring_bwd(axis_name, causal, res, dout):
     dk0 = jnp.zeros((b, tl, hkv, d), jnp.float32)
     dv0 = jnp.zeros_like(dk0)
     dq0 = jnp.zeros((b, tl, hkv, hq // hkv, d), jnp.float32)
-    dk0, dv0, dq0 = jax.lax.pcast((dk0, dv0, dq0), axis_name, to="varying")
+    dk0, dv0, dq0 = jax.lax.pcast(
+        (dk0, dv0, dq0), tuple(sorted(_ring_vma(axis_name, q))), to="varying"
+    )
     (_, _, dk, dv, dq), _ = jax.lax.scan(
         step, (k, v, dk0, dv0, dq0), jnp.arange(n), length=n
     )
@@ -256,7 +275,7 @@ def _ring_flash_forward(q, k, v, axis_name, block):
     from opendiloco_tpu.ops.flash_attention import _fwd
 
     qT, kT, vT = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
-    vma = frozenset({axis_name})
+    vma = _ring_vma(axis_name, q)
 
     idx = jax.lax.axis_index(axis_name)
     n = jax.lax.axis_size(axis_name)
@@ -334,7 +353,7 @@ def _ring_flash_bwd(axis_name, block, res, dout):
         block_q=block,
         block_k=block,
         grad_dtype=jnp.float32,
-        vma=frozenset({axis_name}),
+        vma=_ring_vma(axis_name, q),
     )
     dq, dk, dv = _bwd_impl(qT, kT, vT, doT, lse, delta, causal=True, **kwargs)
 
@@ -370,12 +389,14 @@ def _ring_flash_bwd(axis_name, block, res, dout):
 ring_flash_attention.defvjp(_ring_flash_fwd, _ring_flash_bwd)
 
 
-def _flash_chunk_block(mesh, axis: str, q, causal: bool) -> int:
+def _flash_chunk_block(mesh, axis: str, q, causal: bool, local: bool = False) -> int:
     """Block size for the flash-chunk ring path, or 0 for the einsum path.
 
     Flash chunks need: causal attention, a TPU mesh (or the
     OPENDILOCO_TPU_RING_FLASH=1 override for interpret-mode tests), a local
-    chunk length that tiles by 128, and a lane-aligned head dim.
+    chunk length that tiles by 128, and a lane-aligned head dim. ``local``:
+    q is already the per-device chunk (direct-call path inside an
+    already-manual region) rather than the global-view array.
     """
     if not causal:
         return 0
@@ -390,7 +411,7 @@ def _flash_chunk_block(mesh, axis: str, q, causal: bool) -> int:
     from opendiloco_tpu.ops.flash_attention import _pick_block
 
     n = mesh.shape[axis]
-    tl = q.shape[1] // n
+    tl = q.shape[1] // n if not local else q.shape[1]
     if q.shape[-1] % 8:
         return 0
     return _pick_block(tl, 1024)
@@ -414,12 +435,34 @@ def ring_attention_auto(
         )
     P = jax.sharding.PartitionSpec
     spec = P(None, axis, None, None)
-    block = _flash_chunk_block(mesh, axis, q, causal=True)
+    # block-size/device decisions read the CONCRETE mesh; the shard_map
+    # itself must use the tracing context's mesh when we are already inside
+    # another partial-manual region (the pp pipeline): there the context is
+    # an AbstractMesh with the outer axes Manual, and a concrete mesh would
+    # be rejected. Nesting over a disjoint manual axis set is supported --
+    # this is what composes sp ring attention with pipeline stages.
+    inside_manual = False
+    try:
+        ctx = jax.sharding.get_abstract_mesh()
+        types = dict(
+            zip(getattr(ctx, "axis_names", ()), getattr(ctx, "axis_types", ()))
+        )
+        inside_manual = types.get(axis) == jax.sharding.AxisType.Manual
+    except Exception:  # pragma: no cover - older jax without abstract mesh
+        pass
+    block = _flash_chunk_block(mesh, axis, q, causal=True, local=inside_manual)
     if block:
         body = lambda q, k, v: ring_flash_attention(q, k, v, axis, block)
     else:
         # positional args: custom_vjp nondiff_argnums are position-based
         body = lambda q, k, v: ring_attention(q, k, v, axis, True)
+    if inside_manual:
+        # already inside a manual region over the ring axis (the sp+pp
+        # pipeline binds both axes manual): q/k/v are the local chunks,
+        # so run the ring body directly — a nested shard_map here would
+        # lower in the forward but has no jvp lowering (Shardy rejects
+        # re-binding the outer axis; GSPMD check-fails)
+        return body(q, k, v)
     fn = jax.shard_map(
         body,
         mesh=mesh,

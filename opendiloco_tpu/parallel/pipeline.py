@@ -47,6 +47,7 @@ def pipeline_hidden(
     attn_fn=None,
     remat: RematPolicy = True,
     axis: str = "pp",
+    sp_axis: str | None = None,
 ) -> jax.Array:
     """Run the decoder stack as a pp-staged pipeline.
 
@@ -54,8 +55,17 @@ def pipeline_hidden(
     jit level); h0: embedded inputs [B, T, D]; returns (final hidden
     [B, T, D] (pre-final-norm), moe_aux scalar). B must divide by
     ``microbatches``. ``attn_fn`` is the per-block attention callable built
-    by ``llama.forward`` (ring attention is invalid here -- it nests its
-    own shard_map; the trainer rejects the combination at construction).
+    by ``llama.forward``.
+
+    ``sp_axis`` composes sequence parallelism with the pipeline (round 5):
+    the shard_map binds BOTH axes manual — nesting ring attention's own
+    shard_map inside a pp-manual region lowers in the forward but neither
+    Shardy nor GSPMD can lower its jvp — so activations arrive as local
+    [.., T/sp, D] chunks, every non-attention op is token-local anyway,
+    and ``ring_attention_auto`` detects the already-manual axis and runs
+    the ring body directly. MoE caveat: router batch statistics become
+    sequence-chunk-local under sp (the mean over chunks is psum'd, same
+    GPipe-style semantics as the per-microbatch stats).
 
     moe_aux is the router aux loss averaged over layers AND microbatches
     (psum'd across stages). With microbatches=1 it equals the unpipelined
@@ -76,13 +86,18 @@ def pipeline_hidden(
 
     P = jax.sharding.PartitionSpec
     layer_specs = jax.tree.map(lambda _: P(axis), cparams["layers"])
+    manual_axes = (axis,) if sp_axis is None else (axis, sp_axis)
+    # with sp manual, activations/positions keep their sequence sharding
+    # into the region (dim 2 of [M, B/M, T(, D)]) instead of gathering
+    hs_spec = P(None, None, sp_axis, None) if sp_axis else P()
+    pos_spec = P(None, None, sp_axis) if sp_axis else P()
 
     @functools.partial(
         jax.shard_map,
         mesh=mesh,
-        in_specs=(layer_specs, P(), P()),
-        out_specs=(P(), P()),
-        axis_names={axis},
+        in_specs=(layer_specs, hs_spec, pos_spec),
+        out_specs=(hs_spec, P()),
+        axis_names=set(manual_axes),
     )
     def _pipeline(layers_local, hs, mb_positions):
         r = jax.lax.axis_index(axis)
@@ -118,11 +133,16 @@ def pipeline_hidden(
             nxt = jax.lax.ppermute(y, axis, perm)
             return (nxt, outs, aux), None
 
-        zeros = jnp.zeros_like(hs[0])
-        outs0 = jnp.zeros_like(hs)
-        cur0, outs0, aux0 = jax.lax.pcast(
-            (zeros, outs0, jnp.float32(0.0)), axis, to="varying"
-        )
+        def to_varying(x):
+            # only the axes x is not ALREADY varying over: zeros_like on the
+            # sp-sharded hs inherits {V:sp}, and pcast rejects mixed states
+            vma = getattr(jax.typeof(x), "vma", frozenset()) or frozenset()
+            missing = tuple(a for a in manual_axes if a not in vma)
+            return jax.lax.pcast(x, missing, to="varying") if missing else x
+
+        cur0 = to_varying(jnp.zeros_like(hs[0]))
+        outs0 = to_varying(jnp.zeros_like(hs))
+        aux0 = to_varying(jnp.float32(0.0))
         (cur, outs, aux), _ = jax.lax.scan(
             tick, (cur0, outs0, aux0), jnp.arange(M + n - 1)
         )
@@ -133,6 +153,10 @@ def pipeline_hidden(
         # each stage summed the aux of its own layers over its M valid
         # microbatch runs: psum -> total over all L layers x M microbatches
         aux = jax.lax.psum(aux, axis) / (cfg.num_hidden_layers * M)
+        if sp_axis is not None:
+            # chunk-local router stats: mean over sequence chunks, and the
+            # P() out_spec needs the value invariant over sp
+            aux = jax.lax.psum(aux, sp_axis) / jax.lax.axis_size(sp_axis)
         return outs, aux
 
     outs, moe_aux = _pipeline(cparams["layers"], hs, mb_positions)

@@ -54,11 +54,13 @@ class TrainerConfig:
     # None = auto (TPU dense models on, otherwise off)
     fused_loss: Optional[bool] = None
     pp_microbatches: Optional[int] = None  # pipeline microbatches (None = pp size)
-    # sp+pp cannot run ring attention (it nests its own shard_map inside the
-    # pipeline stages); the only thing the sp axis can then do is shard
-    # activations while every device attends over the FULL sequence. That is
-    # a real memory-scaling mode but never an implicit one: combining sp and
-    # pp raises unless this is set.
+    # sp+pp fallback selector. With the DEFAULT (auto) attention, sp+pp
+    # composes via ring attention running inside the pipeline's manual
+    # region; setting this instead selects the activation-sharding mode
+    # (full-sequence attention, the sp axis only shards activations) — a
+    # real memory-scaling mode, but never an implicit one. An EXPLICIT
+    # attn_impl always wins over this flag (explicit ring composes, and an
+    # explicit non-ring impl under sp+pp raises unless this is set).
     allow_sp_activation_sharding: bool = False
     # fp16 dynamic loss scaling (torch GradScaler parity, train_fsdp.py:228,
     # 383-405; bf16 needs none -- the reference itself recommends bf16)
@@ -120,19 +122,22 @@ def _resolve_perf_defaults(
     on_tpu = "tpu" in getattr(dev, "device_kind", "").lower()
     changes: dict = {}
     if tc.attn_impl == "auto":
-        if getattr(plan, "sp_axis", None) is not None and getattr(
-            plan, "pp_axis", None
-        ) is None:
+        if getattr(plan, "sp_axis", None) is not None and not (
+            tc.allow_sp_activation_sharding and getattr(plan, "pp_axis", None)
+        ):
             # sequence-parallel mesh: flash/xla attention are not
             # sequence-sharded, so XLA would all-gather the full sequence
             # per device, silently defeating the sp axis -- ring attention
-            # is the only impl that keeps the shards local
+            # is the only impl that keeps the shards local. This includes
+            # sp+pp (round 5): the pipeline binds both axes manual and the
+            # ring body runs DIRECTLY on each stage's local chunks (no
+            # nested shard_map -- that construction has no jvp lowering)
             changes["attn_impl"] = "ring"
         else:
             if getattr(plan, "sp_axis", None) is not None:
-                # sp+pp: only reachable with allow_sp_activation_sharding
-                # (InnerTrainer.__init__ raises otherwise); the sp axis
-                # shards activations while attention sees the full sequence
+                # sp+pp with the explicit activation-sharding opt-in: the
+                # sp axis shards activations while attention sees the full
+                # sequence
                 log.warning(
                     "sp+pp with allow_sp_activation_sharding: using "
                     "full-sequence %s attention; the sp axis only shards "
@@ -163,22 +168,26 @@ class InnerTrainer:
     """
 
     def __init__(self, model_cfg: LlamaConfig, tc: TrainerConfig, plan: MeshPlan):
-        # checked before perf-default resolution: the auto path would
-        # otherwise log its opt-in warning for a combination about to raise
+        # sp+pp composes as of round 5: the pipeline binds BOTH axes manual
+        # and ring attention runs directly on the local sequence chunks.
+        # --allow-sp-activation-sharding selects the fallback mode instead
+        # (full-sequence attention, sp shards activations only); a non-ring
+        # attention choice under sp+pp without that opt-in stays an error —
+        # it would silently defeat the sp axis ("chosen, not discovered").
+        tc = _resolve_perf_defaults(tc, model_cfg, plan)
         if (
             plan.pp_axis
             and getattr(plan, "sp_axis", None)
+            and tc.attn_impl != "ring"
             and not tc.allow_sp_activation_sharding
         ):
             raise ValueError(
-                "sp+pp cannot run ring attention (it nests its own shard_map "
-                "inside pipeline stages), so the sp axis would only shard "
+                f"sp+pp with attn_impl={tc.attn_impl!r} would shard "
                 "activations while every device attends over the FULL "
-                "sequence. If that activation-sharding mode is what you "
-                "want, opt in with --allow-sp-activation-sharding; otherwise "
-                "drop sp_size or pp_size"
+                "sequence. Use the default/ring attention (composes with "
+                "the pipeline), or opt into the activation-sharding mode "
+                "with --allow-sp-activation-sharding"
             )
-        tc = _resolve_perf_defaults(tc, model_cfg, plan)
         self.model_cfg = model_cfg
         self.tc = tc
         self.plan = plan
@@ -189,11 +198,12 @@ class InnerTrainer:
                     f"{model_cfg.num_hidden_layers} layers cannot stage over "
                     f"pp={pp_n} (must divide evenly)"
                 )
-            if tc.attn_impl == "ring":
+            if tc.attn_impl == "ring" and not getattr(plan, "sp_axis", None):
                 raise ValueError(
-                    "ring attention cannot run inside pipeline stages (it "
-                    "nests its own shard_map); use attn_impl xla/pallas "
-                    "with pp, or sp without pp"
+                    "ring attention under pp needs a sequence-parallel axis "
+                    "to ring over: add sp_size > 1 (the pipeline binds both "
+                    "axes manual and the ring runs on each stage's local "
+                    "chunks), or use attn_impl xla/pallas"
                 )
         if plan.ep_axis:
             ep_n = plan.mesh.shape[plan.ep_axis]
@@ -348,6 +358,10 @@ class InnerTrainer:
                 pp_mesh=self.plan.mesh,
                 pp_axis=self.plan.pp_axis,
                 pp_microbatches=self.tc.pp_microbatches,
+                # sp+pp: forward threads the ring axis into the pipeline's
+                # manual region (ring runs directly on the local chunks)
+                ring_mesh=self.plan.mesh,
+                ring_axis=self.plan.sp_axis or "sp",
             )
         else:
             fwd_kwargs = dict(

@@ -92,20 +92,24 @@ def test_pp_composes_with_fsdp_and_remat(pp_cfg):
     np.testing.assert_allclose(got, ref, rtol=1e-5, atol=2e-5)
 
 
-def test_sp_pp_requires_explicit_optin(pp_cfg):
-    """sp+pp cannot run ring attention, so the sp axis only shards
-    activations (full-sequence attention per device). That mode must be
-    chosen, not discovered: without allow_sp_activation_sharding the
-    combination is an error; with it, training runs and matches the
-    sequential trajectory."""
-    plan = build_mesh("NO_SHARD", pp_size=2, sp_size=2)
-    tc = TrainerConfig(
-        lr=1e-3, warmup_steps=2, total_steps=10, precision="fp32", remat=False
-    )
-    with pytest.raises(ValueError, match="allow-sp-activation-sharding"):
-        InnerTrainer(pp_cfg, tc, plan)
+def test_sp_pp_composes_with_ring_attention(pp_cfg):
+    """sp+pp true composition (round 5): the pipeline's shard_map binds
+    both axes manual and ring attention runs directly on each stage's
+    local sequence chunks. The auto attention default resolves to ring,
+    and the multi-step trajectory (fwd + reverse pipeline + ring VJP +
+    AdamW) equals the sequential trainer's."""
+    ref = _run_steps(pp_cfg, build_mesh("NO_SHARD"))
+    got = _run_steps(pp_cfg, build_mesh("NO_SHARD", pp_size=2, sp_size=2))
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=5e-5)
 
-    # the explicit attn choice doesn't bypass the gate either
+
+def test_sp_pp_non_ring_requires_explicit_optin(pp_cfg):
+    """A NON-ring attention choice under sp+pp would silently shard
+    activations while attending over the full sequence — that mode must be
+    chosen, not discovered: explicit xla without the opt-in raises; with
+    allow_sp_activation_sharding it runs and matches the sequential
+    first-step loss."""
+    plan = build_mesh("NO_SHARD", pp_size=2, sp_size=2)
     tc_explicit = TrainerConfig(
         lr=1e-3, warmup_steps=2, total_steps=10, precision="fp32",
         remat=False, attn_impl="xla",
@@ -119,6 +123,7 @@ def test_sp_pp_requires_explicit_optin(pp_cfg):
         remat=False, allow_sp_activation_sharding=True,
     )
     trainer = InnerTrainer(pp_cfg, tc_ok, plan)
+    assert trainer.tc.attn_impl != "ring"  # the fallback mode, not ring
     state = trainer.init_state(jax.random.key(0))
     ids = _data()
     batch = trainer.shard_batch(ids, ids.copy(), accum=1)

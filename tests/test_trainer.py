@@ -238,10 +238,17 @@ def test_auto_perf_defaults_on_tpu_device_kind(tiny_cfg):
     tc = _resolve_perf_defaults(TrainerConfig(), tiny_cfg, sp_plan)
     assert tc.attn_impl == "ring" and tc.fused_loss is False
 
-    # sp+pp: ring cannot nest inside pipeline stages -> full-sequence
-    # attention with a warning, never a crash
+    # sp+pp composes (round 5): auto resolves to ring, which runs directly
+    # on each pipeline stage's local sequence chunks
     sppp_plan = SimpleNamespace(mesh=plan.mesh, sp_axis="sp", pp_axis="pp")
     tc = _resolve_perf_defaults(TrainerConfig(), tiny_cfg, sppp_plan)
+    assert tc.attn_impl == "ring" and tc.fused_loss is False
+
+    # the explicit activation-sharding opt-in selects the fallback mode:
+    # full-sequence attention, sp shards activations only
+    tc = _resolve_perf_defaults(
+        TrainerConfig(allow_sp_activation_sharding=True), tiny_cfg, sppp_plan
+    )
     assert tc.attn_impl == "pallas" and tc.fused_loss is False
 
     # MoE composes with the fused kernel (the router aux rides
