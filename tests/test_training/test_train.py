@@ -98,6 +98,23 @@ def read_metrics(logger_file) -> list[dict]:
         return pickle.load(f)
 
 
+def communicate_all(procs, timeout):
+    """communicate() every proc, kill stragglers, assert all exited 0;
+    returns the stdout texts. The multihost tests share this so
+    wedged-process cleanup changes happen in one place (same convention as
+    spawn_worker for launches)."""
+    try:
+        outs = [p.communicate(timeout=timeout)[0] for p in procs]
+    finally:
+        for p in procs:  # never leak a wedged distributed process
+            if p.poll() is None:
+                p.kill()
+    assert all(p.returncode == 0 for p in procs), "\n".join(
+        o[-2000:] for o in outs
+    )
+    return outs
+
+
 @pytest.mark.slow
 def test_train_and_resume_deterministic(tmp_path):
     """Losses and LRs after resume match the uninterrupted run exactly
@@ -391,19 +408,9 @@ def test_multihost_two_process_train_and_resume(tmp_path):
             env=env, cwd=REPO,
         )
 
-    def run_pair(procs):
-        try:
-            # generous: two jax.distributed processes contend with the rest
-            # of the suite for this box's single CPU
-            outs = [p.communicate(timeout=1200)[0] for p in procs]
-        finally:
-            for p in procs:  # never leak a wedged distributed process
-                if p.poll() is None:
-                    p.kill()
-        assert all(p.returncode == 0 for p in procs), (
-            outs[0][-2000:] + outs[1][-2000:]
-        )
-        return outs
+    # generous timeout: two jax.distributed processes contend with the
+    # rest of the suite for this box's single CPU
+    run_pair = lambda procs: communicate_all(procs, 1200)
 
     run_pair([launch(p, tmp_path / f"full_{p}.pkl", []) for p in (0, 1)])
     full = read_metrics(tmp_path / "full_0.pkl")
@@ -499,17 +506,7 @@ def test_multihost_diloco_compose_hybrid(tmp_path):
             env=env, cwd=REPO,
         )
 
-    def run_all(procs, timeout=1800):
-        try:
-            outs = [p.communicate(timeout=timeout)[0] for p in procs]
-        finally:
-            for p in procs:
-                if p.poll() is None:
-                    p.kill()
-        assert all(p.returncode == 0 for p in procs), "\n".join(
-            o[-2000:] for o in outs
-        )
-        return outs
+    run_all = lambda procs: communicate_all(procs, 1800)
 
     try:
         # --- composed arm: 2 workers x 2 processes ---------------------
@@ -624,6 +621,72 @@ def test_multihost_diloco_compose_hybrid(tmp_path):
         peers_seen = [m["num_peers"] for m in ov if "num_peers" in m]
         assert peers_seen and max(peers_seen) == 2, peers_seen
         assert np.isfinite(ov[-1]["Loss"]) and ov[-1]["Loss"] < 7.0
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize(
+    "mode,extra",
+    [
+        ("streaming", ["--diloco.streaming-fragments", "2"]),
+        ("gossip", ["--diloco.outer-mode", "gossip"]),
+    ],
+)
+def test_multihost_diloco_slice_modes(tmp_path, mode, extra):
+    """The beyond-ref outer modes compose with a multihost slice too: one
+    worker as a 2-process jax.distributed slice (galaxy 1) runs streaming
+    fragment sync / gossip through the world-messenger fan-out. Oracles:
+    completes all steps, both slice processes record the identical
+    trajectory, finite trained loss."""
+    import socket
+
+    daemon, addr = spawn_rendezvous_daemon()
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        coord = s.getsockname()[1]
+
+    def launch(pid):
+        env = dict(os.environ)
+        env["OPENDILOCO_TPU_PLATFORM"] = "cpu"
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        args = [
+            "--path-model", "2m", "--fake-data", "--seq-length", "64",
+            "--per-device-train-batch-size", "4", "--total-batch-size", "16",
+            "--lr", "1e-3", "--warmup-steps", "2", "--total-steps", "6",
+            "--precision", "fp32",
+            "--sharding-strategy", "FULL_SHARD",
+            "--metric-logger-type", "dummy",
+            "--project", str(tmp_path / f"{mode}_{pid}.pkl"),
+            "--no-ckpt.interval",
+            "--diloco.local-steps", "2",
+            "--diloco.initial-peers", addr,
+            "--diloco.world-rank", "0", "--diloco.galaxy-size", "1",
+            "--diloco.backend", "tcp", "--diloco.skip-load-from-peers",
+            "--diloco.matchmaking-time", "1.0",
+            "--diloco.averaging-timeout", "60",
+            "--multihost", "--coordinator-address", f"127.0.0.1:{coord}",
+            "--num-processes", "2", "--process-id", str(pid),
+        ] + extra
+        return subprocess.Popen(
+            [sys.executable, "-m", "opendiloco_tpu.train", *args],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env, cwd=REPO,
+        )
+
+    procs = [launch(0), launch(1)]
+    try:
+        communicate_all(procs, 900)
+    finally:
+        daemon.kill()
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    m0 = read_metrics(tmp_path / f"{mode}_0.pkl")
+    m1 = read_metrics(tmp_path / f"{mode}_1.pkl")
+    assert len(m0) == 6 and len(m1) == 6  # a short m1 would make zip vacuous
+    for a, b in zip(m0, m1):
+        assert a["Loss"] == b["Loss"], (a, b)
+    assert np.isfinite(m0[-1]["Loss"]) and m0[-1]["Loss"] < 7.0
 
 
 @pytest.mark.slow
