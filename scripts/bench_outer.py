@@ -170,6 +170,18 @@ def worker_main() -> None:
         )
         backend.close()
         sys.exit(3)
+    # one untimed warmup round: first-touch page-in of the model-sized
+    # buffers (4.4 GB at 1b) plus codec scratch allocation dominate the
+    # first round (measured 179 s vs 11 s steady-state at 1b); keep it out
+    # of the timings entirely
+    try:
+        backend.barrier(timeout=args.timeout)
+        backend.all_reduce(data, timeout=args.timeout, group_cap=args.group_cap)
+    except Exception as e:
+        print(f"FATAL: warmup round failed: {e}", flush=True)
+        backend.close()
+        sys.exit(3)
+
     times = []
     n = 0
     want = expected_group(args.peers, args.group_cap)
