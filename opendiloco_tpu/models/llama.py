@@ -323,12 +323,16 @@ def forward(
     positions: Optional[jax.Array] = None,
     return_aux: bool = False,
     return_hidden: bool = False,
-    ring_mesh=None,
+    ring_mesh=None,  # the plan mesh: ring attention AND the SPMD kernel
+    # wrappers key off it — a multi-device pallas caller MUST pass it (a
+    # pallas operand with a sharded dim fails XLA compile otherwise)
     ring_axis: str = "sp",
     pp_mesh=None,
     pp_axis: str = "pp",
     pp_microbatches: Optional[int] = None,
     return_moe_aux: bool = False,
+    batch_axes: tuple = (),
+    tp_axis: Optional[str] = None,
 ):
     """input_ids [B, T] int32 -> logits [B, T, V] float32.
 
@@ -349,9 +353,25 @@ def forward(
     if attn_impl == "xla":
         attn_fn = lambda q, k, v: xla_attention(q, k, v, causal=True)
     elif attn_impl == "pallas":
-        from opendiloco_tpu.ops.flash_attention import flash_attention
+        from opendiloco_tpu.ops.flash_attention import (
+            flash_attention,
+            flash_attention_sharded,
+        )
 
-        attn_fn = lambda q, k, v: flash_attention(q, k, v, causal=True)
+        if pp_mesh is None and ring_mesh is not None and ring_mesh.size > 1:
+            # multi-device mesh: Mosaic kernels cannot be auto-partitioned,
+            # so the kernel runs manual over the sharded activation axes
+            # (flash_attention_sharded). Under pp the pipeline gathers the
+            # batch, operands arrive replicated, and the plain kernel
+            # compiles (a shard_map here would nest inside the pp region,
+            # which has no jvp lowering).
+            mesh_ = ring_mesh
+            attn_fn = lambda q, k, v: flash_attention_sharded(
+                q, k, v, mesh=mesh_, batch_axes=batch_axes, tp_axis=tp_axis,
+                causal=True,
+            )
+        else:
+            attn_fn = lambda q, k, v: flash_attention(q, k, v, causal=True)
     elif attn_impl == "ring":
         from opendiloco_tpu.ops.ring_attention import ring_attention_auto
 

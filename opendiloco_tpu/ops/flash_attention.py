@@ -112,7 +112,11 @@ def _fwd(q, k, v, *, block_q: int, block_k: int, causal: bool, vma=None):
 
     ``vma``: varying-manual-axes annotation for the outputs, required when
     called inside a shard_map manual region (the ring-attention chunks).
+    When unset it is derived from q so the kernel types correctly in ANY
+    manual region (e.g. flash_attention_sharded's batch/tp shard_map).
     """
+    if vma is None:
+        vma = getattr(jax.typeof(q), "vma", None) or None
     b, hq, t, d = q.shape
     hkv = k.shape[1]
     rep = hq // hkv
@@ -296,7 +300,10 @@ def _bwd_impl(
 ):
     """Backward kernels with delta precomputed. ``grad_dtype`` overrides the
     output dtype and ``vma`` annotates varying manual axes (both used by the
-    ring-attention chunk path, which accumulates f32 inside shard_map)."""
+    ring-attention chunk path, which accumulates f32 inside shard_map);
+    an unset vma is derived from q (see _fwd)."""
+    if vma is None:
+        vma = getattr(jax.typeof(q), "vma", None) or None
     b, hq, t, d = q.shape
     hkv = k.shape[1]
     rep = hq // hkv
@@ -474,3 +481,55 @@ def flash_attention(
     vt = v.transpose(0, 2, 1, 3)
     out = _flash(qt, kt, vt, block_q, block_k, causal)
     return out.transpose(0, 2, 1, 3)
+
+
+def flash_attention_sharded(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    mesh,
+    batch_axes: tuple = (),
+    tp_axis=None,
+    causal: bool = True,
+) -> jax.Array:
+    """SPMD entry for multi-device meshes.
+
+    Mosaic kernels cannot be automatically partitioned — XLA raises at
+    compile the moment a pallas operand has a sharded dimension (found by
+    the deviceless multichip AOT compile, round 5; a single-chip mesh
+    never hits it). Attention is independent per (batch row, head), so
+    the fix is a shard_map manual over exactly the axes the activations
+    are sharded on: the batch axes always, and tp on the head dims when
+    it divides BOTH q and kv head counts (shards then keep whole GQA
+    groups, so the kernel's local group arithmetic is unchanged). A
+    non-dividing tp head dim is instead replicated into the region (tp
+    is in the manual set with no spec entry = all-gather), which is the
+    same gather the auto partitioner would emit.
+
+    Do NOT call inside another manual region (the pp pipeline): nested
+    shard_map has no jvp lowering — there the pipeline's in_specs gather
+    the batch, operands arrive replicated, and the plain kernel compiles.
+    """
+    if mesh is None or getattr(mesh, "size", 1) <= 1:
+        return flash_attention(q, k, v, causal=causal)
+    P = jax.sharding.PartitionSpec
+    hq, hkv = q.shape[2], k.shape[2]
+    head = None
+    if tp_axis is not None and mesh.shape[tp_axis] > 1:
+        n_tp = mesh.shape[tp_axis]
+        if hq % n_tp == 0 and hkv % n_tp == 0:
+            head = tp_axis
+    spec = P(tuple(batch_axes) or None, None, head, None)
+    fn = jax.shard_map(
+        lambda a, b, c: flash_attention(a, b, c, causal=causal),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        # ALL mesh axes manual: a partially-manual pallas call still goes
+        # through the auto partitioner for the remaining axes and XLA
+        # refuses; axes outside the spec replicate into the region (the
+        # same gather auto partitioning would emit)
+        axis_names=set(mesh.axis_names),
+    )
+    return fn(q, k, v)

@@ -125,7 +125,9 @@ def _fwd_kernel(
 def _fwd(h, w, labels, block_n, block_v, true_v):
     # per-token vectors travel as [1, N] rows with (1, block_n) blocks: 1-D
     # operands get a global XLA tiling tied to one block size, which breaks
-    # when forward and backward kernels pick different token blocks
+    # when forward and backward kernels pick different token blocks.
+    # (The SPMD wrapper's shard_map runs with check_vma=False, so no vma
+    # annotations are needed on the out_shapes here.)
     n, d = h.shape
     v = w.shape[1]
     grid = (n // block_n, v // block_v)
@@ -257,6 +259,9 @@ def _bwd_impl(h, w, labels, lse, g, block_n, block_v, true_v):
         # dh in the input dtype (cast happens in-kernel); an f32 output
         # would double its VMEM block for no benefit
         out_specs=pl.BlockSpec((block_n, d), lambda i, j: (i, 0)),
+        # both grads vary like the (batch-sharded) rows: dw is each
+        # shard's partial sum; shard_map's transpose of the replicated-w
+        # in_spec psums the partials outside the kernel
         out_shape=jax.ShapeDtypeStruct((n, d), h.dtype),
         scratch_shapes=[pltpu.VMEM((block_n, d), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
@@ -314,27 +319,24 @@ def _fused_bwd(block_n, block_v, true_v, res, g):
 _fused_nll.defvjp(_fused_fwd, _fused_bwd)
 
 
-def fused_linear_cross_entropy(
+def _nll_sum_count(
     h: jax.Array, w: jax.Array, labels: jax.Array
-) -> jax.Array:
-    """Mean nll over non-ignored labels; h [N, D], w [D, V], labels [N].
+) -> tuple[jax.Array, jax.Array]:
+    """(sum of nll over non-ignored labels, raw non-ignored count).
 
-    Vocabs that don't tile (e.g. Llama's 32000) are zero-padded up to the
-    next block_v multiple and masked in-kernel, so the MXU always sees wide
-    tiles instead of degrading to 128; token counts that don't tile (the
-    causal shift gives B*(T-1) rows) are row-padded with IGNORE labels.
-    Falls back to the materializing path only when hidden % 128 != 0.
+    The kernel-dispatch core shared by the mean entry point and the SPMD
+    wrapper (which psums sums/counts across batch shards before dividing).
     """
     n, d = h.shape
     v = w.shape[1]
     mask = labels != IGNORE
-    count = jnp.maximum(jnp.sum(mask), 1)
+    count = jnp.sum(mask)
     if d % 128 != 0:
         logits = (h.astype(jnp.float32) @ w.astype(jnp.float32))
         lp = jax.nn.log_softmax(logits, axis=-1)
         safe = jnp.where(mask, labels, 0)
         nll = -jnp.take_along_axis(lp, safe[:, None], axis=1)[:, 0] * mask
-        return jnp.sum(nll) / count
+        return jnp.sum(nll), count
     bn_cap, bv_cap = _vmem_caps(d)
     block_n = _pick(n, bn_cap)
     if block_n == 0:
@@ -361,4 +363,73 @@ def fused_linear_cross_entropy(
         nll = _fused_nll(h, w_in, labels, block_n, block_v, v)
     else:
         nll = _fused_nll(h, w, labels, block_n, block_v, v)
-    return jnp.sum(nll) / count
+    return jnp.sum(nll), count
+
+
+def fused_linear_cross_entropy(
+    h: jax.Array, w: jax.Array, labels: jax.Array
+) -> jax.Array:
+    """Mean nll over non-ignored labels; h [N, D], w [D, V], labels [N].
+
+    Vocabs that don't tile (e.g. Llama's 32000) are zero-padded up to the
+    next block_v multiple and masked in-kernel, so the MXU always sees wide
+    tiles instead of degrading to 128; token counts that don't tile (the
+    causal shift gives B*(T-1) rows) are row-padded with IGNORE labels.
+    Falls back to the materializing path only when hidden % 128 != 0.
+    """
+    s, c = _nll_sum_count(h, w, labels)
+    return s / jnp.maximum(c, 1)
+
+
+def fused_linear_cross_entropy_sharded(
+    h: jax.Array,
+    w: jax.Array,
+    labels: jax.Array,
+    *,
+    mesh,
+    batch_axes: tuple = (),
+    tp_axis=None,
+) -> jax.Array:
+    """SPMD entry for multi-device meshes.
+
+    Mosaic kernels cannot be automatically partitioned (XLA raises at
+    compile when a pallas operand has a sharded dim — found by the
+    deviceless multichip AOT compile, round 5). The rows of ``h``/
+    ``labels`` are sharded over the batch axes, so the kernel runs inside
+    a shard_map manual over them: each shard computes its local (nll sum,
+    count) and the mean is taken after a psum. ``w`` has no spec entry —
+    a tp-sharded head is replicated into the region (the softmax needs
+    the full vocab; this is the same gather the auto partitioner emits
+    for the unfused path). tp joins the manual set only so that gather is
+    explicit rather than an illegal sharded operand."""
+    if mesh is None or getattr(mesh, "size", 1) <= 1 or not batch_axes:
+        return fused_linear_cross_entropy(h, w, labels)
+    P = jax.sharding.PartitionSpec
+
+    def body(hh, ww, ll):
+        s, c = _nll_sum_count(hh, ww, ll)
+        # psum over the batch shards only: over tp the operands were
+        # replicated, so (s, c) are already invariant there. The replicated
+        # ww in_spec's TRANSPOSE is a psum, which is exactly the
+        # cross-shard aggregation the partial dw needs.
+        s = jax.lax.psum(s, tuple(batch_axes))
+        c = jax.lax.psum(c, tuple(batch_axes))
+        return s / jnp.maximum(c, 1)
+
+    fn = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(tuple(batch_axes), None), P(), P(tuple(batch_axes))),
+        out_specs=P(),
+        # ALL mesh axes manual — a partially-manual pallas call still hits
+        # the auto partitioner for the remaining axes and XLA refuses; a
+        # tp-sharded head replicates into the region (the softmax needs
+        # the full vocab; same gather the auto partitioner emits)
+        axis_names=set(mesh.axis_names),
+        # the vma checker rejects kernel-internal constants mixing with
+        # varying refs in interpret mode (fresh jnp.full vs varying block);
+        # the cross-shard semantics here are explicit psums, so the check
+        # buys nothing
+        check_vma=False,
+    )
+    return fn(h, w, labels)

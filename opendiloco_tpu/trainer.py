@@ -333,15 +333,22 @@ class InnerTrainer:
 
     # -- steps ------------------------------------------------------------
 
-    @staticmethod
-    def _fused_lm_loss(hidden: jax.Array, head: jax.Array, labels: jax.Array):
+    def _fused_lm_loss(self, hidden: jax.Array, head: jax.Array, labels: jax.Array):
         """Shifted fused lm-head+xent over final hidden states (the single
-        shift/reshape site for both the plain and pipeline paths)."""
-        from opendiloco_tpu.ops.fused_xent import fused_linear_cross_entropy
+        shift/reshape site for both the plain and pipeline paths). On
+        multi-device meshes the SPMD entry runs the kernel manual over the
+        batch shards (Mosaic cannot be auto-partitioned); single-device
+        meshes take the plain kernel."""
+        from opendiloco_tpu.ops.fused_xent import fused_linear_cross_entropy_sharded
 
         d = hidden.shape[-1]
-        return fused_linear_cross_entropy(
-            hidden[:, :-1].reshape(-1, d), head, labels[:, 1:].reshape(-1)
+        return fused_linear_cross_entropy_sharded(
+            hidden[:, :-1].reshape(-1, d),
+            head,
+            labels[:, 1:].reshape(-1),
+            mesh=self.plan.mesh,
+            batch_axes=self.plan.batch_axes,
+            tp_axis=self.plan.tp_axis,
         )
 
     def _loss_fn(self, params: dict, input_ids: jax.Array, labels: jax.Array):
@@ -371,6 +378,8 @@ class InnerTrainer:
         moe = bool(self.model_cfg.num_experts)
         aux = lambda a: self.model_cfg.router_aux_coef * a
         fwd_kwargs.update(
+            batch_axes=self.plan.batch_axes,
+            tp_axis=self.plan.tp_axis,
             compute_dtype=self.tc.compute_dtype,
             attn_impl=self.tc.attn_impl,
             remat=self.tc.remat,
@@ -482,6 +491,8 @@ class InnerTrainer:
             return_aux=True,
             ring_mesh=self.plan.mesh,
             ring_axis=self.plan.sp_axis or "sp",
+            batch_axes=self.plan.batch_axes,
+            tp_axis=self.plan.tp_axis,
         )
         return aux
 
