@@ -361,15 +361,23 @@ def forward(
         if pp_mesh is None and ring_mesh is not None and ring_mesh.size > 1:
             # multi-device mesh: Mosaic kernels cannot be auto-partitioned,
             # so the kernel runs manual over the sharded activation axes
-            # (flash_attention_sharded). Under pp the pipeline gathers the
-            # batch, operands arrive replicated, and the plain kernel
-            # compiles (a shard_map here would nest inside the pp region,
-            # which has no jvp lowering).
+            # (flash_attention_sharded).
             mesh_ = ring_mesh
             attn_fn = lambda q, k, v: flash_attention_sharded(
                 q, k, v, mesh=mesh_, batch_axes=batch_axes, tp_axis=tp_axis,
                 causal=True,
             )
+        elif pp_mesh is not None and any(
+            s > 1 for a, s in pp_mesh.shape.items() if a not in (pp_axis, ring_axis)
+        ):
+            # pp composed with dp/fsdp/tp/ep: pipeline_hidden binds only
+            # pp (and sp) manual, so those axes stay AUTO inside the
+            # region and operands reach the kernel still sharded — Mosaic
+            # cannot be auto-partitioned, and wrapping a shard_map here
+            # would nest inside the pp-manual region, which has no jvp
+            # lowering. Documented downgrade: XLA attention (fuses fine;
+            # the pallas win is single-stage-measured ~+5-20%).
+            attn_fn = lambda q, k, v: xla_attention(q, k, v, causal=True)
         else:
             attn_fn = lambda q, k, v: flash_attention(q, k, v, causal=True)
     elif attn_impl == "ring":

@@ -179,3 +179,31 @@ def test_pp_composes_with_fused_loss(interpret_pallas_fused):
         _, m = trainer.train_step(state, batch)
         losses[fused] = float(m["loss"])
     np.testing.assert_allclose(losses[True], losses[False], rtol=1e-5)
+
+
+def test_pp_with_data_sharding_pallas_falls_back_to_xla(pp_cfg):
+    """pp composed with dp: pipeline_hidden binds only pp (and sp) manual,
+    so dp/fsdp/tp stay AUTO inside the region and operands would reach a
+    plain Pallas call still batch-sharded -- Mosaic kernels cannot be
+    auto-partitioned, and a nested shard_map has no jvp lowering.
+    attn_impl='pallas' must therefore downgrade to XLA attention in this
+    composition. The test runs WITHOUT interpret patching: a surviving
+    pallas_call would raise at lowering on CPU, and the fallback must make
+    the run bit-identical to the explicit xla run."""
+    plan = build_mesh("NO_SHARD", pp_size=2, dp_size=2)
+    losses = {}
+    for attn in ("xla", "pallas"):
+        tc = TrainerConfig(
+            lr=1e-3, warmup_steps=2, total_steps=50, precision="fp32",
+            remat=False, attn_impl=attn,
+        )
+        trainer = InnerTrainer(pp_cfg, tc, plan)
+        state = trainer.init_state(jax.random.key(3))
+        out = []
+        for s in range(2):
+            ids = _data(seed=s)
+            batch = trainer.shard_batch(ids, ids.copy(), accum=1)
+            state, m = trainer.train_step(state, batch)
+            out.append(float(m["loss"]))
+        losses[attn] = out
+    np.testing.assert_array_equal(losses["pallas"], losses["xla"])
