@@ -345,16 +345,17 @@ def main():
         ]
     else:
         # Measured-best first (hits the persistent compile cache, so a
-        # dying window still banks a number in its first minute), then the
-        # AOT-roofline pick (AOT_ROOFLINE.json, round 5: HBM-bound, ceiling
-        # 0.578 -> 0.674 going bs16 -> bs32 per chip -- the predicted 40%
-        # lever), then dots and the xla baseline. remat=False is OMITTED:
-        # the AOT memory model proves it does not fit HBM at these shapes
-        # (16.7G+ vs 15.75G).
+        # dying window still banks a number in its first minute). Round 5's
+        # live window (MFU_SWEEP.json) re-ranked the levers: remat=dots at
+        # bs16 measured best (61.1k tok/s, 36.2% MFU), and the AOT pick
+        # bs32 measured WORSE than bs16 (56.0k vs 58.9k) despite the higher
+        # predicted ceiling -- the live ordering wins over the model.
+        # remat=False is OMITTED: the AOT memory model proves it does not
+        # fit HBM at these shapes (16.7G+ vs 15.75G).
         variants = [
+            ("pallas", True, "dots", bs),
             ("pallas", True, True, bs),
             ("pallas", True, True, 2 * bs),
-            ("pallas", True, "dots", bs),
             ("xla", False, True, bs),
         ]
 

@@ -266,6 +266,27 @@ class InnerTrainer:
             ),
         )
 
+    def lower_abstract(self, global_bs: int, seq: int, accum: int = 1):
+        """Lower ``_train_step`` from ShapeDtypeStructs only (no arrays
+        materialized) — the one recipe the offline cost/memory analyses
+        share (scripts/aot_roofline.py, scripts/mfu_sweep.py). Deviceless
+        AOT targets work too: the shardings carry the topology's devices."""
+        state_sds = jax.tree.map(
+            lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
+            jax.eval_shape(self.init_state, jax.random.key(0)),
+            self.state_shardings,
+        )
+        bsh = self.plan.sharding(self.plan.batch_spec(3, accum=True))
+        if global_bs % accum:
+            raise ValueError(f"global_bs {global_bs} not divisible by accum {accum}")
+        batch_sds = {
+            k: jax.ShapeDtypeStruct(
+                (accum, global_bs // accum, seq), np.int32, sharding=bsh
+            )
+            for k in ("input_ids", "labels")
+        }
+        return self._train_step.lower(state_sds, batch_sds)
+
     # -- state ------------------------------------------------------------
 
     def init_state(self, rng: jax.Array, params: Optional[dict] = None) -> dict:

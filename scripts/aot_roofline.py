@@ -73,7 +73,6 @@ def main():
     import jax
 
     jax.config.update("jax_platforms", "cpu")
-    import numpy as np
     from jax.experimental import topologies
 
     from bench import model_flops_per_token  # the one MFU accounting
@@ -115,6 +114,9 @@ def main():
         doc["error"] = f"topology unavailable: {type(e).__name__}: {e}"
         flush(doc)
         raise SystemExit(doc["error"])
+    # a resumed run that gets this far has a working topology: drop any
+    # failure marker a previous aborted run left at the top level
+    doc.pop("error", None)
     devices = list(topo.devices)[:1]  # single-chip bench shape
 
     cfg_cache = {}
@@ -155,21 +157,7 @@ def main():
                 trainer = InnerTrainer(
                     cfg, tc, build_mesh("NO_SHARD", devices=devices)
                 )
-                state_sds = jax.tree.map(
-                    lambda s, sh: jax.ShapeDtypeStruct(
-                        s.shape, s.dtype, sharding=sh
-                    ),
-                    jax.eval_shape(trainer.init_state, jax.random.key(0)),
-                    trainer.state_shardings,
-                )
-                bsh = trainer.plan.sharding(trainer.plan.batch_spec(3, accum=True))
-                batch_sds = {
-                    k: jax.ShapeDtypeStruct(
-                        (accum, bs // accum, seq), np.int32, sharding=bsh
-                    )
-                    for k in ("input_ids", "labels")
-                }
-                return trainer._train_step.lower(state_sds, batch_sds).compile()
+                return trainer.lower_abstract(bs, seq, accum=accum).compile()
 
             # memory footprint from the program that actually runs (layer
             # scan in place); FLOPs/bytes from the unrolled build, where
@@ -287,23 +275,7 @@ def main():
                 trainer = InnerTrainer(
                     cfg, tc, build_mesh("FULL_SHARD", devices=mc_devices)
                 )
-                state_sds = jax.tree.map(
-                    lambda s, sh: jax.ShapeDtypeStruct(
-                        s.shape, s.dtype, sharding=sh
-                    ),
-                    jax.eval_shape(trainer.init_state, jax.random.key(0)),
-                    trainer.state_shardings,
-                )
-                bsh = trainer.plan.sharding(
-                    trainer.plan.batch_spec(3, accum=True)
-                )
-                batch_sds = {
-                    k: jax.ShapeDtypeStruct(
-                        (accum, bs // accum, seq), np.int32, sharding=bsh
-                    )
-                    for k in ("input_ids", "labels")
-                }
-                return trainer._train_step.lower(state_sds, batch_sds).compile()
+                return trainer.lower_abstract(bs, seq, accum=accum).compile()
 
             os.environ["ODTP_SCAN_UNROLL"] = "1"
             mem = compile_mc().memory_analysis()

@@ -94,21 +94,37 @@ def flash_section():
         T = 256
     mk = lambda h, dt: jnp.asarray(rng.normal(size=(B, T, h, D)) * 0.5, dt)
 
-    # parity in f32 (kernel accumulates f32; tolerance covers bf16-free paths)
+    # Parity oracle, self-calibrating for real MXU hardware: on TPU an f32
+    # matmul runs through the MXU's bf16 passes at default precision, so
+    # plain XLA attention itself is ~1e-3 off a true-f32 result. Measure the
+    # Pallas kernel AND default-precision XLA against a HIGHEST-precision
+    # reference and require the kernel to be no worse than XLA (x4 slack).
+    # On CPU (smoke) default precision IS f32, xla_err ~ 0, and the bound
+    # reduces to the original interpret-mode 2e-3.
     q, k, v = mk(HQ, jnp.float32), mk(HKV, jnp.float32), mk(HKV, jnp.float32)
-    ref = jax.jit(functools.partial(xla_attention, causal=True))(q, k, v)
+    with jax.default_matmul_precision("float32"):
+        ref = jax.jit(functools.partial(xla_attention, causal=True))(q, k, v)
+        ref.block_until_ready()
+    xla = jax.jit(functools.partial(xla_attention, causal=True))(q, k, v)
     got = jax.jit(functools.partial(flash_attention, causal=True))(q, k, v)
+    xla_fwd_err = float(jnp.max(jnp.abs(xla - ref)))
     fwd_err = float(jnp.max(jnp.abs(got - ref)))
-    assert fwd_err < 2e-3, f"flash fwd parity: max|err|={fwd_err}"
+    fwd_tol = max(2e-3, 4.0 * xla_fwd_err)
+    assert fwd_err < fwd_tol, f"flash fwd parity: max|err|={fwd_err} tol={fwd_tol} (xla itself {xla_fwd_err})"
 
     def loss(fn, q, k, v):
         return jnp.sum(fn(q, k, v, causal=True) ** 2)
 
-    gr = jax.jit(jax.grad(functools.partial(loss, xla_attention), argnums=(0, 1, 2)))(q, k, v)
+    with jax.default_matmul_precision("float32"):
+        gr = jax.jit(jax.grad(functools.partial(loss, xla_attention), argnums=(0, 1, 2)))(q, k, v)
+        jax.block_until_ready(gr)
+    gx = jax.jit(jax.grad(functools.partial(loss, xla_attention), argnums=(0, 1, 2)))(q, k, v)
     gg = jax.jit(jax.grad(functools.partial(loss, flash_attention), argnums=(0, 1, 2)))(q, k, v)
+    xla_bwd_err = float(max(jnp.max(jnp.abs(a - b)) for a, b in zip(gr, gx)))
     bwd_err = float(max(jnp.max(jnp.abs(a - b)) for a, b in zip(gr, gg)))
     scale = float(max(jnp.max(jnp.abs(a)) for a in gr))
-    assert bwd_err < 2e-2 * max(scale, 1.0), f"flash bwd parity: max|err|={bwd_err} scale={scale}"
+    bwd_tol = max(2e-2 * max(scale, 1.0), 4.0 * xla_bwd_err)
+    assert bwd_err < bwd_tol, f"flash bwd parity: max|err|={bwd_err} tol={bwd_tol} scale={scale}"
 
     # timings in bf16 (production dtype)
     qb, kb, vb = (x.astype(jnp.bfloat16) for x in (q, k, v))
@@ -120,6 +136,7 @@ def flash_section():
         "shape": f"B{B} T{T} Hq{HQ} Hkv{HKV} D{D}",
         "fwd_max_abs_err_f32": fwd_err,
         "bwd_max_abs_err_f32": bwd_err,
+        "xla_default_precision_err": {"fwd": xla_fwd_err, "bwd": xla_bwd_err},
         "bf16_us": {
             "pallas_fwd": _timeit(f_fwd, qb, kb, vb),
             "xla_fwd": _timeit(x_fwd, qb, kb, vb),
@@ -210,15 +227,23 @@ def ring_section():
             out_specs=P(None, "sp"),
         )
     )
-    ref = jax.jit(functools.partial(xla_attention, causal=True))(q, k, v)
+    # same self-calibrating oracle as the flash section (MXU default
+    # precision makes XLA's own f32 attention ~1e-3 off true f32)
+    with jax.default_matmul_precision("float32"):
+        ref = jax.jit(functools.partial(xla_attention, causal=True))(q, k, v)
+        ref.block_until_ready()
+    xla = jax.jit(functools.partial(xla_attention, causal=True))(q, k, v)
     got = ring(q, k, v)
+    xla_fwd_err = float(jnp.max(jnp.abs(xla - ref)))
     fwd_err = float(jnp.max(jnp.abs(got - ref)))
-    assert fwd_err < 2e-3, f"ring fwd parity: max|err|={fwd_err}"
+    fwd_tol = max(2e-3, 4.0 * xla_fwd_err)
+    assert fwd_err < fwd_tol, f"ring fwd parity: max|err|={fwd_err} tol={fwd_tol} (xla itself {xla_fwd_err})"
 
     qb, kb, vb = (x.astype(jnp.bfloat16) for x in (q, k, v))
     return {
         "shape": f"B{B} T{T} Hq{HQ} Hkv{HKV} D{D} (sp=1 on one chip)",
         "fwd_max_abs_err_f32": fwd_err,
+        "xla_default_precision_err": {"fwd": xla_fwd_err},
         "bf16_us": {"ring_fwd": _timeit(ring, qb, kb, vb)},
     }
 
@@ -260,9 +285,12 @@ def main():
     xent_section()
     ring_section()
     wd.cancel()
-    _DOC["complete"] = True  # tunnel_jobs.sh retries until this is set
-    _flush()
     ok = all(s.get("ok") for s in _DOC["sections"].values())
+    # tunnel_jobs.sh retries until "complete": true — a run whose sections
+    # failed must stay retryable (round 5: the first live window banked a
+    # failed-parity artifact that would otherwise never have been retried)
+    _DOC["complete"] = bool(ok)
+    _flush()
     print(json.dumps(_DOC["sections"], indent=1, sort_keys=True))
     sys.exit(0 if ok else 5)
 

@@ -66,20 +66,23 @@ def main():
     n_chips = len(jax.devices())
     _flush()
 
-    # (model, seq, per-chip bs, accum, remat) -- known-best first so a short
-    # window still refreshes the headline; then the levers. Pruned by the
-    # deviceless AOT memory model (AOT_ROOFLINE.json, round 5): remat=False
-    # exceeds HBM at every 150m bench shape and the single-chip 1b configs
-    # exceed it at every remat -- a live window must not re-discover OOMs
-    # the compiler already proved. bs32+remat=True is the predicted winner
-    # (ceiling 0.674 vs 0.578 at bs16), so it runs right after the
-    # headline refresh.
+    # (model, seq, per-chip bs, accum, remat) -- measured-best first so a
+    # short window still refreshes the headline; then the levers. Pruned by
+    # the deviceless AOT memory model (AOT_ROOFLINE.json, round 5):
+    # remat=False exceeds HBM at every 150m bench shape and the single-chip
+    # 1b configs exceed it at every remat -- a live window must not
+    # re-discover OOMs the compiler already proved. Round 5's first live
+    # window re-ranked the levers: remat=dots bs16 measured best (61.1k
+    # tok/s, 36.2% MFU) while the AOT pick bs32 measured WORSE than bs16
+    # (56.0k vs 58.9k) -- live ordering wins over the model, so dots leads
+    # and dots-neighborhood variants (bs8/bs24) run before the bs32 check.
     plan = [
-        ("150m", 1024, 16, 1, True),
-        ("150m", 1024, 32, 1, True),
         ("150m", 1024, 16, 1, "dots"),
-        ("150m", 1024, 24, 1, True),
+        ("150m", 1024, 8, 1, "dots"),
+        ("150m", 1024, 24, 1, "dots"),
+        ("150m", 1024, 16, 1, True),
         ("150m", 1024, 8, 1, True),
+        ("150m", 1024, 32, 1, True),
         ("150m", 2048, 8, 1, True),
         ("150m", 2048, 16, 1, True),
     ]
@@ -124,7 +127,6 @@ def main():
             default=None,
         )
         if best is not None:
-            import numpy as np
 
             from opendiloco_tpu.parallel.mesh import build_mesh
             from opendiloco_tpu.trainer import InnerTrainer, TrainerConfig
@@ -136,11 +138,25 @@ def main():
                 precision="bf16-mixed", attn_impl="pallas", remat=remat,
                 fused_loss=True,
             )
-            trainer = InnerTrainer(cfg, tc, build_mesh("NO_SHARD"))
-            state = trainer.init_state(jax.random.key(0))
-            ids = np.zeros((best["per_chip_bs"] * n_chips, best["seq"]), np.int32)
-            batch = trainer.shard_batch(ids, ids.copy(), accum=best["accum"])
-            lowered = trainer._train_step.lower(state, batch)  # noqa: SLF001
+            # unroll the layer scan for the cost compile: cost_analysis
+            # counts a scan body ONCE, so the looped build under-reports
+            # FLOPs/bytes ~n_layers-fold (round 5's first live window banked
+            # a roofline with a phantom 10x measured-vs-bound gap this way;
+            # same fix as scripts/aot_roofline.py). Save/restore rather than
+            # pop: an operator-set ODTP_SCAN_UNROLL must survive for the
+            # block-sweep runs below.
+            prev_unroll = os.environ.get("ODTP_SCAN_UNROLL")
+            os.environ["ODTP_SCAN_UNROLL"] = "64"
+            try:
+                trainer = InnerTrainer(cfg, tc, build_mesh("NO_SHARD"))
+                lowered = trainer.lower_abstract(
+                    best["per_chip_bs"] * n_chips, best["seq"], accum=best["accum"]
+                )
+            finally:
+                if prev_unroll is None:
+                    os.environ.pop("ODTP_SCAN_UNROLL", None)
+                else:
+                    os.environ["ODTP_SCAN_UNROLL"] = prev_unroll
             ca = lowered.compile().cost_analysis()
             ca = ca[0] if isinstance(ca, (list, tuple)) else ca
             flops = float(ca.get("flops", 0.0))
@@ -179,6 +195,11 @@ def main():
             default=None,
         )
         if best is not None:
+            # _CTX["flops_per_token"] is whatever the LAST plan row set (the
+            # seq-2048 value in round 5's first live window, which inflated
+            # these rows' MFU by seq2048/seq1024 ~ 6.6%) -- recompute for the
+            # best row's seq
+            fpt = bench.model_flops_per_token(cfgs["150m"], best["seq"])
             for bq, bk in [(512, 512), (512, 1024), (1024, 512)]:
                 os.environ["OPENDILOCO_TPU_FLASH_BLOCKS"] = f"{bq},{bk}"
                 name = f"150m blocks={bq}x{bk}"
@@ -190,7 +211,7 @@ def main():
                             best["remat"]
                         ],
                     )
-                    mfu = tps * bench._CTX["flops_per_token"] / peak
+                    mfu = tps * fpt / peak
                     _DOC["rows"].append({
                         "model": "150m", "seq": best["seq"],
                         "per_chip_bs": best["per_chip_bs"],
