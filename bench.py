@@ -347,17 +347,22 @@ def main():
         # Measured-best first (hits the persistent compile cache, so a
         # dying window still banks a number in its first minute). Round 5's
         # live window (MFU_SWEEP.json) re-ranked the levers: remat=dots at
-        # bs16 measured best (61.1k tok/s, 36.2% MFU), and the AOT pick
-        # bs32 measured WORSE than bs16 (56.0k vs 58.9k) despite the higher
+        # per-chip bs24 measured best (62.0k tok/s, 36.8% MFU; bs16-dots
+        # 61.1k, bs28-dots 61.6k), and the AOT pick bs32+full-remat
+        # measured WORSE than bs16 (56.0k vs 58.9k) despite the higher
         # predicted ceiling -- the live ordering wins over the model.
         # remat=False is OMITTED: the AOT memory model proves it does not
         # fit HBM at these shapes (16.7G+ vs 15.75G).
+        # round the 1.5x batch to a multiple of accum (1b runs accum=4;
+        # shard_batch asserts divisibility)
+        bs_best = max(bs * 3 // 2 // accum, 1) * accum
         variants = [
+            ("pallas", True, "dots", bs_best),
             ("pallas", True, "dots", bs),
             ("pallas", True, True, bs),
-            ("pallas", True, True, 2 * bs),
             ("xla", False, True, bs),
         ]
+        variants = list(dict.fromkeys(variants))  # bs_best may equal bs (1b)
 
     # Quick first emission: time the measured-best variant with a short run
     # before the full sweep, so a tunnel that wedges mid-sweep (or the 540s

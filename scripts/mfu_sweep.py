@@ -198,8 +198,10 @@ def main():
             # _CTX["flops_per_token"] is whatever the LAST plan row set (the
             # seq-2048 value in round 5's first live window, which inflated
             # these rows' MFU by seq2048/seq1024 ~ 6.6%) -- recompute for the
-            # best row's seq
+            # best row's seq AND push it back into _CTX so bench._bank writes
+            # the same corrected MFU into BENCH_LIVE.json rows
             fpt = bench.model_flops_per_token(cfgs["150m"], best["seq"])
+            bench._CTX["flops_per_token"] = fpt
             for bq, bk in [(512, 512), (512, 1024), (1024, 512)]:
                 os.environ["OPENDILOCO_TPU_FLASH_BLOCKS"] = f"{bq},{bk}"
                 name = f"150m blocks={bq}x{bk}"
