@@ -353,9 +353,11 @@ def main():
         # predicted ceiling -- the live ordering wins over the model.
         # remat=False is OMITTED: the AOT memory model proves it does not
         # fit HBM at these shapes (16.7G+ vs 15.75G).
-        # round the 1.5x batch to a multiple of accum (1b runs accum=4;
-        # shard_batch asserts divisibility)
-        bs_best = max(bs * 3 // 2 // accum, 1) * accum
+        # round the 1.5x batch to a multiple of accum * n_chips: shard_batch
+        # asserts accum divisibility (1b runs accum=4) and each microbatch
+        # must shard evenly over the batch axis of a multi-chip mesh
+        base = accum * n_chips
+        bs_best = max(bs * 3 // 2 // base, 1) * base
         variants = [
             ("pallas", True, "dots", bs_best),
             ("pallas", True, "dots", bs),

@@ -175,6 +175,10 @@ class Config(BaseModel):
     # fused lm-head+xent Pallas kernel; None = auto (on for TPU dense models,
     # off elsewhere -- the kernel avoids the [tokens, vocab] f32 logits in HBM)
     fused_loss: Optional[bool] = None
+    # layer-scan unroll width; None = auto (full unroll on TPU for dense
+    # stacks <= 16 layers -- measured +6.8% tok/s on the HBM-bound 150m
+    # step -- and 1 elsewhere)
+    scan_unroll: Optional[int] = None
     # sp+pp cannot run ring attention; with this opt-in the sp axis shards
     # activations only (full-sequence attention per device). Without it the
     # combination is an error rather than a silent downgrade.

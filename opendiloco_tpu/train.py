@@ -102,6 +102,7 @@ def train(config: Config, backend: Optional[OuterBackend] = None) -> dict:
         attn_impl=config.attn_implementation,
         remat=config.remat,
         fused_loss=config.fused_loss,
+        scan_unroll=config.scan_unroll,
         allow_sp_activation_sharding=config.allow_sp_activation_sharding,
     )
     trainer = InnerTrainer(model_cfg, tc, plan)

@@ -53,6 +53,13 @@ class TrainerConfig:
     # avoids materializing [tokens, vocab] float32 logits in HBM.
     # None = auto (TPU dense models on, otherwise off)
     fused_loss: Optional[bool] = None
+    # layer-scan unroll width. None = auto: FULL unroll on TPU for dense
+    # models up to 16 layers (measured +6.8% tok/s at the 150m bench shape
+    # -- the HBM-bound step gains cross-layer scheduling/fusion; round-5
+    # live window), 1 elsewhere (CPU tests, MoE, deep models where the
+    # unrolled program's size would eat HBM -- the 1b looped program is
+    # already 8.2G). ODTP_SCAN_UNROLL overrides for experiments.
+    scan_unroll: Optional[int] = None
     pp_microbatches: Optional[int] = None  # pipeline microbatches (None = pp size)
     # sp+pp fallback selector. With the DEFAULT (auto) attention, sp+pp
     # composes via ring attention running inside the pipeline's manual
@@ -116,7 +123,11 @@ def _resolve_perf_defaults(
     backend (the CPU test mesh included) keeps the portable XLA paths.
     Explicit user choices pass through untouched.
     """
-    if tc.attn_impl != "auto" and tc.fused_loss is not None:
+    if (
+        tc.attn_impl != "auto"
+        and tc.fused_loss is not None
+        and tc.scan_unroll is not None
+    ):
         return tc
     dev = plan.mesh.devices.flat[0]
     on_tpu = "tpu" in getattr(dev, "device_kind", "").lower()
@@ -157,6 +168,20 @@ def _resolve_perf_defaults(
             on_tpu
             and attn == "pallas"
             and getattr(plan, "sp_axis", None) is None
+        )
+    if tc.scan_unroll is None:
+        # full unroll measured +6.8% tok/s on the HBM-bound 150m step (v5e
+        # live window, round 5: 62.0k -> 66.2k at bs24+remat=dots); gated
+        # to dense stacks <= 16 layers so deep/MoE models don't trade HBM
+        # for program size untested
+        changes["scan_unroll"] = (
+            model_cfg.num_hidden_layers
+            if (
+                on_tpu
+                and not model_cfg.num_experts
+                and model_cfg.num_hidden_layers <= 16
+            )
+            else 1
         )
     return dataclasses.replace(tc, **changes)
 
@@ -404,6 +429,7 @@ class InnerTrainer:
             compute_dtype=self.tc.compute_dtype,
             attn_impl=self.tc.attn_impl,
             remat=self.tc.remat,
+            scan_unroll=self.tc.scan_unroll,
         )
         if self.tc.fused_loss:
             out = forward(
