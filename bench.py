@@ -7,14 +7,17 @@ north-star is >=40% inner-loop MFU on llama-150m (BASELINE.json). We report
 tokens/sec/chip and vs_baseline = achieved_MFU / 0.40.
 
 Sweeps perf variants -- the measured-best pallas+fused first (hits the
-persistent compile cache, banks a nonzero number early), then the
-AOT-roofline pick (bs32 per chip; AOT_ROOFLINE.json predicts the ceiling
-rises 0.578 -> 0.674 there), then remat="dots" and the XLA baseline
+persistent compile cache, banks a nonzero number early): pallas attention,
+UNFUSED loss, remat=dots_all, per-chip bs6 under the full layer-scan
+unroll -- the config that crossed the 40% MFU north-star in round 5's
+live fine sweep (PUSH40.json: 70,273 tok/s, 41.69% MFU; the full unroll
+lets XLA fuse the lm-head itself, beating the manual fused kernel's
+slower backward), then the runner-up configs and the XLA baseline
 comparison row -- and reports the fastest. remat=False is omitted: the
 AOT memory model proves it exceeds HBM at these shapes. A wedged
 accelerator or a variant that fails to compile loses that variant, not
 the whole bench. Pin a single variant with OPENDILOCO_TPU_BENCH_ATTN /
-OPENDILOCO_TPU_BENCH_FUSED / OPENDILOCO_TPU_BENCH_REMAT (true|false|dots)
+OPENDILOCO_TPU_BENCH_FUSED / OPENDILOCO_TPU_BENCH_REMAT (true|false|dots|dots_all)
 / OPENDILOCO_TPU_BENCH_BS (per-chip batch); unset pin knobs default to
 the headline pallas+fused config.
 """
@@ -307,11 +310,11 @@ def main():
     env_attn = os.environ.get("OPENDILOCO_TPU_BENCH_ATTN")
     env_fused = os.environ.get("OPENDILOCO_TPU_BENCH_FUSED")
     env_remat = os.environ.get("OPENDILOCO_TPU_BENCH_REMAT")
-    if env_remat and env_remat.lower() not in ("true", "false", "dots"):
+    if env_remat and env_remat.lower() not in ("true", "false", "dots", "dots_all"):
         # fail loudly up front: a typo'd value would otherwise surface only
         # as a swallowed per-variant compile error and a silently-missing pin
         raise SystemExit(
-            f"OPENDILOCO_TPU_BENCH_REMAT={env_remat!r}: must be true|false|dots"
+            f"OPENDILOCO_TPU_BENCH_REMAT={env_remat!r}: must be true|false|dots|dots_all"
         )
     env_bs = os.environ.get("OPENDILOCO_TPU_BENCH_BS")
     if env_bs:
@@ -322,17 +325,19 @@ def main():
                 f"OPENDILOCO_TPU_BENCH_BS={env_bs!r}: must be a per-chip "
                 "batch size (integer)"
             )
-        if pin_bs <= 0 or pin_bs % accum:
+        if pin_bs <= 0 or pin_bs % (accum * n_chips):
             raise SystemExit(
-                f"OPENDILOCO_TPU_BENCH_BS={env_bs!r}: must be positive and "
-                f"divisible by the accumulation factor {accum}"
+                f"OPENDILOCO_TPU_BENCH_BS={env_bs!r}: global batch {pin_bs} "
+                f"must be positive and divisible by accum*chips = "
+                f"{accum * n_chips} (each microbatch shards over the "
+                "batch axis of the mesh)"
             )
     if env_attn or env_fused or env_remat or env_bs:
         # pinned single variant. Unset knobs default to the HEADLINE config
         # (pallas attention + fused loss) so pinning one lever, e.g. BS=32,
         # measures the configuration the roofline actually models; pass
         # FUSED=0 explicitly for an unfused pin
-        remat = {"false": False, "true": True, "dots": "dots"}[
+        remat = {"false": False, "true": True, "dots": "dots", "dots_all": "dots_all"}[
             (env_remat or "true").lower()
         ]
         variants = [
@@ -343,19 +348,29 @@ def main():
                 pin_bs if env_bs else bs,
             )
         ]
-    else:
+    elif model == "150m":
         # Measured-best first (hits the persistent compile cache, so a
         # dying window still banks a number in its first minute). Round 5's
-        # live window (MFU_SWEEP.json) re-ranked the levers: remat=dots at
-        # per-chip bs24 measured best (62.0k tok/s, 36.8% MFU; bs16-dots
-        # 61.1k, bs28-dots 61.6k), and the AOT pick bs32+full-remat
-        # measured WORSE than bs16 (56.0k vs 58.9k) despite the higher
-        # predicted ceiling -- the live ordering wins over the model.
-        # remat=False is OMITTED: the AOT memory model proves it does not
-        # fit HBM at these shapes (16.7G+ vs 15.75G).
-        # round the 1.5x batch to a multiple of accum * n_chips: shard_batch
-        # asserts accum divisibility (1b runs accum=4) and each microbatch
-        # must shard evenly over the batch axis of a multi-chip mesh
+        # live fine sweep (PUSH40.json) crossed the north-star with the
+        # loss UNFUSED + remat=dots_all at small per-chip batch under the
+        # full layer-scan unroll: unfused bs6 70,273 tok/s (41.69% MFU;
+        # rep 70,168), unfused bs8 68,885 (40.87%), fused bs6 68,451
+        # (40.61%). Under the unroll XLA fuses the lm-head matmul into the
+        # graph itself and the manual fused kernel's slower backward loses
+        # (KERNEL_EVIDENCE.json chained timings). remat=False is OMITTED:
+        # the AOT memory model proves it does not fit HBM at these shapes
+        # (16.7G+ vs 15.75G).
+        variants = [
+            ("pallas", False, "dots_all", 6 * n_chips),
+            ("pallas", False, "dots_all", 8 * n_chips),
+            ("pallas", True, "dots_all", 6 * n_chips),
+            ("xla", False, True, bs),
+        ]
+    else:
+        # non-headline models: best-known generic ordering. Round the 1.5x
+        # batch to a multiple of accum * n_chips: shard_batch asserts accum
+        # divisibility (1b runs accum=4) and each microbatch must shard
+        # evenly over the batch axis of a multi-chip mesh
         base = accum * n_chips
         bs_best = max(bs * 3 // 2 // base, 1) * base
         variants = [

@@ -171,7 +171,7 @@ class Config(BaseModel):
     # (reference-style per-layer checkpointing), or "dots" (save MXU outputs,
     # recompute elementwise -- near-full memory savings without the extra
     # matmul forward)
-    remat: Union[bool, Literal["none", "full", "dots"]] = True
+    remat: Union[bool, Literal["none", "full", "dots", "dots_all"]] = True
     # fused lm-head+xent Pallas kernel; None = auto (on for TPU dense models,
     # off elsewhere -- the kernel avoids the [tokens, vocab] f32 logits in HBM)
     fused_loss: Optional[bool] = None

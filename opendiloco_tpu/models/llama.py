@@ -154,8 +154,9 @@ def init_params(rng: jax.Array, cfg: LlamaConfig) -> dict:
 
 # rematerialization policy accepted everywhere a `remat` argument appears:
 # False/"none" saves all activations; True/"full" checkpoints per layer;
-# "dots" saves MXU outputs and recomputes the elementwise chain
-RematPolicy = Union[bool, Literal["none", "full", "dots"]]
+# "dots" saves MXU outputs and recomputes the elementwise chain;
+# "dots_all" additionally saves batched dots (more memory, less recompute)
+RematPolicy = Union[bool, Literal["none", "full", "dots", "dots_all"]]
 
 
 def _maybe_remat(block, remat: RematPolicy):
@@ -171,15 +172,22 @@ def _maybe_remat(block, remat: RematPolicy):
         return block
     if remat in (True, "full"):
         return jax.checkpoint(block)
-    if remat == "dots":
+    if remat in ("dots", "dots_all"):
         # also save the flash-attention outputs (tagged in
         # ops/flash_attention._flash_fwd): they are custom-calls, not dots,
         # so the dots policy alone would rerun the whole forward kernel
-        # during backward just to rebuild its residuals
+        # during backward just to rebuild its residuals. "dots_all" saves
+        # batched dots too (the XLA-attention score/weighted-sum matmuls),
+        # trading more HBM for less backward recompute
+        dots = (
+            jax.checkpoint_policies.dots_saveable
+            if remat == "dots_all"
+            else jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+        )
         return jax.checkpoint(
             block,
             policy=jax.checkpoint_policies.save_from_both_policies(
-                jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+                dots,
                 jax.checkpoint_policies.save_only_these_names(
                     "attn_out", "attn_lse"
                 ),

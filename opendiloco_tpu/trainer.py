@@ -156,19 +156,6 @@ def _resolve_perf_defaults(
                     "pallas" if on_tpu else "xla",
                 )
             changes["attn_impl"] = "pallas" if on_tpu else "xla"
-    if tc.fused_loss is None:
-        # auto-on only where the sweep measured a win: pallas attention on a
-        # non-sequence-parallel mesh (xla+fused measured slower than xla
-        # alone). Sequence-parallel meshes keep the standard loss: the
-        # fused kernel is not sequence-sharded and would gather the full
-        # [B*T, d] activations per device. (MoE composes: the router aux
-        # rides return_hidden and is added after the fused xent.)
-        attn = changes.get("attn_impl", tc.attn_impl)
-        changes["fused_loss"] = (
-            on_tpu
-            and attn == "pallas"
-            and getattr(plan, "sp_axis", None) is None
-        )
     if tc.scan_unroll is None:
         # full unroll measured +6.8% tok/s on the HBM-bound 150m step (v5e
         # live window, round 5: 62.0k -> 66.2k at bs24+remat=dots); gated
@@ -182,6 +169,29 @@ def _resolve_perf_defaults(
                 and model_cfg.num_hidden_layers <= 16
             )
             else 1
+        )
+    if tc.fused_loss is None:
+        # auto-on only where the sweep measured a win: pallas attention on a
+        # non-sequence-parallel mesh WITH the layer scan still looped.
+        # Under the full unroll (the TPU default for dense <=16-layer
+        # stacks) the round-5 chained op timings showed the fused kernel's
+        # backward is ~1.6x slower than XLA's unfused path, and end-to-end
+        # the unfused step measured faster at every batch (70.2k vs 68.5k
+        # tok/s best; PUSH40.json) -- XLA fuses the lm-head matmul into the
+        # unrolled graph itself. For looped stacks (1b, MoE, pp) the fused
+        # kernel's memory saving (no [B*T, V] logits materialization)
+        # still carries the win. Sequence-parallel meshes keep the
+        # standard loss: the fused kernel is not sequence-sharded and
+        # would gather the full [B*T, d] activations per device. (MoE
+        # composes: the router aux rides return_hidden and is added after
+        # the fused xent.)
+        attn = changes.get("attn_impl", tc.attn_impl)
+        unroll = changes.get("scan_unroll", tc.scan_unroll) or 1
+        changes["fused_loss"] = (
+            on_tpu
+            and attn == "pallas"
+            and getattr(plan, "sp_axis", None) is None
+            and unroll < model_cfg.num_hidden_layers
         )
     return dataclasses.replace(tc, **changes)
 

@@ -132,7 +132,7 @@ def main():
             from opendiloco_tpu.trainer import InnerTrainer, TrainerConfig
 
             cfg = cfgs["150m"]
-            remat = {"True": True, "False": False, "dots": "dots"}[best["remat"]]
+            remat = {"True": True, "False": False, "dots": "dots", "dots_all": "dots_all"}[best["remat"]]
             tc = TrainerConfig(
                 lr=4e-4, warmup_steps=10, total_steps=1000,
                 precision="bf16-mixed", attn_impl="pallas", remat=remat,
@@ -209,9 +209,8 @@ def main():
                     tps = bench._run_variant(
                         cfgs["150m"], "pallas", True, best["seq"],
                         best["per_chip_bs"] * n_chips, best["accum"],
-                        remat={"True": True, "False": False, "dots": "dots"}[
-                            best["remat"]
-                        ],
+                        remat={"True": True, "False": False, "dots": "dots",
+                               "dots_all": "dots_all"}[best["remat"]],
                     )
                     mfu = tps * fpt / peak
                     _DOC["rows"].append({
