@@ -1,0 +1,114 @@
+"""Live on-chip training evidence at the headline config.
+
+Runs llama-150m for N steps on the real chip with the exact auto-default
+perf config the headline bench measures (pallas attention, unfused loss,
+remat per TrainerConfig default, full layer-scan unroll) on the learnable
+deterministic ramp stream the convergence oracle uses, and records the
+loss curve. CONVERGENCE.json proves the DiLoCo outer loop converges
+on-chip at 2m scale; this artifact proves the FLAGSHIP model trains at
+the measured-throughput config (loss moves, grads finite, no NaN-scale
+events) — the piece a throughput-only bench can't show.
+
+Writes LIVE_TRAIN.json incrementally; run when the tunnel is alive.
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
+_OUT = os.path.join(_ROOT, "LIVE_TRAIN.json")
+N_STEPS = int(os.environ.get("ODTP_LIVE_TRAIN_STEPS", "400"))
+LOG_EVERY = 10
+
+
+def _flush(doc):
+    tmp = _OUT + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, indent=1)
+    os.replace(tmp, _OUT)
+
+
+def main():
+    import jax
+
+    try:
+        jax.config.update(
+            "jax_compilation_cache_dir",
+            os.environ.get("OPENDILOCO_TPU_COMPILE_CACHE", "/tmp/odtp-jax-cache"),
+        )
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass
+
+    from opendiloco_tpu.models.hf_io import get_model
+    from opendiloco_tpu.parallel.mesh import build_mesh
+    from opendiloco_tpu.trainer import InnerTrainer, TrainerConfig
+
+    doc = {
+        "model": "150m",
+        "seq": 1024,
+        "per_chip_bs": 6,
+        "n_steps": N_STEPS,
+        "platform": jax.devices()[0].platform,
+        "device": jax.devices()[0].device_kind,
+        "config": "auto defaults (pallas attn, unfused loss, full unroll) + remat=dots_all",
+        "data": "deterministic consecutive-token ramps (convergence-oracle stream)",
+        "losses": [],
+        "grad_norms": [],
+        "complete": False,
+        "started": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+    _flush(doc)
+
+    def watchdog():
+        doc["aborted"] = "watchdog 1500s (tunnel wedge)"
+        _flush(doc)
+        os._exit(0 if doc["losses"] else 4)
+
+    t = threading.Timer(1500.0, watchdog)
+    t.daemon = True
+    t.start()
+
+    cfg, _ = get_model("150m")
+    tc = TrainerConfig(
+        lr=4e-4, warmup_steps=50, total_steps=N_STEPS,
+        precision="bf16-mixed", remat="dots_all",
+    )
+    trainer = InnerTrainer(cfg, tc, build_mesh("NO_SHARD"))
+    state = trainer.init_state(jax.random.key(0))
+
+    bs, seq = 6, 1024
+    rng = np.random.default_rng(0)
+    t0 = time.time()
+    for step in range(N_STEPS):
+        starts = rng.integers(0, cfg.vocab_size, (bs, 1))
+        ids = ((starts + np.arange(seq)) % cfg.vocab_size).astype(np.int32)
+        state, m = trainer.train_step(state, trainer.shard_batch(ids, ids.copy(), accum=1))
+        if step % LOG_EVERY == 0 or step == N_STEPS - 1:
+            loss = float(m["loss"])
+            gn = float(m.get("grad_norm", float("nan")))
+            doc["losses"].append({"step": step, "loss": round(loss, 4)})
+            doc["grad_norms"].append({"step": step, "grad_norm": round(gn, 4)})
+            assert np.isfinite(loss), f"non-finite loss at step {step}"
+            _flush(doc)
+            print(f"step {step}: loss {loss:.4f} grad_norm {gn:.3f}", flush=True)
+    doc["wall_s"] = round(time.time() - t0, 1)
+    doc["tokens_per_sec"] = round(N_STEPS * bs * seq / doc["wall_s"], 1)
+    doc["complete"] = True
+    first, last = doc["losses"][0]["loss"], doc["losses"][-1]["loss"]
+    doc["loss_first_to_last"] = [first, last]
+    _flush(doc)
+    print(f"done: loss {first} -> {last} over {N_STEPS} steps", flush=True)
+    t.cancel()
+
+
+if __name__ == "__main__":
+    main()
