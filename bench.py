@@ -8,11 +8,12 @@ tokens/sec/chip and vs_baseline = achieved_MFU / 0.40.
 
 Sweeps perf variants -- the measured-best pallas+fused first (hits the
 persistent compile cache, banks a nonzero number early): pallas attention,
-UNFUSED loss, remat=dots_all, per-chip bs6 under the full layer-scan
-unroll -- the config that crossed the 40% MFU north-star in round 5's
-live fine sweep (PUSH40.json: 70,273 tok/s, 41.69% MFU; the full unroll
-lets XLA fuse the lm-head itself, beating the manual fused kernel's
-slower backward), then the runner-up configs and the XLA baseline
+UNFUSED loss, remat=False (no recompute -- it fits at small batch),
+per-chip bs8 under the full layer-scan unroll -- the config that beat the
+40% MFU north-star by 5.8 points in round 5's live fine sweep
+(PUSH40.json: 77,175 tok/s, 45.79% MFU; the full unroll lets XLA fuse
+the lm-head itself, beating the manual fused kernel's slower backward),
+then the runner-up configs and the XLA baseline
 comparison row -- and reports the fastest. remat=False is omitted: the
 AOT memory model proves it exceeds HBM at these shapes. A wedged
 accelerator or a variant that fails to compile loses that variant, not
@@ -351,19 +352,19 @@ def main():
     elif model == "150m":
         # Measured-best first (hits the persistent compile cache, so a
         # dying window still banks a number in its first minute). Round 5's
-        # live fine sweep (PUSH40.json) crossed the north-star with the
-        # loss UNFUSED + remat=dots_all at small per-chip batch under the
-        # full layer-scan unroll: unfused bs6 70,273 tok/s (41.69% MFU;
-        # rep 70,168), unfused bs8 68,885 (40.87%), fused bs6 68,451
-        # (40.61%). Under the unroll XLA fuses the lm-head matmul into the
-        # graph itself and the manual fused kernel's slower backward loses
-        # (KERNEL_EVIDENCE.json chained timings). remat=False is OMITTED:
-        # the AOT memory model proves it does not fit HBM at these shapes
-        # (16.7G+ vs 15.75G).
+        # live fine sweep (PUSH40.json) crossed the north-star and kept
+        # climbing: the winner is NO remat at all + UNFUSED loss at small
+        # per-chip batch under the full layer-scan unroll -- remat=False
+        # bs8 77,175 tok/s (45.79% MFU; bs12 77,000, bs6 76,549). The old
+        # "remat=False exceeds HBM" AOT verdict was the bs16+fused shape;
+        # at bs6-8 unfused the whole step is 6.9-8.3G of 15.75G. Unfused
+        # because under the unroll XLA fuses the lm-head matmul itself and
+        # the manual fused kernel's slower backward loses
+        # (KERNEL_EVIDENCE.json chained timings).
         variants = [
+            ("pallas", False, False, 8 * n_chips),
+            ("pallas", False, False, 12 * n_chips),
             ("pallas", False, "dots_all", 6 * n_chips),
-            ("pallas", False, "dots_all", 8 * n_chips),
-            ("pallas", True, "dots_all", 6 * n_chips),
             ("xla", False, True, bs),
         ]
     else:

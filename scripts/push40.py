@@ -85,20 +85,18 @@ def main():
     # peak; round 2 probes fine batch steps around it, repeat reps of the
     # best config, and the new dots_all policy (save batched dots too:
     # less bwd recompute for more HBM).
-    # round 5: the chained op-level timings (KERNEL_EVIDENCE.json) showed
-    # the fused-xent BACKWARD is slower than XLA's; an unfused pin at the
-    # best config measured 70,273 tok/s (41.69% MFU) -- the fused-loss win
-    # was a looped-scan/bigger-batch regime. Sweep unfused x {dots,
-    # dots_all} x fine batch; plan rows are (bs, blocks, remat, fused).
+    # round 6: the AOT memory model proves remat=False FITS at small batch
+    # unfused (bs6 6.94G, bs8 8.29G of 15.75G -- the old "does not fit"
+    # verdict was the bs16+fused shape), and the live pin measured 73,964
+    # tok/s (43.88% MFU). Probe the no-recompute neighborhood; plan rows
+    # are (bs, blocks, remat, fused).
+    # round 7: confirm the bs8-12 no-recompute plateau (77.2k/77.0k) with
+    # reps and fill bs10
     plan = [
-        (6, None, "dots_all", False),
-        (8, None, "dots_all", False),
-        (4, None, "dots_all", False),
-        (6, None, "dots", False),
-        (12, None, "dots_all", False),
-        (16, None, "dots_all", False),
-        (24, None, "dots", False),
-        (6, None, "dots_all", False),
+        (8, None, False, False),
+        (10, None, False, False),
+        (12, None, False, False),
+        (8, None, False, False),
     ]
     for row in plan:
         per_bs, blocks, remat = row[:3]
