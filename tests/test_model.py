@@ -105,7 +105,7 @@ def test_torch_parity(tmp_path, tiny_cfg):
     np.testing.assert_allclose(ours, ref, atol=5e-3, rtol=5e-2)
 
 
-@pytest.mark.parametrize("remat", [False, True, "none", "full", "dots"])
+@pytest.mark.parametrize("remat", [False, True, "none", "full", "dots", "dots_all"])
 def test_remat_policies_forward_and_grad_parity(tiny_cfg, remat):
     """Every remat policy is pure memory/schedule choice: forward logits and
     parameter gradients must match the no-remat baseline exactly (fp32)."""
