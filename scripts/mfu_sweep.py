@@ -66,28 +66,25 @@ def main():
     n_chips = len(jax.devices())
     _flush()
 
-    # (model, seq, per-chip bs, accum, remat) -- measured-best first so a
-    # short window still refreshes the headline; then the levers. Pruned by
-    # the deviceless AOT memory model (AOT_ROOFLINE.json, round 5):
-    # remat=False exceeds HBM at every 150m bench shape and the single-chip
-    # 1b configs exceed it at every remat -- a live window must not
-    # re-discover OOMs the compiler already proved. Round 5's first live
-    # window re-ranked the levers: remat=dots bs16 measured best (61.1k
-    # tok/s, 36.2% MFU) while the AOT pick bs32 measured WORSE than bs16
-    # (56.0k vs 58.9k) -- live ordering wins over the model, so dots leads
-    # and dots-neighborhood variants (bs8/bs24) run before the bs32 check.
+    # (model, seq, per-chip bs, accum, remat, fused) -- measured-best first
+    # so a short window still refreshes the headline; then the levers.
+    # Round 5's fine sweeps (PUSH40.json) moved the winner twice: the
+    # headline is now NO remat + UNFUSED loss at small per-chip batch
+    # under the full layer-scan unroll (bs8 77.2k tok/s, 45.8% MFU; the
+    # old remat=False OOM verdict was the bs16+fused shape). The 1b
+    # single-chip configs still exceed HBM at every remat (AOT-proved) --
+    # a live window must not re-discover those OOMs.
     plan = [
-        ("150m", 1024, 16, 1, "dots"),
-        ("150m", 1024, 8, 1, "dots"),
-        ("150m", 1024, 24, 1, "dots"),
-        ("150m", 1024, 16, 1, True),
-        ("150m", 1024, 8, 1, True),
-        ("150m", 1024, 32, 1, True),
-        ("150m", 2048, 8, 1, True),
-        ("150m", 2048, 16, 1, True),
+        ("150m", 1024, 8, 1, False, False),
+        ("150m", 1024, 10, 1, False, False),
+        ("150m", 1024, 6, 1, "dots_all", False),
+        ("150m", 1024, 24, 1, "dots", True),
+        ("150m", 1024, 16, 1, True, True),
+        ("150m", 2048, 8, 1, True, True),
+        ("150m", 2048, 16, 1, True, True),
     ]
     cfgs = {}
-    for model, seq, bs, accum, remat in plan:
+    for model, seq, bs, accum, remat, fused in plan:
         if model not in cfgs:
             cfgs[model] = get_model(model)[0]
         cfg = cfgs[model]
@@ -101,17 +98,18 @@ def main():
         name = f"{model} seq{seq} bs{bs} accum{accum} remat={remat}"
         try:
             tps = bench._run_variant(
-                cfg, "pallas", True, seq, bs * n_chips, accum, remat=remat
+                cfg, "pallas", fused, seq, bs * n_chips, accum, remat=remat
             )
             mfu = tps * bench._CTX["flops_per_token"] / peak
+            attn_label = "pallas+fused" if fused else "pallas"
             row = {
                 "model": model, "seq": seq, "per_chip_bs": bs, "accum": accum,
-                "remat": str(remat), "attn": "pallas+fused",
+                "remat": str(remat), "attn": attn_label,
                 "tokens_per_sec_per_chip": round(tps, 1),
                 "mfu": round(mfu, 4),
             }
             _DOC["rows"].append(row)
-            bench._bank(model, f"pallas+fused+remat={remat}+bs{bs}+seq{seq}", tps)
+            bench._bank(model, f"{attn_label}+remat={remat}+bs{bs}+seq{seq}", tps)
             print(f"# {name}: {tps:.0f} tok/s/chip, {mfu:.1%} MFU", flush=True)
         except Exception as e:
             _DOC["rows"].append({"config": name, "error": f"{type(e).__name__}: {e}"})
@@ -136,7 +134,7 @@ def main():
             tc = TrainerConfig(
                 lr=4e-4, warmup_steps=10, total_steps=1000,
                 precision="bf16-mixed", attn_impl="pallas", remat=remat,
-                fused_loss=True,
+                fused_loss="fused" in best.get("attn", "pallas+fused"),
             )
             # unroll the layer scan for the cost compile: cost_analysis
             # counts a scan body ONCE, so the looped build under-reports
@@ -202,12 +200,14 @@ def main():
             # the same corrected MFU into BENCH_LIVE.json rows
             fpt = bench.model_flops_per_token(cfgs["150m"], best["seq"])
             bench._CTX["flops_per_token"] = fpt
+            best_fused = "fused" in best.get("attn", "pallas+fused")
+            best_attn = "pallas+fused" if best_fused else "pallas"
             for bq, bk in [(512, 512), (512, 1024), (1024, 512)]:
                 os.environ["OPENDILOCO_TPU_FLASH_BLOCKS"] = f"{bq},{bk}"
                 name = f"150m blocks={bq}x{bk}"
                 try:
                     tps = bench._run_variant(
-                        cfgs["150m"], "pallas", True, best["seq"],
+                        cfgs["150m"], "pallas", best_fused, best["seq"],
                         best["per_chip_bs"] * n_chips, best["accum"],
                         remat={"True": True, "False": False, "dots": "dots",
                                "dots_all": "dots_all"}[best["remat"]],
@@ -217,11 +217,11 @@ def main():
                         "model": "150m", "seq": best["seq"],
                         "per_chip_bs": best["per_chip_bs"],
                         "accum": best["accum"], "remat": best["remat"],
-                        "attn": f"pallas+fused blocks={bq}x{bk}",
+                        "attn": f"{best_attn} blocks={bq}x{bk}",
                         "tokens_per_sec_per_chip": round(tps, 1),
                         "mfu": round(mfu, 4),
                     })
-                    bench._bank("150m", f"pallas+fused+blocks={bq}x{bk}", tps)
+                    bench._bank("150m", f"{best_attn}+blocks={bq}x{bk}", tps)
                     print(f"# {name}: {tps:.0f} tok/s/chip, {mfu:.1%}", flush=True)
                 except Exception as e:
                     _DOC["rows"].append(
