@@ -14,8 +14,7 @@ per-chip bs8 under the full layer-scan unroll -- the config that beat the
 (PUSH40.json: 77,175 tok/s, 45.79% MFU; the full unroll lets XLA fuse
 the lm-head itself, beating the manual fused kernel's slower backward),
 then the runner-up configs and the XLA baseline
-comparison row -- and reports the fastest. remat=False is omitted: the
-AOT memory model proves it exceeds HBM at these shapes. A wedged
+comparison row -- and reports the fastest. A wedged
 accelerator or a variant that fails to compile loses that variant, not
 the whole bench. Pin a single variant with OPENDILOCO_TPU_BENCH_ATTN /
 OPENDILOCO_TPU_BENCH_FUSED / OPENDILOCO_TPU_BENCH_REMAT (true|false|dots|dots_all)

@@ -110,8 +110,9 @@ def main():
             "Caveat: the unrolled build used for cost_analysis lets XLA CSE "
             "part of the remat recompute (recompute_factor < 1 means the "
             "counted FLOPs approximate the no-remat ideal); the memory "
-            "verdicts come from the looped build that actually runs, so "
-            "fits_hbm/oom are faithful"
+            "verdicts compile the program the trainer actually runs "
+            "(full unroll for dense <=16-layer stacks, looped otherwise), "
+            "so fits_hbm/oom are faithful to the runtime default"
         ),
         "rows": [],
     }
@@ -169,10 +170,20 @@ def main():
                 )
                 return trainer.lower_abstract(bs, seq, accum=accum).compile()
 
-            # memory footprint from the program that actually runs (layer
-            # scan in place); FLOPs/bytes from the unrolled build, where
-            # cost_analysis sees every layer instead of one loop body
-            os.environ["ODTP_SCAN_UNROLL"] = "1"
+            # memory footprint from the program that actually runs: the
+            # trainer's auto default FULLY unrolls dense stacks <= 16
+            # layers on TPU (looped otherwise) -- round 5 found the looped
+            # build can mis-verdict the unrolled runtime in both
+            # directions (bs10 no-remat "doesn't fit" looped yet runs
+            # live). FLOPs/bytes always come from the unrolled build,
+            # where cost_analysis sees every layer instead of one loop
+            # body
+            runtime_unroll = (
+                cfg.num_hidden_layers
+                if (not cfg.num_experts and cfg.num_hidden_layers <= 16)
+                else 1
+            )
+            os.environ["ODTP_SCAN_UNROLL"] = str(runtime_unroll)
             mem = compile_step().memory_analysis()
             os.environ["ODTP_SCAN_UNROLL"] = "64"
             ca = compile_step().cost_analysis()
