@@ -266,10 +266,10 @@ def main():
         (r["model"], r["per_chip_batch"], r["accum"], r["remat"])
         for r in doc["multichip_rows"]
     }
-    for model, seq, bs_chip, accum, remat in (
-        ("1b", 1024, 4, 4, True),
-        ("1b", 1024, 8, 2, True),
-        ("150m", 1024, 16, 1, True),
+    for model, seq, bs_chip, accum, remat, fused in (
+        ("1b", 1024, 4, 4, True, True),
+        ("1b", 1024, 8, 2, True, True),
+        ("150m", 1024, 16, 1, True, False),
     ):
         if (model, bs_chip, accum, str(remat)) in have_mc:
             continue
@@ -278,7 +278,8 @@ def main():
         row = {
             "model": model, "seq": seq, "chips": 4,
             "strategy": "FULL_SHARD", "per_chip_batch": bs_chip,
-            "accum": accum, "remat": str(remat), "attn": "pallas+fused",
+            "accum": accum, "remat": str(remat),
+            "attn": "pallas+fused" if fused else "pallas",
         }
         try:
             if model not in cfg_cache:
@@ -287,7 +288,7 @@ def main():
             tc = TrainerConfig(
                 lr=4e-4, warmup_steps=10, total_steps=1000,
                 precision="bf16-mixed", attn_impl="pallas", remat=remat,
-                fused_loss=True,
+                fused_loss=fused,
             )
             mc_devices = list(topo.devices)[:4]
             bs = bs_chip * 4
@@ -298,7 +299,13 @@ def main():
                 )
                 return trainer.lower_abstract(bs, seq, accum=accum).compile()
 
-            os.environ["ODTP_SCAN_UNROLL"] = "1"
+            # same runtime-unroll memory basis as the single-chip rows
+            runtime_unroll = (
+                cfg.num_hidden_layers
+                if (not cfg.num_experts and cfg.num_hidden_layers <= 16)
+                else 1
+            )
+            os.environ["ODTP_SCAN_UNROLL"] = str(runtime_unroll)
             mem = compile_mc().memory_analysis()
             os.environ["ODTP_SCAN_UNROLL"] = "64"
             ca = compile_mc().cost_analysis()
