@@ -154,6 +154,25 @@ class DiLoCoOptimizer:
 
         if self.backend is not None:
             self.backend.serve_state(self._state_for_peers)
+            # announce at join, BEFORE the first (slow) inner-step compile:
+            # progress gossip is what makes this peer visible to the other
+            # workers' WAIT_FOR_ALL polling (backend.py wait_for_peers). The
+            # first in-step report only happens after the first train_step
+            # returns (~minutes of XLA compile on a cold cache), and an
+            # unannounced peer reads as "no other peers known" to a faster
+            # worker, which then matchmakes a solo group — observed live on
+            # TPU with two staggered 150m workers. The reference announces
+            # tracker state on join (hivemind_diloco.py:174-282 progress
+            # tracker starts reporting at construction).
+            self.backend.report_progress(
+                PeerProgress(
+                    peer_id=self.backend.peer_id,
+                    epoch=self.epoch,
+                    samples=0,
+                    samples_per_second=0.0,
+                    timestamp=time.time(),
+                )
+            )
 
     def _pseudo_grad_into(self, boundary: list, slot: int) -> list[np.ndarray]:
         """master - boundary, written into the persistent slot buffers."""

@@ -1010,3 +1010,31 @@ def test_gossip_four_workers_mix_and_learn(tiny_cfg):
         np.abs(a - b).max() for i, a in enumerate(flat) for b in flat[i + 1:]
     )
     assert spread < 0.5 * scale
+
+
+def test_optimizer_announces_progress_at_construction(tiny_cfg):
+    """A worker must be visible to peers' WAIT_FOR_ALL polling from the
+    moment its optimizer exists — NOT only after its first train_step
+    returns. Before the join-time announce, a worker still inside its
+    first (slow) XLA compile was invisible to a faster peer, which then
+    read "no other peers known" and matchmade a solo outer group
+    (observed live: two staggered 150m workers each all-reduced over 1
+    peer). The reference's progress tracker reports from construction
+    (hivemind_diloco.py:174-282)."""
+    trainer = make_trainer(tiny_cfg)
+    state = trainer.init_state(jax.random.key(7))
+    world = LoopbackWorld(2)
+    backends = world.make_backends()
+    DiLoCoOptimizer(
+        trainer,
+        backends[0],
+        DilocoConfig(local_steps=4, backend="loopback"),
+        state,
+        batch_size=8,
+    )
+    # worker-1 has constructed no optimizer and taken no step: it must
+    # already see worker-0 at epoch 0 through the progress gossip
+    seen = {p.peer_id: p for p in backends[1].peer_progress()}
+    assert backends[0].peer_id in seen
+    assert seen[backends[0].peer_id].epoch == 0
+    assert seen[backends[0].peer_id].samples == 0
