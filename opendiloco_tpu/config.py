@@ -60,7 +60,15 @@ class DilocoConfig(BaseModel):
     all_reduce_strategy: Literal["wait_for_all", "no_wait"] = "wait_for_all"
     timeout_waiting_for_peers: float = 600.0
     averaging_timeout: float = 300.0
-    matchmaking_time: float = 5.0
+    # matchmaking window for outer-round group formation. Must cover the
+    # gap between a peer REPORTING its epoch boundary and it actually
+    # joining matchmaking -- which includes the device->host boundary
+    # param fetch (measured ~35 s for 150m through a slow transport; scale
+    # with model size). A large window costs nothing when peers are
+    # prompt: the rendezvous closes the round early once every live
+    # registered peer has joined (rendezvous.py). 5 s windows made two
+    # staggered live 150m workers matchmake SOLO groups every round.
+    matchmaking_time: float = 30.0
     fail_rank_drop: bool = False  # crash if a peer drops (train_fsdp.py:93)
 
     # wire compression for the outer all-reduce (utils.py:83-121)
