@@ -67,8 +67,9 @@ class DilocoConfig(BaseModel):
     # with model size). A large window costs nothing when peers are
     # prompt: the rendezvous closes the round early once every live
     # registered peer has joined (rendezvous.py). 5 s windows made two
-    # staggered live 150m workers matchmake SOLO groups every round.
-    matchmaking_time: float = 30.0
+    # staggered live 150m workers matchmake SOLO groups every round; the
+    # banked paired run (LIVE_DILOCO_TCP.json) used this 60 s default.
+    matchmaking_time: float = 60.0
     fail_rank_drop: bool = False  # crash if a peer drops (train_fsdp.py:93)
 
     # wire compression for the outer all-reduce (utils.py:83-121)

@@ -125,8 +125,20 @@ def wait_for_peers(
         behind = [
             p
             for p in others
-            if p.epoch < own_epoch
-            or (p.epoch == own_epoch and p.samples < target_samples)
+            # Peers >=2 epochs behind are NOT worth waiting for: they will
+            # discard their stale phase and desync-onboard at their next
+            # epoch start (optimizer._desynced, mirroring the reference's
+            # hivemind_diloco.py:528-531 threshold), so stalling the round
+            # on them buys nothing. Without this, a fresh joiner's
+            # join-time announce (epoch 0, sps 0 -> eta inf) would stall
+            # every established peer's boundary for the full
+            # timeout_waiting_for_peers while the joiner sits in its first
+            # cold compile.
+            if own_epoch - p.epoch < 2
+            and (
+                p.epoch < own_epoch
+                or (p.epoch == own_epoch and p.samples < target_samples)
+            )
         ]
         if not behind:
             return

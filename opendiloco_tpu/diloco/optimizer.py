@@ -37,6 +37,11 @@ from opendiloco_tpu.utils.logger import get_text_logger
 
 log = get_text_logger(__name__)
 
+# join-keepalive cadence: must beat the rendezvous registration TTL (60 s
+# default in both daemons) so a worker stuck in its first multi-minute XLA
+# compile is never reaped as dead before taking a step
+_ANNOUNCE_INTERVAL_S = 15.0
+
 
 class PeerDropError(RuntimeError):
     """Raised when a DiLoCo worker disappears and fail_rank_drop is set
@@ -163,16 +168,37 @@ class DiLoCoOptimizer:
             # worker, which then matchmakes a solo group — observed live on
             # TPU with two staggered 150m workers. The reference announces
             # tracker state on join (hivemind_diloco.py:174-282 progress
-            # tracker starts reporting at construction).
-            self.backend.report_progress(
-                PeerProgress(
-                    peer_id=self.backend.peer_id,
-                    epoch=self.epoch,
-                    samples=0,
-                    samples_per_second=0.0,
-                    timestamp=time.time(),
-                )
+            # tracker starts reporting at construction). A single announce
+            # is NOT enough: the rendezvous registration TTL (60 s default)
+            # would expire during a multi-minute silent compile and the
+            # daemon would reap the peer, so a background thread keeps
+            # re-announcing until the first step() lands.
+            self._announce(samples=0, sps=0.0)
+            self._first_step_evt = threading.Event()
+
+            def _keepalive():
+                while not self._first_step_evt.wait(_ANNOUNCE_INTERVAL_S):
+                    try:
+                        self._announce(samples=0, sps=0.0)
+                    except Exception as e:  # never kill the joiner over gossip
+                        log.warning("join keepalive announce failed: %s", e)
+
+            t = threading.Thread(target=_keepalive, daemon=True)
+            t.start()
+
+    def _announce(self, *, samples: int, sps: float) -> None:
+        """Report this peer's progress to the gossip fabric (the one
+        construction site for PeerProgress: join announce, compile
+        keepalive, and the in-step report all go through here)."""
+        self.backend.report_progress(
+            PeerProgress(
+                peer_id=self.backend.peer_id,
+                epoch=self.epoch,
+                samples=samples,
+                samples_per_second=sps,
+                timestamp=time.time(),
             )
+        )
 
     def _pseudo_grad_into(self, boundary: list, slot: int) -> list[np.ndarray]:
         """master - boundary, written into the persistent slot buffers."""
@@ -341,6 +367,8 @@ class DiLoCoOptimizer:
         state, metrics = self.trainer.train_step(state, batch)
         self.local_step += 1
         self.samples_in_epoch += self.batch_size
+        if self.backend is not None and not self._first_step_evt.is_set():
+            self._first_step_evt.set()  # stop the join keepalive announcer
 
         # progress gossip is a synchronous rendezvous RPC on the TCP backend;
         # rate-limit it so the training loop never blocks on it per-step
@@ -352,14 +380,9 @@ class DiLoCoOptimizer:
         ):
             self._last_report = now
             elapsed = max(now - self._epoch_t0, 1e-6)
-            self.backend.report_progress(
-                PeerProgress(
-                    peer_id=self.backend.peer_id,
-                    epoch=self.epoch,
-                    samples=self.samples_in_epoch,
-                    samples_per_second=self.samples_in_epoch / elapsed,
-                    timestamp=time.time(),
-                )
+            self._announce(
+                samples=self.samples_in_epoch,
+                sps=self.samples_in_epoch / elapsed,
             )
 
         metrics = dict(metrics)

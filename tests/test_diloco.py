@@ -10,6 +10,7 @@ normative algorithm of train_diloco_torch.py:336-353):
 """
 
 import threading
+import time
 
 import jax
 import numpy as np
@@ -1038,3 +1039,82 @@ def test_optimizer_announces_progress_at_construction(tiny_cfg):
     assert backends[0].peer_id in seen
     assert seen[backends[0].peer_id].epoch == 0
     assert seen[backends[0].peer_id].samples == 0
+
+
+def test_join_keepalive_reannounces_until_first_step(tiny_cfg, monkeypatch):
+    """One announce at construction is not enough: the rendezvous TTL (60s)
+    would reap a worker whose first XLA compile is silent for minutes. A
+    background thread must keep re-announcing until the first step lands."""
+    import opendiloco_tpu.diloco.optimizer as opt_mod
+
+    monkeypatch.setattr(opt_mod, "_ANNOUNCE_INTERVAL_S", 0.05)
+    trainer = make_trainer(tiny_cfg)
+    state = trainer.init_state(jax.random.key(7))
+    world = LoopbackWorld(1)
+    (backend,) = world.make_backends()
+    reports = []
+    orig = backend.report_progress
+    backend.report_progress = lambda p: (reports.append(p), orig(p))
+    opt = DiLoCoOptimizer(
+        trainer,
+        backend,
+        DilocoConfig(local_steps=4, backend="loopback"),
+        state,
+        batch_size=8,
+    )
+    time.sleep(0.4)
+    assert len(reports) >= 3, "keepalive must re-announce during the compile"
+    # the first step stops the keepalive
+    ids, labels = next(batches(0, tiny_cfg.vocab_size, 1))
+    state, _ = opt.step(state, trainer.shard_batch(ids, labels, accum=1))
+    time.sleep(0.2)
+    n = len(reports)
+    time.sleep(0.3)
+    assert len(reports) == n, "keepalive must stop after the first step"
+
+
+def test_wait_for_peers_ignores_far_behind_joiners():
+    """A fresh joiner announcing epoch 0 (sps 0 -> eta inf) must NOT stall
+    an established swarm's boundary: peers >=2 epochs behind will desync-
+    onboard anyway (optimizer._desynced), so waiting on them buys nothing."""
+    from opendiloco_tpu.diloco.backend import PeerProgress, wait_for_peers
+
+    class StubBackend:
+        peer_id = "me"
+
+        def peer_progress(self):
+            return [
+                PeerProgress("me", epoch=50, samples=64, samples_per_second=10.0, timestamp=time.time()),
+                PeerProgress("joiner", epoch=0, samples=0, samples_per_second=0.0, timestamp=time.time()),
+            ]
+
+    t0 = time.monotonic()
+    wait_for_peers(
+        StubBackend(),
+        target_samples=64,
+        own_epoch=50,
+        strategy="wait_for_all",
+        timeout_waiting_for_peers=5.0,
+        log=None,
+    )
+    assert time.monotonic() - t0 < 1.0, "must return without waiting on the epoch-0 joiner"
+
+    # a peer ONE epoch behind (normal near boundaries) still holds the
+    # round (slow enough that the ETA fast-path doesn't fire)
+    class StubBehind(StubBackend):
+        def peer_progress(self):
+            return [
+                PeerProgress("me", epoch=50, samples=64, samples_per_second=10.0, timestamp=time.time()),
+                PeerProgress("lag", epoch=49, samples=32, samples_per_second=1.0, timestamp=time.time()),
+            ]
+
+    t0 = time.monotonic()
+    wait_for_peers(
+        StubBehind(),
+        target_samples=64,
+        own_epoch=50,
+        strategy="wait_for_all",
+        timeout_waiting_for_peers=0.5,
+        log=None,
+    )
+    assert time.monotonic() - t0 >= 0.5, "one-epoch-behind peers must still be waited for"
