@@ -177,11 +177,19 @@ class DiLoCoOptimizer:
             self._first_step_evt = threading.Event()
 
             def _keepalive():
+                failures = 0
                 while not self._first_step_evt.wait(_ANNOUNCE_INTERVAL_S):
                     try:
                         self._announce(samples=0, sps=0.0)
+                        failures = 0
                     except Exception as e:  # never kill the joiner over gossip
+                        failures += 1
                         log.warning("join keepalive announce failed: %s", e)
+                        if failures >= 3:
+                            # backend closed / rendezvous gone: stop warning
+                            # forever; the in-step report path takes over if
+                            # the worker ever steps
+                            return
 
             t = threading.Thread(target=_keepalive, daemon=True)
             t.start()
