@@ -25,7 +25,7 @@ if _ROOT not in sys.path:
     sys.path.insert(0, _ROOT)
 
 _OUT = os.path.join(_ROOT, "LIVE_TRAIN.json")
-N_STEPS = int(os.environ.get("ODTP_LIVE_TRAIN_STEPS", "400"))
+N_STEPS = int(os.environ.get("ODTP_LIVE_TRAIN_STEPS", "1500"))
 LOG_EVERY = 10
 
 
@@ -55,11 +55,11 @@ def main():
     doc = {
         "model": "150m",
         "seq": 1024,
-        "per_chip_bs": 6,
+        "per_chip_bs": 8,
         "n_steps": N_STEPS,
         "platform": jax.devices()[0].platform,
         "device": jax.devices()[0].device_kind,
-        "config": "auto defaults (pallas attn, unfused loss, full unroll) + remat=dots_all",
+        "config": "the 45.8%-MFU headline config: auto defaults (pallas attn, unfused loss, full unroll) + remat=False, per-chip bs8",
         "data": "deterministic consecutive-token ramps (convergence-oracle stream)",
         "losses": [],
         "grad_norms": [],
@@ -80,12 +80,12 @@ def main():
     cfg, _ = get_model("150m")
     tc = TrainerConfig(
         lr=4e-4, warmup_steps=50, total_steps=N_STEPS,
-        precision="bf16-mixed", remat="dots_all",
+        precision="bf16-mixed", remat=False,
     )
     trainer = InnerTrainer(cfg, tc, build_mesh("NO_SHARD"))
     state = trainer.init_state(jax.random.key(0))
 
-    bs, seq = 6, 1024
+    bs, seq = 8, 1024
     rng = np.random.default_rng(0)
     t0 = time.time()
     for step in range(N_STEPS):
