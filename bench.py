@@ -6,14 +6,14 @@ The reference publishes no in-tree numbers (BASELINE.md); the driver-specified
 north-star is >=40% inner-loop MFU on llama-150m (BASELINE.json). We report
 tokens/sec/chip and vs_baseline = achieved_MFU / 0.40.
 
-Sweeps perf variants -- the measured-best pallas+fused first (hits the
-persistent compile cache, banks a nonzero number early): pallas attention,
-UNFUSED loss, remat=False (no recompute -- it fits at small batch),
-per-chip bs8 under the full layer-scan unroll -- the config that beat the
-40% MFU north-star by 5.8 points in round 5's live fine sweep
-(PUSH40.json: 77,175 tok/s, 45.79% MFU; the full unroll lets XLA fuse
-the lm-head itself, beating the manual fused kernel's slower backward),
-then the runner-up configs and the XLA baseline
+Sweeps perf variants -- the measured-best first (hits the persistent
+compile cache, banks a nonzero number early): pallas attention, UNFUSED
+loss, remat=False (no recompute -- it fits at small batch), per-chip
+bs13 under the full layer-scan unroll -- the config that beat the 40%
+MFU north-star by 6.6 points in round 5's live fine sweep (best
+end-to-end emission 78,541 tok/s, 46.60% MFU; the full unroll lets XLA
+fuse the lm-head itself, beating the manual fused kernel's slower
+backward), then the runner-up configs and the XLA baseline
 comparison row -- and reports the fastest. A wedged
 accelerator or a variant that fails to compile loses that variant, not
 the whole bench. Pin a single variant with OPENDILOCO_TPU_BENCH_ATTN /
@@ -353,16 +353,18 @@ def main():
         # dying window still banks a number in its first minute). Round 5's
         # live fine sweep (PUSH40.json) crossed the north-star and kept
         # climbing: the winner is NO remat at all + UNFUSED loss at small
-        # per-chip batch under the full layer-scan unroll -- remat=False
-        # bs8 77,175 tok/s (45.79% MFU; bs12 77,000, bs6 76,549). The old
+        # per-chip batch under the full layer-scan unroll -- the bs8-15
+        # region is one plateau (77-78k, run jitter ~1.5%): bs13 best
+        # single row 78,317 tok/s (46.47% MFU), bs8 77,175 (45.79%). The
+        # old
         # "remat=False exceeds HBM" AOT verdict was the bs16+fused shape;
         # at bs6-8 unfused the whole step is 6.9-8.3G of 15.75G. Unfused
         # because under the unroll XLA fuses the lm-head matmul itself and
         # the manual fused kernel's slower backward loses
         # (KERNEL_EVIDENCE.json chained timings).
         variants = [
+            ("pallas", False, False, 13 * n_chips),
             ("pallas", False, False, 8 * n_chips),
-            ("pallas", False, False, 12 * n_chips),
             ("pallas", False, "dots_all", 6 * n_chips),
             ("xla", False, True, bs),
         ]
