@@ -175,33 +175,51 @@ class DiLoCoOptimizer:
             # re-announcing until the first step() lands.
             self._announce(samples=0, sps=0.0)
             self._first_step_evt = threading.Event()
+            self._announce_lock = threading.Lock()
+            # the keepalive pins the epoch it announced at JOIN: desync
+            # onboarding teleports self.epoch to the swarm's value before
+            # the first (slow) compile, and a keepalive announcing the
+            # swarm epoch with samples=0 / sps=0 (eta inf) would stall
+            # every established peer's WAIT_FOR_ALL for the full timeout;
+            # announcing the join epoch keeps the compiling joiner behind
+            # the >=2-epoch discount in backend.wait_for_peers until its
+            # first real report
+            join_epoch = self.epoch
 
             def _keepalive():
                 failures = 0
                 while not self._first_step_evt.wait(_ANNOUNCE_INTERVAL_S):
-                    try:
-                        self._announce(samples=0, sps=0.0)
-                        failures = 0
-                    except Exception as e:  # never kill the joiner over gossip
-                        failures += 1
-                        log.warning("join keepalive announce failed: %s", e)
-                        if failures >= 3:
-                            # backend closed / rendezvous gone: stop warning
-                            # forever; the in-step report path takes over if
-                            # the worker ever steps
+                    # check+announce atomic vs the first step's report: a
+                    # tick already past wait() must not publish a stale
+                    # samples=0 row AFTER the first in-step report landed
+                    with self._announce_lock:
+                        if self._first_step_evt.is_set():
                             return
+                        try:
+                            self._announce(samples=0, sps=0.0, epoch=join_epoch)
+                            failures = 0
+                        except Exception as e:  # never kill the joiner over gossip
+                            failures += 1
+                            log.warning("join keepalive announce failed: %s", e)
+                            if failures >= 3:
+                                # backend closed / rendezvous gone: stop
+                                # warning forever; the in-step report path
+                                # takes over if the worker ever steps
+                                return
 
             t = threading.Thread(target=_keepalive, daemon=True)
             t.start()
 
-    def _announce(self, *, samples: int, sps: float) -> None:
+    def _announce(
+        self, *, samples: int, sps: float, epoch: Optional[int] = None
+    ) -> None:
         """Report this peer's progress to the gossip fabric (the one
         construction site for PeerProgress: join announce, compile
         keepalive, and the in-step report all go through here)."""
         self.backend.report_progress(
             PeerProgress(
                 peer_id=self.backend.peer_id,
-                epoch=self.epoch,
+                epoch=self.epoch if epoch is None else epoch,
                 samples=samples,
                 samples_per_second=sps,
                 timestamp=time.time(),
@@ -376,7 +394,11 @@ class DiLoCoOptimizer:
         self.local_step += 1
         self.samples_in_epoch += self.batch_size
         if self.backend is not None and not self._first_step_evt.is_set():
-            self._first_step_evt.set()  # stop the join keepalive announcer
+            # stop the join keepalive announcer; under the lock so an
+            # in-flight keepalive tick finishes its (stale) announce BEFORE
+            # this step's fresh report below can be overwritten by it
+            with self._announce_lock:
+                self._first_step_evt.set()
 
         # progress gossip is a synchronous rendezvous RPC on the TCP backend;
         # rate-limit it so the training loop never blocks on it per-step

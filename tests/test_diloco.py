@@ -1064,6 +1064,18 @@ def test_join_keepalive_reannounces_until_first_step(tiny_cfg, monkeypatch):
     )
     time.sleep(0.4)
     assert len(reports) >= 3, "keepalive must re-announce during the compile"
+    # keepalive announces the JOIN epoch even after onboarding teleports
+    # self.epoch (a compiling joiner must stay behind wait_for_peers'
+    # >=2-epoch discount, not stall the swarm with an inf-ETA row at the
+    # swarm's own epoch)
+    opt.epoch = 50
+    n_before = len(reports)
+    time.sleep(0.3)
+    assert len(reports) > n_before
+    assert all(p.epoch == 0 for p in reports[n_before:]), (
+        "keepalive must pin the join epoch, not track self.epoch"
+    )
+    opt.epoch = 0
     # the first step stops the keepalive
     ids, labels = next(batches(0, tiny_cfg.vocab_size, 1))
     state, _ = opt.step(state, trainer.shard_batch(ids, labels, accum=1))
