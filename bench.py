@@ -18,7 +18,7 @@ comparison row -- and reports the fastest. A wedged
 accelerator or a variant that fails to compile loses that variant, not
 the whole bench. Pin a single variant with OPENDILOCO_TPU_BENCH_ATTN /
 OPENDILOCO_TPU_BENCH_FUSED / OPENDILOCO_TPU_BENCH_REMAT (true|false|dots|dots_all)
-/ OPENDILOCO_TPU_BENCH_BS (per-chip batch); unset pin knobs default to
+/ OPENDILOCO_TPU_BENCH_BS (global batch); unset pin knobs default to
 the headline pallas+fused config.
 """
 
@@ -319,10 +319,10 @@ def main():
     env_bs = os.environ.get("OPENDILOCO_TPU_BENCH_BS")
     if env_bs:
         try:
-            pin_bs = int(env_bs) * n_chips  # env pins the PER-CHIP batch
+            pin_bs = int(env_bs)  # env pins the GLOBAL batch
         except ValueError:
             raise SystemExit(
-                f"OPENDILOCO_TPU_BENCH_BS={env_bs!r}: must be a per-chip "
+                f"OPENDILOCO_TPU_BENCH_BS={env_bs!r}: must be a global "
                 "batch size (integer)"
             )
         if pin_bs <= 0 or pin_bs % (accum * n_chips):

@@ -228,6 +228,34 @@ void odtp_quantize_uniform8(const float* src, uint8_t* q, size_t n,
     *span_out = span;
 }
 
+// Chunk-granular encode entry points for the pipelined outer data plane:
+// the prescan reduction (min/max over the WHOLE part) is split out from the
+// quantize loop so one prescan can feed many per-chunk quantize calls while
+// earlier chunks are already on the wire. The reduction and the quantize
+// expression are copied verbatim from odtp_quantize_uniform8 above — a
+// chunked encode must stay bit-identical to the fused whole-tensor kernel.
+void odtp_minmax_f32(const float* src, size_t n, float* lo_out, float* hi_out) {
+    float lo = n ? src[0] : 0.f, hi = n ? src[0] : 0.f;
+#pragma omp parallel for schedule(static) reduction(min:lo) reduction(max:hi)
+    for (ptrdiff_t i = 0; i < (ptrdiff_t)n; ++i) {
+        lo = std::min(lo, src[i]);
+        hi = std::max(hi, src[i]);
+    }
+    *lo_out = lo;
+    *hi_out = hi;
+}
+
+void odtp_quantize_uniform8_given(const float* src, uint8_t* q, size_t n,
+                                  float lo, float span) {
+    float inv = 255.f / span;
+#pragma omp parallel for schedule(static)
+    for (ptrdiff_t i = 0; i < (ptrdiff_t)n; ++i) {
+        float v = std::nearbyint((src[i] - lo) * inv);
+        v = std::min(255.f, std::max(0.f, v));
+        q[i] = (uint8_t)v;
+    }
+}
+
 void odtp_dequantize_uniform8(const uint8_t* q, float lo, float span,
                               float* dst, size_t n) {
     float s = span / 255.f;
@@ -298,8 +326,9 @@ void odtp_lut256_accumulate(const uint8_t* idx, const float* lut, float* dst,
 }
 
 // Bumped once per exported symbol-group addition: 1 = base codecs,
-// 2 = fused decode-accumulate, 3 = absmax + fused scaled-fp16 paths.
-int odtp_version() { return 3; }
+// 2 = fused decode-accumulate, 3 = absmax + fused scaled-fp16 paths,
+// 4 = chunk-granular encode prescans (minmax + quantize-given).
+int odtp_version() { return 4; }
 
 }  // extern "C"
 

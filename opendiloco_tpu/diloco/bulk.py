@@ -306,7 +306,13 @@ class BulkServer:
                     if magic != MAGIC or hlen > MAX_HEADER:
                         raise WireError(f"bad bulk frame: magic={magic!r}")
                     header = json.loads(_recv_exact(conn, hlen))
-                except (ConnectionError, OSError, WireError):
+                except ConnectionResetError:
+                    return  # peer dropped the pooled connection: normal
+                except (ConnectionError, OSError, WireError) as e:
+                    # anything but a clean close means stream desync or a
+                    # socket fault -- make it visible, the sender will see
+                    # an unexplained EOF on its next ack read
+                    log.warning("bulk conn dropped (%r)", e)
                     return
                 if _frame_observer is not None:
                     _frame_observer(header["type"])
@@ -486,6 +492,28 @@ class BulkSender:
         if ack[0] != _ACK[0]:
             raise WireError(f"bad bulk ack {ack[0]!r}")
 
+    def stream(
+        self, host: str, port: int, *, lock_timeout: float = 30.0
+    ) -> "BulkStream":
+        """Open a pipelined chunk stream to one destination.
+
+        The destination lock is held for the stream's whole lifetime (chunk
+        frames from two rounds must not interleave on one connection);
+        ``BulkStream.close`` releases it. On connect failure the lock is
+        released here and the caller falls back to the RPC path."""
+        key = (host, port)
+        with self._meta_lock:
+            lock = self._locks.setdefault(key, threading.Lock())
+        if not lock.acquire(timeout=lock_timeout):
+            raise TimeoutError(f"bulk destination {key} busy")
+        try:
+            sock = self._get_conns(key, 1)[0]
+        except BaseException:
+            self._drop(key)
+            lock.release()
+            raise
+        return BulkStream(self, key, lock, sock)
+
     def _send_striped(
         self, key: tuple, msg: str, meta: dict, payload, streams: int
     ) -> None:
@@ -548,3 +576,75 @@ class BulkSender:
         with self._meta_lock:
             for key in list(self._conns):
                 self._drop(key)
+
+
+class BulkStream:
+    """One destination's bulk connection held across a part's chunk frames.
+
+    Frames are pipelined with a bounded ack window: chunk k's ack is only
+    collected once k+`window` frames are on the wire, so the socket never
+    idles between chunks while the server's per-frame ack still provides
+    end-of-stream backpressure (``close`` drains the remainder). Any
+    send/ack error poisons the stream and drops the pooled connection; the
+    caller re-sends outstanding chunks through the RPC path."""
+
+    def __init__(
+        self,
+        sender: BulkSender,
+        key: tuple,
+        lock: threading.Lock,
+        sock: socket.socket,
+        window: int = 2,
+    ):
+        self._sender = sender
+        self._key = key
+        self._lock = lock
+        self._sock = sock
+        self._window = max(1, window)
+        self._pending = 0
+        self._broken = False
+        self._released = False
+
+    def send(self, msg: str, meta: dict, payload) -> None:
+        if self._broken:
+            raise WireError(f"bulk stream to {self._key} is broken")
+        try:
+            send_frame_sync(self._sock, msg, meta, payload)
+            self._pending += 1
+            while self._pending >= self._window:
+                self._sender._await_ack(self._sock)
+                self._pending -= 1
+        except BaseException:
+            self._broken = True
+            self._sender._drop(self._key)
+            raise
+
+    def close(self) -> None:
+        """Drain outstanding acks and release the destination lock.
+
+        A drain failure drops the pooled connection but does NOT raise:
+        every frame was already written (send() errors are fatal and
+        re-routed by the caller), and the acks are backpressure, not a
+        delivery guarantee — delivery is enforced end-to-end by the
+        receiver's mailbox timeout and the round retry machinery. Failing
+        the sender's round here over a lost trailing ack was observed to
+        desync an otherwise-complete 8-worker round: every receiver had the
+        data, only this peer re-formed, and the swarm phase-shifted."""
+        try:
+            if not self._broken:
+                try:
+                    while self._pending:
+                        self._sender._await_ack(self._sock)
+                        self._pending -= 1
+                except Exception as e:
+                    self._broken = True
+                    self._sender._drop(self._key)
+                    log.warning(
+                        "bulk stream to %s: %d trailing ack(s) lost at "
+                        "close (%s); connection dropped",
+                        self._key, self._pending, e,
+                    )
+        finally:
+            if not self._released:
+                self._released = True
+                self._lock.release()

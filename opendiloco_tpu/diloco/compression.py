@@ -13,6 +13,13 @@ Design constraints:
   numpy fallbacks -- identical semantics either way.
 - ``decode_accumulate`` fuses the butterfly collect step (decode + sum) into
   one pass over the buffer.
+- Chunked encode (``chunk_state`` + ``encode_chunk``) splits a part into
+  independently decodable chunk payloads for the pipelined data plane.
+  Tensor-global codec state (scaled-fp16's abs-max, uniform8bit's lo/span,
+  quantile8bit's codebook) is computed once over the whole part by
+  ``chunk_state``, then reused per chunk, so the concatenated chunk decodes
+  are bit-identical to the whole-tensor path — each chunk's (payload, meta)
+  feeds the existing ``decode_accumulate`` / ``decode_into`` unchanged.
 """
 
 from __future__ import annotations
@@ -24,8 +31,40 @@ from opendiloco_tpu import native
 _BLOCK = 4096
 
 
+def chunk_bounds(n: int, chunk_elems: int, align: int = 1) -> list[int]:
+    """Element offsets splitting an n-element part into pipeline chunks.
+
+    Returns ``[0, c1, ..., n]``; always at least one chunk (an empty part
+    yields a single empty chunk so the receiver's chunk loop still runs).
+    ``align`` rounds the chunk size down to a codec's block granularity
+    (blockwise8bit) so chunk payloads stay bit-identical to the whole-tensor
+    encode."""
+    ce = max(1, int(chunk_elems))
+    if align > 1:
+        ce = max(align, ce - (ce % align))
+    if n <= 0:
+        return [0, 0]
+    return list(range(0, n, ce)) + [n]
+
+
 class Codec:
     name: str = "none"
+    # chunk offsets must be multiples of this many elements (blockwise8bit)
+    chunk_align: int = 1
+
+    def chunk_state(self, arr: np.ndarray) -> dict:
+        """Tensor-global encode state, computed once per part before the
+        per-chunk ``encode_chunk`` calls. Stateless codecs return {}."""
+        return {}
+
+    def encode_chunk(self, arr: np.ndarray, state: dict) -> tuple[bytes, dict]:
+        """Encode one contiguous slice of a part using the shared ``state``.
+
+        The returned (payload, meta) must decode through the whole-tensor
+        ``decode_accumulate`` / ``decode_into`` on the matching destination
+        slice, and the concatenation of chunk decodes must be bit-identical
+        to decoding one whole-tensor encode."""
+        return self.encode(arr)
 
     def encode(self, arr: np.ndarray) -> tuple[bytes, dict]:
         # zero-copy when already contiguous f32: a memoryview over the array
@@ -88,6 +127,18 @@ class ScaledFloat16Codec(Codec):
         scale = scale if scale > 0 else 1.0
         return native.f32_to_f16_scaled_bytes(arr, scale), {"scale": scale}
 
+    def chunk_state(self, arr):
+        arr = np.asarray(arr, np.float32)
+        scale = native.absmax(arr) if arr.size else 0.0
+        return {"scale": scale if scale > 0 else 1.0}
+
+    def encode_chunk(self, arr, state):
+        scale = state["scale"]
+        return (
+            native.f32_to_f16_scaled_bytes(np.asarray(arr, np.float32), scale),
+            {"scale": scale},
+        )
+
     def decode(self, payload, shape, meta):
         return native.f16_bytes_to_f32_scaled(
             payload, float(meta["scale"]), int(np.prod(shape))
@@ -112,6 +163,14 @@ class Uniform8BitCodec(Codec):
     def encode(self, arr):
         payload, lo, span = native.quantize_uniform8(arr)
         return payload, {"lo": lo, "span": span}
+
+    def chunk_state(self, arr):
+        lo, span = native.minmax_span(arr)
+        return {"lo": lo, "span": span}
+
+    def encode_chunk(self, arr, state):
+        payload = native.quantize_uniform8_given(arr, state["lo"], state["span"])
+        return payload, {"lo": state["lo"], "span": state["span"]}
 
     def decode(self, payload, shape, meta):
         return native.dequantize_uniform8(
@@ -147,6 +206,26 @@ class Quantile8BitCodec(Codec):
         idx = native.quantile_assign(flat, edges[1:-1])
         return codebook.tobytes() + idx.tobytes(), {}
 
+    def chunk_state(self, arr):
+        # codebook is built over the whole part; each chunk payload carries
+        # it (1 KB) so chunks stay independently decodable
+        flat = np.asarray(arr, np.float32).reshape(-1)
+        if flat.size == 0:
+            return {
+                "codebook": np.zeros(256, np.float32).tobytes(),
+                "inner": np.zeros(255, np.float32),
+            }
+        edges = native.quantile_edges(flat)
+        codebook = ((edges[:-1] + edges[1:]) * 0.5).astype(np.float32)
+        return {"codebook": codebook.tobytes(), "inner": edges[1:-1]}
+
+    def encode_chunk(self, arr, state):
+        flat = np.asarray(arr, np.float32).reshape(-1)
+        if flat.size == 0:
+            return state["codebook"], {}
+        idx = native.quantile_assign(flat, state["inner"])
+        return state["codebook"] + idx.tobytes(), {}
+
     def decode(self, payload, shape, meta):
         codebook = np.frombuffer(payload[: 256 * 4], dtype=np.float32)
         return native.lut256_gather(
@@ -168,6 +247,9 @@ class Blockwise8BitCodec(Codec):
     Payload layout: [nblocks x f32 scales][n x i8]."""
 
     name = "blockwise8bit"
+    # chunk boundaries on block multiples keep chunk-local blocks (and their
+    # scales) identical to the whole-tensor block grid
+    chunk_align = _BLOCK
 
     def encode(self, arr):
         arr = np.asarray(arr, np.float32).reshape(-1)

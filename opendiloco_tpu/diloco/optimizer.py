@@ -173,7 +173,13 @@ class DiLoCoOptimizer:
             # would expire during a multi-minute silent compile and the
             # daemon would reap the peer, so a background thread keeps
             # re-announcing until the first step() lands.
-            self._announce(samples=0, sps=0.0)
+            try:
+                self._announce(samples=0, sps=0.0)
+            except Exception as e:  # never kill the joiner over gossip
+                # same contract as the keepalive below: a flaky rendezvous
+                # at construction time must not take down the worker — the
+                # keepalive retries in seconds anyway
+                log.warning("join announce failed: %s", e)
             self._first_step_evt = threading.Event()
             self._announce_lock = threading.Lock()
             # the keepalive pins the epoch it announced at JOIN: desync

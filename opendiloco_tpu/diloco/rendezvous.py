@@ -68,6 +68,10 @@ class _GroupRound:
         # set when a joiner had to be transparently re-registered: the
         # registry is stale, so only the window timer may close the round
         self.no_early_close = False
+        # joiner-count hint from the workers (largest wins): when set, the
+        # round closes the moment this many joiners arrive — a complete
+        # group by definition, independent of registry freshness
+        self.expect = 0
 
     def group_for(self, peer_id: str) -> list[dict]:
         if self.cap:
@@ -435,7 +439,17 @@ class RendezvousServer:
             # joined" would matchmake a solo group. Wait the full window so
             # the other expired peers can re-join.
             rnd.no_early_close = True
-        if not rnd.no_early_close and set(rnd.joiners) >= set(
+        rnd.expect = max(rnd.expect, int(meta.get("expect") or 0))
+        if rnd.expect:
+            # a declared swarm size overrides the registry heuristics in
+            # BOTH directions: the round closes the instant all expected
+            # joiners arrive (even with a stale registry — the group is
+            # complete by definition), and never closes early on the
+            # "every live peer joined" rule while joiners are still missing
+            # (the registry may simply not know about them yet)
+            if len(rnd.joiners) >= rnd.expect:
+                self._close_round(rnd)
+        elif not rnd.no_early_close and set(rnd.joiners) >= set(
             self._live_peers()
         ):
             self._close_round(rnd)

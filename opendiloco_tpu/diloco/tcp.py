@@ -33,10 +33,12 @@ from typing import Any, Callable, Optional
 import numpy as np
 
 from opendiloco_tpu.diloco.backend import AllReduceError, OuterBackend, PeerProgress
-from opendiloco_tpu.diloco.compression import Codec, get_codec
+from opendiloco_tpu.diloco.compression import Codec, chunk_bounds, get_codec
 from opendiloco_tpu.diloco.wire import (
     STREAM_LIMIT,
     WireError,
+    chunk_fields,
+    chunk_span,
     read_frame,
     request,
     send_frame,
@@ -44,6 +46,39 @@ from opendiloco_tpu.diloco.wire import (
 from opendiloco_tpu.utils.logger import get_text_logger
 
 log = get_text_logger(__name__)
+
+
+def _mailbox_key(msg: str, meta: dict) -> tuple:
+    """Mailbox key for a push/result frame. Pipelined chunk frames append
+    the chunk index; whole-part (serial) frames keep the 3-tuple key, so the
+    two paths can never consume each other's traffic."""
+    key = (
+        meta["round"],
+        msg,
+        meta["part"] if msg == "result" else meta["from"],
+    )
+    if "chunk" in meta:
+        key += (int(meta["chunk"]),)
+    return key
+
+
+def _pipeline_enabled() -> bool:
+    """Chunk-pipelined exchange (default). ODTP_PIPELINE=0 restores the
+    whole-part serial path. The flag must agree across the swarm: pipelined
+    and serial peers key their mailbox frames differently and cannot
+    complete a round together."""
+    return os.environ.get("ODTP_PIPELINE", "1").lower() not in ("0", "false")
+
+
+def _pipeline_chunk_elems() -> int:
+    """Pipeline chunk size in elements (ODTP_PIPELINE_CHUNK_ELEMS overrides;
+    ODTP_PIPELINE_CHUNK_MB, default 8, otherwise). Read per round so tests
+    and benches can vary it without rebuilding backends."""
+    env = os.environ.get("ODTP_PIPELINE_CHUNK_ELEMS")
+    if env:
+        return max(1, int(env))
+    mb = float(os.environ.get("ODTP_PIPELINE_CHUNK_MB", "8"))
+    return max(1, int(mb * (1 << 20)) // 4)
 
 
 # -- state (de)serialization: raw numpy bytes + JSON meta, no pickle ---------
@@ -102,6 +137,7 @@ class TcpBackend(OuterBackend):
         compression: str = "none",
         matchmaking_time: float = 5.0,
         rpc_timeout: float = 30.0,
+        expect_peers: int = 0,
     ):
         if not initial_peers:
             raise ValueError("TcpBackend needs at least one rendezvous address")
@@ -126,6 +162,12 @@ class TcpBackend(OuterBackend):
         self.codec: Codec = get_codec(compression)
         self.matchmaking_time = matchmaking_time
         self.rpc_timeout = rpc_timeout
+        # known swarm size: when > 0, the rendezvous closes the matchmaking
+        # window as soon as this many joiners arrive instead of waiting out
+        # the full window / trusting its (possibly stale) live-peer registry
+        self.expect_peers = int(
+            expect_peers or os.environ.get("ODTP_EXPECT_PEERS", 0) or 0
+        )
 
         # every worker is also a rendezvous node (hivemind's every-peer-is-
         # a-DHT-node property, train_fsdp.py:205-212): an embedded server,
@@ -515,11 +557,7 @@ class TcpBackend(OuterBackend):
                 ):
                     break
                 if msg in ("push", "result"):
-                    key = (
-                        meta["round"],
-                        msg,
-                        meta["part"] if msg == "result" else meta["from"],
-                    )
+                    key = _mailbox_key(msg, meta)
                     async with self._mailbox_cv:
                         self._mailbox[key] = (meta, payload)
                         self._gc_mailbox()
@@ -612,11 +650,7 @@ class TcpBackend(OuterBackend):
         """Mailbox delivery from a bulk-server handler thread."""
         if msg not in ("push", "result"):
             return
-        key = (
-            meta["round"],
-            msg,
-            meta["part"] if msg == "result" else meta["from"],
-        )
+        key = _mailbox_key(msg, meta)
 
         def _post():
             async def _set():
@@ -887,6 +921,7 @@ class TcpBackend(OuterBackend):
                 "round": join_key,
                 "matchmaking_time": self.matchmaking_time,
                 "group_cap": group_cap,
+                "expect": self.expect_peers,
                 # a joiner whose registration TTL lapsed mid-round (one
                 # outer round can legitimately outlast the TTL on a slow
                 # link) re-registers transparently from this identity
@@ -942,6 +977,35 @@ class TcpBackend(OuterBackend):
         bounds = np.linspace(0, flat.size, n + 1).astype(np.int64)
         parts = [flat[bounds[j] : bounds[j + 1]] for j in range(n)]
         timings["flatten_s"] = time.monotonic() - t_ph
+
+        # 3-5. exchange: chunk-pipelined by default (encode chunk k+1 while
+        # chunk k is on the wire, decode-accumulate as chunks land), serial
+        # whole-part path behind ODTP_PIPELINE=0. Both produce bit-identical
+        # flat_avg buffers (the parity test in tests/test_bulk_pipeline.py
+        # holds the pipelined path to the serial result).
+        exchange = (
+            self._exchange_pipelined
+            if _pipeline_enabled()
+            else self._exchange_serial
+        )
+        flat_avg = await exchange(
+            group, my_idx, n, parts, bounds, flat.size, round_key, deadline,
+            scratch, timings,
+        )
+        self.last_round_timings = timings
+
+        # 6. hand back per-array views of the reassembled buffer
+        out, off = [], 0
+        for a in arrays:
+            out.append(flat_avg[off : off + a.size].reshape(a.shape))
+            off += a.size
+        return out, n
+
+    async def _exchange_serial(
+        self, group, my_idx, n, parts, bounds, flat_size, round_key, deadline,
+        scratch, timings,
+    ):
+        """Whole-part exchange: each butterfly frame carries a full part."""
 
         # 3. push part j to its owner
         async def push(j):
@@ -1021,7 +1085,7 @@ class TcpBackend(OuterBackend):
         # on all_reduce). Checked out before the gather: every arriving
         # part decodes STRAIGHT into its slice (one native pass per part,
         # no intermediate array, no reassembly concatenate afterwards).
-        flat_avg = self._checkout_buf(flat.size)
+        flat_avg = self._checkout_buf(flat_size)
         with self._pool_lock:
             self._retired_bufs.append(flat_avg)
 
@@ -1057,14 +1121,260 @@ class TcpBackend(OuterBackend):
             recv_results(), *[send_result(j) for j in range(n) if j != my_idx]
         )
         timings["all_gather_s"] = time.monotonic() - t_ph
-        self.last_round_timings = timings
+        return flat_avg
 
-        # 6. hand back per-array views of the reassembled buffer
-        out, off = [], 0
-        for a in arrays:
-            out.append(flat_avg[off : off + a.size].reshape(a.shape))
-            off += a.size
-        return out, n
+    def _chunk_sender(self, dest: dict, deadline: float):
+        """Per-destination chunk transport for the pipelined exchange.
+
+        Returns (send, close) coroutines. The first chunk at/above the bulk
+        threshold opens a BulkStream (windowed acks: the socket never idles
+        between chunks); smaller payloads and any stream failure use the
+        ordinary `_send_part` routing, which re-sends the failed chunk over
+        the RPC plane."""
+        loop = self._loop
+        state: dict = {"stream": None, "tried": False}
+
+        async def send(msg: str, meta: dict, payload) -> None:
+            nbytes = payload.nbytes if hasattr(payload, "nbytes") else len(payload)
+            if (
+                state["stream"] is None
+                and not state["tried"]
+                and self._bulk_sender is not None
+                and nbytes >= self._bulk_threshold
+            ):
+                state["tried"] = True
+                bulk_port = await self._bulk_port_of(dest["host"], dest["port"])
+                if bulk_port:
+                    try:
+                        state["stream"] = await loop.run_in_executor(
+                            None,
+                            lambda: self._bulk_sender.stream(
+                                dest["host"], bulk_port
+                            ),
+                        )
+                    except Exception as e:
+                        log.warning(
+                            "bulk stream to %s:%s failed to open (%s); RPC path",
+                            dest["host"], bulk_port, e,
+                        )
+            if state["stream"] is not None:
+                try:
+                    await loop.run_in_executor(
+                        None, state["stream"].send, msg, meta, payload
+                    )
+                    return
+                except Exception as e:
+                    # the stream poisoned itself and dropped the pooled
+                    # connection; this chunk falls through to the RPC path,
+                    # later chunks follow it directly
+                    state["stream"] = None
+                    log.warning(
+                        "bulk stream chunk to %s:%s failed (%s); RPC path",
+                        dest["host"], dest["port"], e,
+                    )
+            await self._send_part(
+                dest["host"], dest["port"], msg, meta, payload,
+                timeout=max(5.0, deadline - time.monotonic()),
+            )
+
+        async def close() -> None:
+            stream, state["stream"] = state["stream"], None
+            if stream is not None:
+                try:
+                    await loop.run_in_executor(None, stream.close)
+                except Exception as e:
+                    log.warning(
+                        "bulk stream to %s:%s failed at close (%s)",
+                        dest["host"], dest["port"], e,
+                    )
+                    raise
+
+        return send, close
+
+    async def _exchange_pipelined(
+        self, group, my_idx, n, parts, bounds, flat_size, round_key, deadline,
+        scratch, timings,
+    ):
+        """Chunk-pipelined exchange: every part travels as fixed-size chunk
+        frames, with codec work off the event loop (native kernels release
+        the GIL) so compression, socket send, socket receive, and fused
+        decode-accumulate overlap — encode chunk k+1 while chunk k is on
+        the wire, accumulate chunk k as chunk k+1 is received.
+
+        Bit-parity with the serial path: tensor-global codec state comes
+        from a whole-part prescan (compression.chunk_state), the accumulate
+        loop folds peers in group order with chunks in offset order (the
+        serial path's exact per-element addition order), and the all-gather
+        adopts decoded wire chunks for the owner's own part too."""
+        from opendiloco_tpu import native as _native
+        from opendiloco_tpu.diloco.bulk import release_buffer
+
+        loop = self._loop
+        chunk_elems = _pipeline_chunk_elems()
+        align = getattr(self.codec, "chunk_align", 1)
+
+        # 3. push part j to its owner, chunk by chunk
+        async def push(j):
+            part = parts[j]
+            state = await loop.run_in_executor(
+                None, self.codec.chunk_state, part
+            )
+            grid = chunk_bounds(part.size, chunk_elems, align)
+            nchunks = len(grid) - 1
+
+            def enc(k):
+                return self.codec.encode_chunk(part[grid[k] : grid[k + 1]], state)
+
+            send, close = self._chunk_sender(group[j], deadline)
+            nxt = loop.run_in_executor(None, enc, 0)
+            try:
+                for k in range(nchunks):
+                    payload, cmeta = await nxt
+                    if k + 1 < nchunks:
+                        nxt = loop.run_in_executor(None, enc, k + 1)
+                    await send(
+                        "push",
+                        {
+                            "round": round_key,
+                            "from": self._peer_id,
+                            "meta": cmeta,
+                            "shape": [int(part.size)],
+                            **chunk_fields(
+                                k, nchunks, grid[k], grid[k + 1] - grid[k]
+                            ),
+                        },
+                        payload,
+                    )
+            finally:
+                await close()
+
+        # 4. fold incoming chunks into my accumulator as they decode
+        async def collect():
+            acc = self._checkout_buf(parts[my_idx].size)
+            scratch.append(acc)
+            np.copyto(acc, parts[my_idx])
+            for p in group:
+                if p["peer_id"] == self._peer_id:
+                    continue
+                k, nchunks = 0, 1
+                while k < nchunks:
+                    pmeta, payload = await self._wait_mailbox(
+                        (round_key, "push", p["peer_id"], k), deadline
+                    )
+                    nchunks = int(pmeta.get("nchunks", 1))
+                    coff, clen = chunk_span(pmeta, acc.size)
+                    await loop.run_in_executor(
+                        None,
+                        self.codec.decode_accumulate,
+                        payload,
+                        pmeta["meta"],
+                        acc[coff : coff + clen],
+                    )
+                    release_buffer(payload)
+                    k += 1
+            _native.scale_inplace(acc, 1.0 / n)
+            return acc
+
+        t_ph = time.monotonic()
+        results = await asyncio.gather(
+            collect(), *[push(j) for j in range(n) if j != my_idx]
+        )
+        my_avg = results[0]
+        timings["scatter_reduce_s"] = time.monotonic() - t_ph
+
+        # 5. fan the averaged part back out chunk by chunk; gather the other
+        # parts. Each chunk is encoded ONCE (shared future) and the same
+        # payload serves every destination plus the owner's self-adoption of
+        # the decoded wire value — the serial path's encode-once invariant
+        # at chunk granularity.
+        state = await loop.run_in_executor(None, self.codec.chunk_state, my_avg)
+        grid = chunk_bounds(my_avg.size, chunk_elems, align)
+        nchunks = len(grid) - 1
+
+        def enc(k):
+            return self.codec.encode_chunk(my_avg[grid[k] : grid[k + 1]], state)
+
+        enc_futs: dict = {}
+
+        def chunk_fut(k):
+            if k not in enc_futs:
+                enc_futs[k] = loop.run_in_executor(None, enc, k)
+            return enc_futs[k]
+
+        flat_avg = self._checkout_buf(flat_size)
+        with self._pool_lock:
+            self._retired_bufs.append(flat_avg)
+
+        async def send_result_to(j):
+            send, close = self._chunk_sender(group[j], deadline)
+            try:
+                for k in range(nchunks):
+                    payload, cmeta = await chunk_fut(k)
+                    if k + 1 < nchunks:
+                        chunk_fut(k + 1)  # encode k+1 while k is on the wire
+                    await send(
+                        "result",
+                        {
+                            "round": round_key,
+                            "part": my_idx,
+                            "from": self._peer_id,
+                            "meta": cmeta,
+                            "shape": [int(my_avg.size)],
+                            **chunk_fields(
+                                k, nchunks, grid[k], grid[k + 1] - grid[k]
+                            ),
+                        },
+                        payload,
+                    )
+            finally:
+                await close()
+
+        async def adopt():
+            my_dst = flat_avg[bounds[my_idx] : bounds[my_idx + 1]]
+            for k in range(nchunks):
+                payload, cmeta = await chunk_fut(k)
+                await loop.run_in_executor(
+                    None,
+                    self.codec.decode_into,
+                    payload,
+                    cmeta,
+                    my_dst[grid[k] : grid[k + 1]],
+                )
+
+        async def recv_from(j):
+            dst_part = flat_avg[bounds[j] : bounds[j + 1]]
+            k, nchunks_j = 0, 1
+            while k < nchunks_j:
+                rmeta, payload = await self._wait_mailbox(
+                    (round_key, "result", j, k), deadline
+                )
+                nchunks_j = int(rmeta.get("nchunks", 1))
+                if int(rmeta["shape"][0]) != dst_part.size:
+                    raise WireError(
+                        f"result part {j}: peer claims {rmeta['shape']} "
+                        f"elements, expected {dst_part.size}"
+                    )
+                coff, clen = chunk_span(rmeta, dst_part.size)
+                # (decode_into additionally validates the actual payload
+                # length against the slice size before any native kernel)
+                await loop.run_in_executor(
+                    None,
+                    self.codec.decode_into,
+                    payload,
+                    rmeta["meta"],
+                    dst_part[coff : coff + clen],
+                )
+                release_buffer(payload)
+                k += 1
+
+        t_ph = time.monotonic()
+        await asyncio.gather(
+            adopt(),
+            *[send_result_to(j) for j in range(n) if j != my_idx],
+            *[recv_from(j) for j in range(n) if j != my_idx],
+        )
+        timings["all_gather_s"] = time.monotonic() - t_ph
+        return flat_avg
 
     def _peer_id_epoch_key(self) -> str:
         ep = self._own_progress.epoch if self._own_progress else 0

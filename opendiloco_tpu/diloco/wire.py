@@ -109,6 +109,36 @@ async def request(
             pass
 
 
+# -- chunk framing (pipelined outer data plane) ------------------------------
+#
+# A pipelined part travels as `nchunks` independent frames; each frame's meta
+# gains the fields below so the receiver can route the payload to the right
+# element slice without waiting for the rest of the part. Frames without a
+# "chunk" field are whole-part (serial path) and keep their original keys.
+
+
+def chunk_fields(k: int, nchunks: int, coff: int, clen: int) -> dict[str, int]:
+    """Meta fields marking one chunk of a pipelined part: chunk index,
+    chunk count, and the element offset/length within the part."""
+    return {
+        "chunk": int(k),
+        "nchunks": int(nchunks),
+        "coff": int(coff),
+        "clen": int(clen),
+    }
+
+
+def chunk_span(meta: dict[str, Any], part_size: int) -> tuple[int, int]:
+    """Validated (offset, length) of a chunk frame within its part."""
+    coff = int(meta.get("coff", 0))
+    clen = int(meta.get("clen", part_size))
+    if coff < 0 or clen < 0 or coff + clen > part_size:
+        raise WireError(
+            f"chunk [{coff}:{coff + clen}] outside part of {part_size} elements"
+        )
+    return coff, clen
+
+
 # -- multi-tensor payload packing -------------------------------------------
 
 

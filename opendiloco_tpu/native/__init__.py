@@ -95,6 +95,13 @@ def get_lib() -> Optional[ctypes.CDLL]:
         ]
     except AttributeError:
         pass
+    try:  # version-4 kernels (chunk-granular encode prescans)
+        lib.odtp_minmax_f32.argtypes = [f32p, st, f32p, f32p]
+        lib.odtp_quantize_uniform8_given.argtypes = [
+            f32p, u8p, st, ctypes.c_float, ctypes.c_float,
+        ]
+    except AttributeError:
+        pass
     for fn in (lib.odtp_sendall, lib.odtp_recvall):
         fn.argtypes = [ctypes.c_int, ctypes.c_void_p, st]
         fn.restype = ctypes.c_int
@@ -391,6 +398,47 @@ def quantize_uniform8(a: np.ndarray) -> tuple[bytes, float, float]:
         _f32p(a), _u8p(q), a.size, _f32p(lo_out), _f32p(span_out)
     )
     return q.tobytes(), float(lo_out[0]), float(span_out[0])
+
+
+def minmax_span(a: np.ndarray) -> tuple[float, float]:
+    """(lo, span) of ``a`` with the same reduction, arithmetic precision,
+    and zero-span fix-up as ``quantize_uniform8``, so a chunked encode fed
+    by this prescan is bit-identical to the fused whole-tensor kernel on
+    the matching build (native-vs-native, fallback-vs-fallback)."""
+    a = np.ascontiguousarray(a, np.float32).reshape(-1)
+    lib = get_lib()
+    if not _has(lib, "odtp_minmax_f32"):
+        lo = float(a.min()) if a.size else 0.0
+        hi = float(a.max()) if a.size else 0.0
+        span = (hi - lo) or 1.0
+        return lo, span
+    lo_out = np.empty(1, np.float32)
+    hi_out = np.empty(1, np.float32)
+    lib.odtp_minmax_f32(_f32p(a), a.size, _f32p(lo_out), _f32p(hi_out))
+    # f32 subtraction, exactly as the C kernel computes span
+    span = np.float32(hi_out[0]) - np.float32(lo_out[0])
+    if not (span > 0):
+        span = np.float32(1.0)
+    return float(lo_out[0]), float(span)
+
+
+def quantize_uniform8_given(a: np.ndarray, lo: float, span: float) -> bytes:
+    """Quantize ``a`` with a precomputed (lo, span) — the per-chunk half of
+    the prescan/quantize split. Expression order matches the fused kernel
+    (and the ``quantize_uniform8`` fallback) for bit-parity."""
+    a = np.ascontiguousarray(a, np.float32).reshape(-1)
+    lib = get_lib()
+    if not _has(lib, "odtp_quantize_uniform8_given"):
+        inv = np.float32(255.0) / np.float32(span)
+        q = np.clip(
+            np.round((a - np.float32(lo)) * inv), 0, 255
+        ).astype(np.uint8)
+        return q.tobytes()
+    q = np.empty(a.size, np.uint8)
+    lib.odtp_quantize_uniform8_given(
+        _f32p(a), _u8p(q), a.size, ctypes.c_float(lo), ctypes.c_float(span)
+    )
+    return q.tobytes()
 
 
 def dequantize_uniform8(

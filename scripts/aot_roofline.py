@@ -263,7 +263,10 @@ def main():
         r for r in doc.get("multichip_rows", []) if "error" not in r
     ]
     have_mc = {
-        (r["model"], r["per_chip_batch"], r["accum"], r["remat"])
+        # fused isn't a row field: rows record it only through the attn
+        # label, so derive it the same way the writer encodes it
+        (r["model"], r["per_chip_batch"], r["accum"], r["remat"],
+         r.get("attn") == "pallas+fused")
         for r in doc["multichip_rows"]
     }
     for model, seq, bs_chip, accum, remat, fused in (
@@ -271,7 +274,7 @@ def main():
         ("1b", 1024, 8, 2, True, True),
         ("150m", 1024, 16, 1, True, False),
     ):
-        if (model, bs_chip, accum, str(remat)) in have_mc:
+        if (model, bs_chip, accum, str(remat), fused) in have_mc:
             continue
         name = f"mc4 {model} seq{seq} bs{bs_chip}/chip accum{accum} remat={remat}"
         t0 = time.time()

@@ -36,7 +36,10 @@ ALL_CODECS = [
     "none", "fp16", "scaled-fp16", "uniform8bit", "quantile8bit",
     "blockwise8bit",
 ]
-_OUT = os.path.join(REPO, "OUTER_BENCH.json")
+# tests point this somewhere disposable; default is the banked artifact
+_OUT = os.environ.get("ODTP_OUTER_BENCH_OUT") or os.path.join(
+    REPO, "OUTER_BENCH.json"
+)
 
 
 def expected_group(peers: int, group_cap: int) -> int:
@@ -113,7 +116,13 @@ def worker_main() -> None:
     ap.add_argument("--timeout", type=float, default=600.0)
     ap.add_argument("--sweep-start", type=float, default=0.0)
     ap.add_argument("--group-cap", type=int, default=0)
+    ap.add_argument("--pipeline", default="1")
     args = ap.parse_args()
+
+    # the pipelined/serial choice must agree across the whole group (the
+    # two paths key their mailbox frames differently); the parent passes it
+    # explicitly per sweep
+    os.environ["ODTP_PIPELINE"] = args.pipeline
 
     from opendiloco_tpu.diloco.backend import PeerProgress
     from opendiloco_tpu.diloco.tcp import TcpBackend
@@ -136,6 +145,13 @@ def worker_main() -> None:
         peer_id=f"bench-{args.rank}",
         compression=args.compression,
         matchmaking_time=window,
+        # the bench KNOWS the swarm size: the rendezvous closes each
+        # matchmaking window the instant all peers have joined, never
+        # early on a stale registry view — this is what turned the old
+        # "matchmade group N < peers" error rows into clean rounds.
+        # (expect counts JOINERS, so it holds under --group-cap too: the
+        # partition into capped groups happens at close.)
+        expect_peers=args.peers,
     )
     # a worker that starts its round before the others register gets a SOLO
     # matchmaking group (n=1, no wire traffic -- a meaningless number); the
@@ -246,7 +262,14 @@ def _append_row(row: dict) -> None:
                 doc = json.load(f)
         except ValueError:
             pass
-    doc.setdefault("rows", []).append(row)
+    # latest run wins: a re-run of one sweep replaces its old row instead
+    # of stacking duplicates
+    ident = lambda r: (
+        r.get("model"), r.get("peers"), r.get("codec"), r.get("pipelined")
+    )
+    doc["rows"] = [
+        r for r in doc.setdefault("rows", []) if ident(r) != ident(row)
+    ] + [row]
     doc["updated"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
     doc.setdefault("host", {}).update(
         cores=os.cpu_count(), loadavg=round(os.getloadavg()[0], 2)
@@ -284,7 +307,19 @@ def main() -> None:
         "the codec tradeoff measurable: on a constrained link the 8-bit "
         "wire beats raw fp32 even after paying encode/decode",
     )
+    ap.add_argument(
+        "--pipeline", default="both", choices=["both", "on", "off"],
+        help="data-plane mode per codec: 'on' = chunk-pipelined (the "
+        "production default), 'off' = serial whole-part frames, 'both' = "
+        "bench the pair and report the pipelined speedup",
+    )
+    ap.add_argument(
+        "--fresh", action="store_true",
+        help="start OUTER_BENCH.json from scratch instead of appending",
+    )
     args = ap.parse_args()
+    if args.fresh and os.path.exists(_OUT):
+        os.remove(_OUT)
     if args.group_cap and args.peers % args.group_cap:
         # the rendezvous would hand the remainder a smaller (possibly solo)
         # group by design -- which benches nothing; require even gossip
@@ -341,128 +376,158 @@ def run_sweep(args, server, nbytes, base_env, cap_bps: float) -> None:
     cap_note = (
         {"bandwidth_mbps": round(cap_bps * 8 / 1e6)} if cap_bps > 0 else {}
     )
+    # serial ("0") first so the pipelined row can record its speedup
+    modes = {"both": ["0", "1"], "on": ["1"], "off": ["0"]}[args.pipeline]
     for compression in args.codecs.split(","):
-        ceiling = loopback_ceiling_gbps()
-        procs = [
-            subprocess.Popen(
-                [
-                    sys.executable, os.path.abspath(__file__), "--worker",
-                    "--rendezvous", server.address, "--rank", str(i),
-                    "--model", args.model, "--compression", compression,
-                    "--rounds", str(args.rounds),
-                    "--peers", str(args.peers),
-                    "--timeout", str(round_timeout),
-                    "--sweep-start", str(time.time()),
-                    "--group-cap", str(args.group_cap),
-                ],
-                stdout=subprocess.PIPE,
-                stderr=subprocess.STDOUT,  # tracebacks land in the detail
-                text=True,
-                env=env,
+        serial_mean = None  # this codec's serial trimmed_mean_s, if benched
+        for mode in modes:
+            pipelined = mode == "1"
+            label = f"{compression}[{'pipe' if pipelined else 'serial'}]"
+            plane = {"pipelined": pipelined}
+            ceiling = loopback_ceiling_gbps()
+            procs = [
+                subprocess.Popen(
+                    [
+                        sys.executable, os.path.abspath(__file__), "--worker",
+                        "--rendezvous", server.address, "--rank", str(i),
+                        "--model", args.model, "--compression", compression,
+                        "--rounds", str(args.rounds),
+                        "--peers", str(args.peers),
+                        "--timeout", str(round_timeout),
+                        "--sweep-start", str(time.time()),
+                        "--group-cap", str(args.group_cap),
+                        "--pipeline", mode,
+                    ],
+                    stdout=subprocess.PIPE,
+                    stderr=subprocess.STDOUT,  # tracebacks -> detail
+                    text=True,
+                    env=env,
+                )
+                for i in range(args.peers)
+            ]
+            try:
+                outs = [p.communicate(timeout=proc_timeout)[0] for p in procs]
+            except subprocess.TimeoutExpired:
+                for p in procs:
+                    p.kill()
+                for p in procs:  # reap; drain pipes so fds don't leak
+                    try:
+                        p.communicate(timeout=10)
+                    except Exception:
+                        pass
+                print(f"{label:>22}: TIMEOUT")
+                _append_row({
+                    "model": args.model, "peers": args.peers,
+                    "codec": compression, **plane, "error": "timeout",
+                    **cap_note,
+                })
+                continue
+            line = next(
+                (l for o in outs for l in o.splitlines()
+                 if l.startswith("RESULT")),
+                None,
             )
-            for i in range(args.peers)
-        ]
-        try:
-            outs = [p.communicate(timeout=proc_timeout)[0] for p in procs]
-        except subprocess.TimeoutExpired:
-            for p in procs:
-                p.kill()
-            for p in procs:  # reap; drain pipes so fds don't leak
-                try:
-                    p.communicate(timeout=10)
-                except Exception:
-                    pass
-            print(f"{compression:>14}: TIMEOUT")
-            _append_row({
-                "model": args.model, "peers": args.peers,
-                "codec": compression, "error": "timeout", **cap_note,
-            })
-            continue
-        line = next(
-            (l for o in outs for l in o.splitlines()
-             if l.startswith("RESULT")),
-            None,
-        )
-        # classify a partial round (any worker's) before generic failure:
-        # workers exit 4 on a partial group but rank 0 still prints RESULT
-        want = expected_group(args.peers, args.group_cap)
-        group_n = int(line.split()[-1].split("=")[1]) if line else 0
-        partial = any(
-            l.startswith("PARTIAL") for o in outs for l in o.splitlines()
-        )
-        if line is not None and (group_n < want or partial):
-            print(f"{compression:>14}: SOLO/PARTIAL GROUP n={group_n}")
-            _append_row({
-                "model": args.model, "peers": args.peers,
-                "codec": compression,
-                "error": (
-                    f"matchmade group {group_n} < {want}"
-                    if group_n < want
-                    else "partial group in a non-rank-0 worker"
-                ),
-                **cap_note,
-            })
-            continue
-        if line is None or any(p.returncode for p in procs):
-            print(f"{compression:>14}: FAILED")
-            _append_row({
-                "model": args.model, "peers": args.peers,
-                "codec": compression, "error": "worker failure", **cap_note,
-                # last lines of each worker so a failed row is diagnosable
-                "detail": [
-                    " | ".join(o.splitlines()[-3:])[-400:] for o in outs
-                ],
-            })
-            continue
-        tline = next(
-            (l for o in outs for l in o.splitlines()
-             if l.startswith("TIMINGS")),
-            None,
-        )
-        timings = json.loads(tline.split(None, 1)[1]) if tline else {}
-        tokens = line.split()[1:]
-        kv = dict(t.split("=", 1) for t in tokens if "=" in t)
-        times = [float(x) for x in tokens if "=" not in x]
-        best = min(times)
-        eff = nbytes / best / 1e9
-        # normalize against whichever is binding: the box's socket ceiling
-        # or the emulated link cap
-        norm_base = min(ceiling, cap_bps / 1e9) if cap_bps > 0 else ceiling
-        row = {
-            "model": args.model, "mb_fp32": round(nbytes / 1e6),
-            "peers": args.peers, "codec": compression,
-            **({"group_cap": args.group_cap} if args.group_cap else {}),
-            "rounds_s": [round(t, 3) for t in times],
-            "best_s": round(best, 3),
-            "median_s": round(statistics.median(times), 3),
-            # drop the worst round (and the best too at >=5 rounds): on a
-            # 1-core box one descheduled worker poisons a single round and
-            # the plain median of 3 still carries it half the time
-            "trimmed_mean_s": round(
+            # classify a partial round (any worker's) before generic
+            # failure: workers exit 4 on a partial group but rank 0 still
+            # prints RESULT
+            want = expected_group(args.peers, args.group_cap)
+            group_n = int(line.split()[-1].split("=")[1]) if line else 0
+            partial = any(
+                l.startswith("PARTIAL") for o in outs for l in o.splitlines()
+            )
+            if line is not None and (group_n < want or partial):
+                print(f"{label:>22}: SOLO/PARTIAL GROUP n={group_n}")
+                _append_row({
+                    "model": args.model, "peers": args.peers,
+                    "codec": compression, **plane,
+                    "error": (
+                        f"matchmade group {group_n} < {want}"
+                        if group_n < want
+                        else "partial group in a non-rank-0 worker"
+                    ),
+                    # the partial worker's tail makes the row diagnosable
+                    # (RETRY lines carry the observed group sizes)
+                    "detail": [
+                        " | ".join(o.splitlines()[-3:])[-400:] for o in outs
+                        if "PARTIAL" in o or "RETRY" in o
+                    ][:4],
+                    **cap_note,
+                })
+                continue
+            if line is None or any(p.returncode for p in procs):
+                print(f"{label:>22}: FAILED")
+                _append_row({
+                    "model": args.model, "peers": args.peers,
+                    "codec": compression, **plane,
+                    "error": "worker failure", **cap_note,
+                    # last lines of each worker so the row is diagnosable
+                    "detail": [
+                        " | ".join(o.splitlines()[-3:])[-400:] for o in outs
+                    ],
+                })
+                continue
+            tline = next(
+                (l for o in outs for l in o.splitlines()
+                 if l.startswith("TIMINGS")),
+                None,
+            )
+            timings = json.loads(tline.split(None, 1)[1]) if tline else {}
+            tokens = line.split()[1:]
+            kv = dict(t.split("=", 1) for t in tokens if "=" in t)
+            times = [float(x) for x in tokens if "=" not in x]
+            best = min(times)
+            eff = nbytes / best / 1e9
+            # normalize against whichever is binding: the box's socket
+            # ceiling or the emulated link cap
+            norm_base = min(ceiling, cap_bps / 1e9) if cap_bps > 0 else ceiling
+            trimmed = round(
                 statistics.fmean(
+                    # drop the worst round (and the best too at >=5
+                    # rounds): on a 1-core box one descheduled worker
+                    # poisons a single round and the plain median of 3
+                    # still carries it half the time
                     sorted(times)[1:-1] if len(times) >= 5
                     else sorted(times)[:-1] if len(times) >= 2
                     else times
                 ),
                 3,
-            ),
-            **(
-                {"matchmaking_retries": int(kv["retries"])}
-                if kv.get("retries", "0") != "0"
-                else {}
-            ),
-            "eff_gbps": round(eff, 3),
-            "loopback_ceiling_gbps": round(ceiling, 3),
-            "normalized_eff": round(eff / norm_base, 4),
-            "last_round_timings": timings,
-            **cap_note,
-        }
-        _append_row(row)
-        print(
-            f"{compression:>14}: {best * 1e3:8.0f} ms/round best  "
-            f"({eff:5.2f} GB/s eff, ceiling {ceiling:5.2f} GB/s, "
-            f"normalized {eff / norm_base:5.1%})"
-        )
+            )
+            row = {
+                "model": args.model, "mb_fp32": round(nbytes / 1e6),
+                "peers": args.peers, "codec": compression, **plane,
+                **(
+                    {"chunk_mb": int(
+                        env.get("ODTP_PIPELINE_CHUNK_MB", 8) or 8)}
+                    if pipelined else {}
+                ),
+                **({"group_cap": args.group_cap} if args.group_cap else {}),
+                "rounds_s": [round(t, 3) for t in times],
+                "best_s": round(best, 3),
+                "median_s": round(statistics.median(times), 3),
+                "trimmed_mean_s": trimmed,
+                **(
+                    {"matchmaking_retries": int(kv["retries"])}
+                    if kv.get("retries", "0") != "0"
+                    else {}
+                ),
+                "eff_gbps": round(eff, 3),
+                "loopback_ceiling_gbps": round(ceiling, 3),
+                "normalized_eff": round(eff / norm_base, 4),
+                "last_round_timings": timings,
+                **cap_note,
+            }
+            speed_note = ""
+            if pipelined and serial_mean:
+                row["speedup_vs_serial"] = round(serial_mean / trimmed, 3)
+                speed_note = f"  {serial_mean / trimmed:4.2f}x vs serial"
+            if not pipelined:
+                serial_mean = trimmed
+            _append_row(row)
+            print(
+                f"{label:>22}: {best * 1e3:8.0f} ms/round best  "
+                f"({eff:5.2f} GB/s eff, ceiling {ceiling:5.2f} GB/s, "
+                f"normalized {eff / norm_base:5.1%}){speed_note}"
+            )
 
 
 if __name__ == "__main__":

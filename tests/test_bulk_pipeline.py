@@ -1,0 +1,231 @@
+"""Pipelined outer data plane: chunked codec parity and pipelined-vs-serial
+bit-identity of the butterfly all-reduce.
+
+The contract under test (diloco/compression.py chunk_state/encode_chunk,
+diloco/tcp.py _exchange_pipelined): a part cut into pipeline chunks must
+produce EXACTLY the bytes-for-bytes values of the serial whole-part path --
+the pipelined plane is a transport optimization, not a numerics change.
+"""
+
+import os
+import subprocess
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+from opendiloco_tpu import native
+from opendiloco_tpu.diloco.compression import chunk_bounds, get_codec
+from opendiloco_tpu.diloco.rendezvous import RendezvousServer
+from opendiloco_tpu.diloco.tcp import TcpBackend
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+ALL_CODECS = [
+    "none", "fp16", "scaled-fp16", "uniform8bit", "quantile8bit",
+    "blockwise8bit",
+]
+# codecs whose chunk payloads carry no per-chunk side-channel: their
+# concatenated chunk payloads must equal the whole-part payload byte-for-byte
+# (quantile8bit repeats the codebook per chunk; blockwise8bit repeats scales)
+_FLAT_CODECS = {"none", "fp16", "scaled-fp16", "uniform8bit"}
+
+
+def _force_fallback():
+    """(module, saved) -- set module._lib=None to exercise the numpy path."""
+    import opendiloco_tpu.native as nm
+
+    saved = (nm._lib, nm._tried)
+    nm._lib, nm._tried = None, True
+    return nm, saved
+
+
+def _chunked_encode(codec, arr, chunk_elems):
+    state = codec.chunk_state(arr)
+    grid = chunk_bounds(arr.size, chunk_elems, codec.chunk_align)
+    return [
+        (grid[k], grid[k + 1], *codec.encode_chunk(arr[grid[k]:grid[k + 1]], state))
+        for k in range(len(grid) - 1)
+    ]
+
+
+def _assert_chunked_matches_whole(name, n, chunk_elems):
+    codec = get_codec(name)
+    rng = np.random.default_rng(n + 1)
+    arr = (rng.standard_normal(n) * 3).astype(np.float32)
+
+    whole_payload, whole_meta = codec.encode(arr)
+    whole_dec = np.empty(n, np.float32)
+    codec.decode_into(bytes(whole_payload), whole_meta, whole_dec)
+
+    chunks = _chunked_encode(codec, arr, chunk_elems)
+    assert chunks[0][0] == 0 and chunks[-1][1] == n
+    if name in _FLAT_CODECS:
+        assert b"".join(bytes(p) for _, _, p, _ in chunks) == bytes(whole_payload)
+
+    # decode_into per chunk reassembles the whole-part decode exactly
+    chunk_dec = np.empty(n, np.float32)
+    for lo, hi, payload, meta in chunks:
+        codec.decode_into(bytes(payload), meta, chunk_dec[lo:hi])
+    np.testing.assert_array_equal(chunk_dec, whole_dec)
+
+    # fused accumulate per chunk == whole-part accumulate, bit for bit
+    base = rng.standard_normal(n).astype(np.float32)
+    acc_whole, acc_chunk = base.copy(), base.copy()
+    codec.decode_accumulate(bytes(whole_payload), whole_meta, acc_whole)
+    for lo, hi, payload, meta in chunks:
+        codec.decode_accumulate(bytes(payload), meta, acc_chunk[lo:hi])
+    np.testing.assert_array_equal(acc_chunk, acc_whole)
+
+
+@pytest.mark.parametrize("name", ALL_CODECS)
+# 0: the barrier / tiny-tensor shape (linspace parts can be empty);
+# 999: single partial chunk; 4096*2+999: two aligned chunks + odd tail
+@pytest.mark.parametrize("n", [0, 999, 4096 * 2 + 999])
+def test_chunked_codec_parity_native(name, n):
+    if not native.available():
+        pytest.skip("native lib not built (make -C native)")
+    _assert_chunked_matches_whole(name, n, chunk_elems=4096)
+
+
+@pytest.mark.parametrize("name", ALL_CODECS)
+@pytest.mark.parametrize("n", [0, 999, 4096 * 2 + 999])
+def test_chunked_codec_parity_fallback(name, n):
+    nm, saved = _force_fallback()
+    try:
+        _assert_chunked_matches_whole(name, n, chunk_elems=4096)
+    finally:
+        nm._lib, nm._tried = saved
+
+
+@pytest.fixture
+def rendezvous():
+    server = RendezvousServer(host="127.0.0.1", port=0).start_in_thread()
+    yield server
+    server.stop()
+
+
+def _make_backends(rendezvous, n, **kwargs):
+    return [
+        TcpBackend(
+            [rendezvous.address],
+            peer_id=f"worker-{i}",
+            matchmaking_time=kwargs.pop("matchmaking_time", 2.0),
+            **kwargs,
+        )
+        for i in range(n)
+    ]
+
+
+def _concurrent_allreduce(backends, arrays_per_peer, timeout=60.0):
+    results = [None] * len(backends)
+    errors = []
+
+    def run(i):
+        try:
+            results[i] = backends[i].all_reduce(
+                arrays_per_peer[i], timeout=timeout
+            )
+        except Exception as e:  # pragma: no cover - surfaced via assert
+            errors.append((i, e))
+
+    threads = [
+        threading.Thread(target=run, args=(i,)) for i in range(len(backends))
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=timeout + 30)
+    assert not errors, errors
+    return results
+
+
+def _peer_arrays(n_peers, seed=7):
+    # sizes chosen so the 2-way part split lands mid-chunk and leaves odd
+    # tails; the scalar exercises the empty-part path for one peer
+    out = []
+    for rank in range(n_peers):
+        rng = np.random.default_rng(seed + rank)
+        out.append([
+            rng.standard_normal(9001).astype(np.float32),
+            rng.standard_normal((3, 1024)).astype(np.float32),
+            np.float32(rank + 0.25) * np.ones((), np.float32),
+        ])
+    return out
+
+
+@pytest.mark.parametrize("compression", ALL_CODECS)
+def test_pipelined_matches_serial(rendezvous, compression, monkeypatch):
+    """The pipelined exchange is bit-identical to the serial one, per codec,
+    and all peers agree on the reduced value (the adopt-decoded-wire-value
+    invariant survives chunking)."""
+    monkeypatch.setenv("ODTP_PIPELINE_CHUNK_ELEMS", "4096")
+    arrays = _peer_arrays(2)
+    results = {}
+    for mode in ("0", "1"):
+        monkeypatch.setenv("ODTP_PIPELINE", mode)
+        backends = _make_backends(rendezvous, 2, compression=compression)
+        try:
+            results[mode] = _concurrent_allreduce(backends, arrays)
+        finally:
+            for b in backends:
+                b.close()
+    for (serial, n_s), (pipe, n_p) in zip(results["0"], results["1"]):
+        assert n_s == n_p == 2
+        for a, b in zip(serial, pipe):
+            np.testing.assert_array_equal(a, b)
+    # cross-peer agreement within the pipelined round
+    for a, b in zip(results["1"][0][0], results["1"][1][0]):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_pipelined_bulk_stream_smoke(rendezvous, monkeypatch):
+    """Every chunk rides the persistent bulk stream (threshold 1) and the
+    reduced value still matches the exact float average."""
+    monkeypatch.setenv("ODTP_PIPELINE", "1")
+    monkeypatch.setenv("ODTP_PIPELINE_CHUNK_ELEMS", "2048")
+    monkeypatch.setenv("ODTP_BULK_THRESHOLD", "1")
+    arrays = _peer_arrays(2, seed=21)
+    backends = _make_backends(rendezvous, 2, compression="none")
+    try:
+        results = _concurrent_allreduce(backends, arrays)
+    finally:
+        for b in backends:
+            b.close()
+    (out0, n0), (out1, n1) = results
+    assert n0 == n1 == 2
+    for k, (a, b) in enumerate(zip(out0, out1)):
+        np.testing.assert_array_equal(a, b)
+        expected = (arrays[0][k].astype(np.float32)
+                    + arrays[1][k].astype(np.float32)) * np.float32(0.5)
+        np.testing.assert_array_equal(a, expected.reshape(a.shape))
+
+
+@pytest.mark.slow
+def test_bench_outer_8_workers(tmp_path):
+    """The full galaxy shape through the real bench harness: 8 worker
+    processes, matchmade to the full group via the rendezvous expect hint,
+    serial + pipelined, zero error rows."""
+    out_path = tmp_path / "OUTER_BENCH.json"
+    env = dict(os.environ)
+    env["ODTP_OUTER_BENCH_OUT"] = str(out_path)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    proc = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(_REPO, "scripts", "bench_outer.py"),
+            "--peers", "8", "--model", "2m", "--rounds", "1",
+            "--codecs", "uniform8bit", "--pipeline", "both",
+        ],
+        capture_output=True, text=True, timeout=1800, env=env,
+    )
+    assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
+    import json
+
+    doc = json.loads(out_path.read_text())
+    rows = doc["rows"]
+    assert len(rows) == 2 and not any("error" in r for r in rows), rows
+    assert {r["pipelined"] for r in rows} == {False, True}
+    assert all(r["peers"] == 8 for r in rows)
+    assert "speedup_vs_serial" in rows[1]

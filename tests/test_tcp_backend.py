@@ -783,9 +783,12 @@ def test_bulk_orphan_stripe_fails_fast():
 
 def test_bulk_striped_allreduce(monkeypatch):
     """End-to-end butterfly all-reduce with striping forced on: results
-    stay exact and _stripe frames actually travel."""
+    stay exact and _stripe frames actually travel. Striping is the serial
+    plane's whole-part transport — the pipelined default sends chunk
+    frames below any realistic stripe floor, so pin serial mode here."""
     from opendiloco_tpu.diloco import bulk as bulk_mod
 
+    monkeypatch.setenv("ODTP_PIPELINE", "0")
     monkeypatch.setenv("ODTP_BULK_THRESHOLD", "1")
     monkeypatch.setenv("ODTP_BULK_STREAMS", "3")
     monkeypatch.setenv("ODTP_BULK_STRIPE_MIN", "64")
