@@ -131,6 +131,21 @@ def _attach_tunnel_evidence(extra: dict) -> None:
         extra["tunnel_evidence"] = os.path.basename(logs[-1])
 
 
+_XLA_ATTN_MFU_REF = 0.289  # PARITY.md: "xla attention, remat=full" same-chip MFU
+
+
+def _vs_xla_attention(tps: float, mfu: float) -> float:
+    """Side-by-side same-chip ratio vs the XLA-attention baseline — the
+    honest companion to ``vs_baseline`` (which divides by the 0.40-MFU
+    north star and reads like an absolute claim). Prefers an xla variant
+    measured in THIS run; otherwise scales by the committed PARITY.md
+    xla-attention MFU (28.9%), which is a same-chip tokens/sec ratio."""
+    xla = [v for k, v in _RESULTS.items() if k.startswith("xla") and v > 0]
+    if xla:
+        return round(tps / max(xla), 4)
+    return round(mfu / _XLA_ATTN_MFU_REF, 4)
+
+
 def _emit(error: str = None) -> bool:
     """Print the one JSON line. Returns True iff a nonzero value was emitted."""
     # exactly one JSON line, even when the watchdog fires while the main
@@ -160,6 +175,7 @@ def _emit(error: str = None) -> bool:
                     "value": round(tps, 1),
                     "unit": "tokens/sec/chip",
                     "vs_baseline": round(mfu / 0.40, 4),
+                    "vs_xla_attention": _vs_xla_attention(tps, mfu),
                     "extra": extra,
                 }
             ),
@@ -195,6 +211,9 @@ def _emit(error: str = None) -> bool:
                         "value": banked["tokens_per_sec_per_chip"],
                         "unit": "tokens/sec/chip",
                         "vs_baseline": round(banked["mfu"] / 0.40, 4),
+                        "vs_xla_attention": _vs_xla_attention(
+                            banked["tokens_per_sec_per_chip"], banked["mfu"]
+                        ),
                         "extra": extra,
                     }
                 ),
@@ -210,6 +229,7 @@ def _emit(error: str = None) -> bool:
                     "value": 0,
                     "unit": "tokens/sec/chip",
                     "vs_baseline": 0,
+                    "vs_xla_attention": 0,
                     "extra": zero_extra,
                 }
             ),

@@ -40,6 +40,7 @@ from typing import Callable, Optional
 import numpy as np
 
 from opendiloco_tpu import native
+from opendiloco_tpu.diloco import chaos
 from opendiloco_tpu.diloco.wire import MAGIC, MAX_HEADER, WireError
 from opendiloco_tpu.utils.logger import get_text_logger
 
@@ -227,6 +228,16 @@ def send_frame_sync(
     header = json.dumps(
         {"type": msg_type, "meta": meta, "payload_len": nbytes}
     ).encode()
+    cp = chaos.plane()
+    if cp is not None and nbytes and cp.truncate("bulk_send"):
+        # mid-transfer truncation: the header promises nbytes but only half
+        # go out before the "link" dies. The receiver wedges in recvall
+        # until the dropped connection resets it; the sender's retry /
+        # RPC-fallback machinery owns recovery.
+        native.sock_sendall(sock, _HDR.pack(MAGIC, len(header)) + header)
+        view = memoryview(payload).cast("B")
+        native.sock_sendall(sock, view[: nbytes // 2])
+        raise ConnectionResetError("chaos: bulk payload truncated mid-transfer")
     native.sock_sendall(sock, _HDR.pack(MAGIC, len(header)) + header)
     if nbytes:
         _send_payload(sock, payload)
@@ -469,7 +480,19 @@ class BulkSender:
             )
             streams = _num_streams()
             striped = streams > 1 and nbytes >= max(_stripe_min(), streams)
+            cp = chaos.plane()
             for attempt in (0, 1):
+                if cp is not None:
+                    d = cp.delay_s("bulk_send")
+                    if d:
+                        time.sleep(d)
+                    if cp.drop_conn("bulk_send"):
+                        self._drop(key)
+                        if attempt == 1:
+                            raise ConnectionResetError(
+                                "chaos: bulk connection dropped"
+                            )
+                        continue
                 try:
                     if striped:
                         self._send_striped(key, msg, meta, payload, streams)
@@ -608,6 +631,11 @@ class BulkStream:
     def send(self, msg: str, meta: dict, payload) -> None:
         if self._broken:
             raise WireError(f"bulk stream to {self._key} is broken")
+        cp = chaos.plane()
+        if cp is not None:
+            d = cp.delay_s("bulk_stream")
+            if d:  # write-side latency on the pipelined chunk path
+                time.sleep(d)
         try:
             send_frame_sync(self._sock, msg, meta, payload)
             self._pending += 1

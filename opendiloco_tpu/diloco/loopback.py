@@ -20,6 +20,7 @@ from typing import Any, Callable, Optional
 
 import numpy as np
 
+from opendiloco_tpu.diloco import chaos
 from opendiloco_tpu.diloco.backend import (
     AllReduceError,
     OuterBackend,
@@ -56,8 +57,41 @@ class LoopbackBackend(OuterBackend):
     def __init__(self, world: LoopbackWorld, peer_id: str):
         self.world = world
         self._peer_id = peer_id
+        # round health ledger, same shape as TcpBackend's: loopback is the
+        # oracle the chaos tests hold the TCP rescaling math against
+        self.round_ledger: list[dict] = []
+        self.last_round_health: dict = {}
         with world.lock:
             world.live.add(peer_id)
+
+    def _chaos_gate(self) -> None:
+        """Chaos hooks for the in-process backend: straggler/latency sleeps,
+        plus transient contribution failures retried with the same bounded
+        backoff the TCP round retry uses. Zero-cost when ODTP_CHAOS unset."""
+        cp = chaos.plane()
+        if cp is None:
+            return
+        d = cp.straggle_s() + cp.delay_s("loopback")
+        if d:
+            time.sleep(d)
+        attempt = 0
+        while cp.drop_conn("loopback"):
+            time.sleep(min(chaos.backoff_s(attempt), 1.0))
+            attempt += 1
+
+    def _record_round_health(self, tag, epoch, group: int) -> None:
+        expected = self.world.n_peers
+        health = {
+            "round": f"{tag}-epoch-{epoch}",
+            "group_size": group,
+            "expected": expected,
+            "elastic": bool(group < expected),
+            "retries": 0,
+        }
+        self.last_round_health = health
+        self.round_ledger.append(health)
+        if len(self.round_ledger) > 256:
+            del self.round_ledger[:-256]
 
     @property
     def peer_id(self) -> str:
@@ -73,8 +107,11 @@ class LoopbackBackend(OuterBackend):
         moment they close(). Lossy codecs are applied to each contribution
         to model wire compression faithfully. ``group_cap`` partitions the
         live peers into deterministic per-round groups (gossip mode)."""
+        self._chaos_gate()
         if group_cap:
-            return self._group_reduce(arrays, tag, epoch, group_cap, timeout)
+            out, n = self._group_reduce(arrays, tag, epoch, group_cap, timeout)
+            self._record_round_health(tag, epoch, n)
+            return out, n
         w = self.world
         codec = w.codec
         compressed = [
@@ -110,6 +147,7 @@ class LoopbackBackend(OuterBackend):
                 w.cond.wait(timeout=min(remaining, 0.1))
             result = [a.copy() for a in w._result]
             group = w._result_group
+        self._record_round_health(tag, epoch, group)
         return result, group
 
     def _group_reduce(self, arrays, tag, epoch, cap, timeout):

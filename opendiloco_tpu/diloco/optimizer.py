@@ -717,6 +717,7 @@ class DiLoCoOptimizer:
         self._landed_metrics = {
             "outer_allreduce_s": landed_s,
             "num_peers": group_size,
+            **self._round_health_metrics(),
         }
         self.last_outer_metrics = dict(self._landed_metrics)
         log.info(
@@ -727,6 +728,19 @@ class DiLoCoOptimizer:
             landed_s,
         )
         return state
+
+    def _round_health_metrics(self) -> dict:
+        """Elastic-round fields from the backend's health ledger, merged
+        into the metrics row of every landed outer round: dashboards and
+        the chaos soak read partial groups as data, not as errors."""
+        health = getattr(self.backend, "last_round_health", None) or {}
+        out = {}
+        if "elastic" in health:
+            out["elastic"] = bool(health["elastic"])
+            out["expected_peers"] = int(health.get("expected", 0))
+        if health.get("retries"):
+            out["round_retries"] = int(health["retries"])
+        return out
 
     def _check_group_size(self, group_size: int) -> None:
         if group_size < self.max_num_peers:
@@ -979,6 +993,7 @@ class DiLoCoOptimizer:
             "outer_allreduce_s": allreduce_s,
             "outer_wait_s": wait_s,
             "num_peers": group_size,
+            **self._round_health_metrics(),
         }
         self.last_outer_metrics = outer_metrics
         return state, outer_metrics

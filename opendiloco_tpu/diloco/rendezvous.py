@@ -20,6 +20,7 @@ import uuid
 from dataclasses import dataclass, field
 from typing import Optional
 
+from opendiloco_tpu.diloco import chaos
 from opendiloco_tpu.diloco.wire import STREAM_LIMIT, read_frame, send_frame
 from opendiloco_tpu.utils.logger import get_text_logger
 
@@ -274,6 +275,16 @@ class RendezvousServer:
         try:
             msg, meta, _ = await read_frame(reader, timeout=120.0)
         except Exception:
+            self._writers.discard(writer)
+            writer.close()
+            return
+        cp = chaos.plane()
+        if cp is not None and cp.rdv_blackout(
+            meta.get("round") if msg == "join_group" else None
+        ):
+            # scripted daemon blackout: drop the frame without replying --
+            # to the worker this daemon is dead, so failover/worker-hosted
+            # rendezvous and round backoff machinery must carry the swarm
             self._writers.discard(writer)
             writer.close()
             return

@@ -32,6 +32,7 @@ from typing import Any, Callable, Optional
 
 import numpy as np
 
+from opendiloco_tpu.diloco import chaos
 from opendiloco_tpu.diloco.backend import AllReduceError, OuterBackend, PeerProgress
 from opendiloco_tpu.diloco.compression import Codec, chunk_bounds, get_codec
 from opendiloco_tpu.diloco.wire import (
@@ -84,25 +85,67 @@ def _pipeline_chunk_elems() -> int:
 # -- state (de)serialization: raw numpy bytes + JSON meta, no pickle ---------
 
 
-def serialize_state(state: dict[str, Any]) -> tuple[dict, bytes]:
+def serialize_state(
+    state: dict[str, Any], codec: Optional[Codec] = None
+) -> tuple[dict, bytes]:
+    """Flatten a state tree to (JSON meta, payload bytes).
+
+    With ``codec``, float32 arrays ride the wire codec-encoded (the
+    reference's state_averaging_compression, open_diloco/utils.py:83-121:
+    onboarding downloads are fp16 by default, halving the late-joiner
+    catch-up bytes); non-f32 arrays (int step counters, fp64) stay raw.
+    Per-array codec metas travel in the header's ``enc`` list so
+    ``deserialize_state`` is self-describing either way."""
     arrays: list[np.ndarray] = []
     meta = _encode_obj(state, arrays)
-    blobs, offsets = [], []
+    blobs, offsets, encs = [], [], []
     off = 0
     for a in arrays:
-        b = np.ascontiguousarray(a).tobytes()
+        ac = np.ascontiguousarray(a)
+        if codec is not None and codec.name != "none" and ac.dtype == np.float32:
+            payload, cmeta = codec.encode(ac.reshape(-1))
+            b = bytes(payload)
+            encs.append({"codec": codec.name, "meta": cmeta})
+        else:
+            b = ac.tobytes()
+            encs.append(None)
         offsets.append((off, len(b), str(a.dtype), list(a.shape)))
         off += len(b)
         blobs.append(b)
-    return {"tree": meta, "arrays": offsets}, b"".join(blobs)
+    out_meta = {"tree": meta, "arrays": offsets}
+    if any(e is not None for e in encs):
+        out_meta["enc"] = encs
+    return out_meta, b"".join(blobs)
 
 
 def deserialize_state(meta: dict, payload: bytes) -> dict[str, Any]:
-    arrays = [
-        np.frombuffer(payload[o : o + n], dtype=dt).reshape(shape).copy()
-        for o, n, dt, shape in meta["arrays"]
-    ]
+    encs = meta.get("enc") or [None] * len(meta["arrays"])
+    arrays = []
+    for (o, n, dt, shape), enc in zip(meta["arrays"], encs):
+        raw = payload[o : o + n]
+        if enc is not None:
+            c = get_codec(enc["codec"])
+            size = int(np.prod(shape)) if shape else 1
+            a = np.asarray(
+                c.decode(raw, (size,), enc["meta"]), dtype=np.float32
+            ).reshape(shape).copy()
+        else:
+            a = np.frombuffer(raw, dtype=dt).reshape(shape).copy()
+        arrays.append(a)
     return _decode_obj(meta["tree"], arrays)
+
+
+def state_codec(configured: Codec) -> Codec:
+    """Codec for onboarding-state payloads: the configured codec when it is
+    a float16-family codec, else plain fp16 (8-bit codecs are tuned for
+    pseudo-gradient magnitudes, not master weights). ODTP_STATE_CODEC
+    overrides ("none" restores raw float32)."""
+    name = os.environ.get("ODTP_STATE_CODEC")
+    if name:
+        return get_codec(name)
+    if configured.name in ("fp16", "scaled-fp16"):
+        return configured
+    return get_codec("fp16")
 
 
 def _encode_obj(obj: Any, arrays: list[np.ndarray]) -> Any:
@@ -160,8 +203,15 @@ class TcpBackend(OuterBackend):
         self.port = port
         self._peer_id = peer_id or f"peer-{uuid.uuid4().hex[:12]}"
         self.codec: Codec = get_codec(compression)
+        self._state_codec = state_codec(self.codec)
         self.matchmaking_time = matchmaking_time
         self.rpc_timeout = rpc_timeout
+        # round health ledger: one dict per completed outer round
+        # (group_size, expected, elastic, retries, per-stage timings);
+        # last_round_health mirrors the newest entry for cheap polling
+        self.round_ledger: list[dict] = []
+        self._ledger_cap = 256
+        self.last_round_health: dict = {}
         # known swarm size: when > 0, the rendezvous closes the matchmaking
         # window as soon as this many joiners arrive instead of waiting out
         # the full window / trusting its (possibly stale) live-peer registry
@@ -208,6 +258,7 @@ class TcpBackend(OuterBackend):
         # optimizer's persistent grad buffers (hivemind_diloco.py:68-119).
         self._free_bufs: dict[int, list[np.ndarray]] = {}
         self._retired_bufs: list[np.ndarray] = []  # reclaim at next round
+        self._round_attempt = 0  # current all_reduce retry index (ledger)
         self._pool_lock = threading.Lock()  # caller + event-loop threads
         self._progress_cache: list[PeerProgress] = []
         self._own_progress: Optional[PeerProgress] = None
@@ -429,9 +480,16 @@ class TcpBackend(OuterBackend):
         last_err: Optional[Exception] = None
         retried_timeout = False
         attempts = 0
+        cp = chaos.plane()
         while attempts < len(self.rendezvous_list):
             addr = self.rendezvous_list[self._rdv_idx]
             try:
+                if cp is not None:
+                    d = cp.delay_s("rdv_rpc")
+                    if d:
+                        await asyncio.sleep(d)
+                    if cp.drop_conn("rdv_rpc"):
+                        raise ConnectionResetError("chaos: rendezvous RPC dropped")
                 resp = await request(*addr, msg, meta, payload, timeout=timeout)
                 if self._worker_rdv_addrs and addr not in self._worker_rdv_addrs:
                     self._prune_worker_rdv(keep=addr)
@@ -546,6 +604,12 @@ class TcpBackend(OuterBackend):
         """Serve frames until the peer hangs up: connections persist across
         rounds so bulk transfers keep a warmed-up TCP window instead of
         re-running slow-start on every push/result frame."""
+        cp = chaos.plane()
+        if cp is not None and cp.drop_conn("peer_accept"):
+            # refuse the inbound connection outright: the client's pooled
+            # connection dies and its retry/backoff paths take over
+            writer.close()
+            return
         try:
             while True:
                 try:
@@ -557,6 +621,10 @@ class TcpBackend(OuterBackend):
                 ):
                     break
                 if msg in ("push", "result"):
+                    if cp is not None:
+                        d = cp.delay_s("mailbox")
+                        if d:  # read-side latency before the frame lands
+                            await asyncio.sleep(d)
                     key = _mailbox_key(msg, meta)
                     async with self._mailbox_cv:
                         self._mailbox[key] = (meta, payload)
@@ -577,7 +645,9 @@ class TcpBackend(OuterBackend):
                     if self._state_provider is None:
                         await send_frame(writer, "error", {"error": "no state"})
                     else:
-                        smeta, sblob = serialize_state(self._state_provider())
+                        smeta, sblob = serialize_state(
+                            self._state_provider(), codec=self._state_codec
+                        )
                         await send_frame(writer, "state", smeta, sblob)
                 else:
                     await send_frame(writer, "error", {"error": f"unknown {msg!r}"})
@@ -612,7 +682,21 @@ class TcpBackend(OuterBackend):
         lock = self._conn_locks.setdefault(key, asyncio.Lock())
         from opendiloco_tpu.diloco.wire import _tune_socket
 
+        cp = chaos.plane()
         for attempt in (0, 1):
+            if cp is not None:
+                d = cp.delay_s("peer_rpc")
+                if d:
+                    await asyncio.sleep(d)
+                if cp.drop_conn("peer_rpc"):
+                    # simulate the connection dying under us: drop the pooled
+                    # entry so the existing stale-connection retry reopens it
+                    stale = self._conn_pool.pop(key, None)
+                    if stale is not None:
+                        stale[1].close()
+                    if attempt == 1:
+                        raise ConnectionResetError("chaos: peer RPC dropped")
+                    continue
             async with lock:
                 entry = self._conn_pool.get(key)
                 if entry is None or entry[1].is_closing():
@@ -846,6 +930,30 @@ class TcpBackend(OuterBackend):
             while len(self._free_bufs) > 4:
                 del self._free_bufs[min(self._free_bufs)]
 
+    def _record_round_health(
+        self, join_key: str, n: int, expected: int, elastic: bool, timings: dict
+    ) -> None:
+        """Append one row to the round health ledger (and keep the legacy
+        ``last_round_timings`` view in sync). Solo and elastic rounds are
+        recorded as data, not errors: the bench/soak layers read this
+        instead of inferring health from exceptions."""
+        self.last_round_timings = timings
+        health = {
+            "round": join_key,
+            "group_size": n,
+            "expected": expected,
+            "elastic": elastic,
+            "retries": self._round_attempt,
+            **{k: round(v, 6) for k, v in timings.items()},
+        }
+        cp = chaos.plane()
+        if cp is not None:
+            health["chaos_faults"] = dict(cp.counters)
+        self.last_round_health = health
+        self.round_ledger.append(health)
+        if len(self.round_ledger) > self._ledger_cap:
+            del self.round_ledger[: -self._ledger_cap]
+
     def all_reduce(
         self, arrays, *, timeout=None, tag: str = "grads", epoch=None, group_cap=0
     ):
@@ -868,14 +976,20 @@ class TcpBackend(OuterBackend):
         for b in reclaim:
             self._checkin_buf(b)
         timeout = timeout or 300.0
-        deadline = time.monotonic() + timeout
         if epoch is None:
             epoch = self._own_progress.epoch if self._own_progress else 0
         round_key = f"{tag}-epoch-{epoch}"
         last_err: Optional[Exception] = None
-        for attempt in range(3):
-            if time.monotonic() >= deadline:
-                break
+        retries = chaos.round_retries()
+        for attempt in range(retries):
+            self._round_attempt = attempt  # feeds the health ledger
+            # each re-formed round gets a FRESH deadline: a round that
+            # wedges on a split-brain group (e.g. divergent membership
+            # views after a daemon blackout) burns its whole window
+            # waiting on a fingerprint nobody serves, and a retry with
+            # only the scraps of a shared deadline dies before the fresh
+            # matchmaking window can close
+            deadline = time.monotonic() + timeout
             try:
                 return self._run(
                     self._all_reduce_round(
@@ -885,11 +999,21 @@ class TcpBackend(OuterBackend):
                 )
             except (asyncio.TimeoutError, AllReduceError, OSError) as e:
                 last_err = e
+                if attempt + 1 >= retries:
+                    break
+                # bounded exponential backoff + jitter before re-forming:
+                # an immediate retry after a daemon blackout or peer reset
+                # re-forms against the same dead endpoint and burns an
+                # attempt; backing off lets failover/TTL machinery settle
+                pause = chaos.backoff_s(attempt)
                 log.warning(
-                    "all-reduce attempt %d failed (%s); re-forming group",
+                    "all-reduce attempt %d failed (%s); re-forming group "
+                    "in %.2fs",
                     attempt,
                     e,
+                    pause,
                 )
+                time.sleep(pause)
         raise AllReduceError(f"all-reduce failed: {last_err}")
 
     async def _all_reduce_round(
@@ -947,7 +1071,19 @@ class TcpBackend(OuterBackend):
             except Exception:
                 pass  # the retry's join_group meta re-registers anyway
             raise AllReduceError(f"matchmade group {group} does not contain self")
+        # elastic round bookkeeping: the average is always rescaled by the
+        # ACTUAL contributor count n (every exchange path divides by n), so a
+        # partial group is a correct, smaller average — record it as data
+        expected = self.expect_peers or max(n, len(self._peers_view) or n)
+        elastic = bool(n < expected)
+        if elastic:
+            log.warning(
+                "elastic round %s: proceeding with %d/%d peers",
+                join_key, n, expected,
+            )
         if n == 1:
+            timings["matchmake_s"] = time.monotonic() - t_mm
+            self._record_round_health(join_key, n, expected, elastic, timings)
             return [a.copy() for a in arrays], 1
         # fingerprint the membership: retried rounds (same join_key) must not
         # consume stale mailbox traffic from a differently-shaped group
@@ -992,7 +1128,7 @@ class TcpBackend(OuterBackend):
             group, my_idx, n, parts, bounds, flat.size, round_key, deadline,
             scratch, timings,
         )
-        self.last_round_timings = timings
+        self._record_round_health(join_key, n, expected, elastic, timings)
 
         # 6. hand back per-array views of the reassembled buffer
         out, off = [], 0

@@ -29,6 +29,7 @@ import numpy as np
 
 from opendiloco_tpu import ckpt as ckpt_lib
 from opendiloco_tpu.config import Config, DilocoConfig, parse_argv
+from opendiloco_tpu.diloco import chaos
 from opendiloco_tpu.data.dataloader import get_dataloader
 from opendiloco_tpu.diloco.backend import OuterBackend
 from opendiloco_tpu.diloco.optimizer import DiLoCoOptimizer, PeerDropError
@@ -64,6 +65,10 @@ def train(config: Config, backend: Optional[OuterBackend] = None) -> dict:
     """Returns a summary dict (final step/loss) for programmatic callers."""
     world_rank = config.diloco.world_rank if config.diloco else 0
     os.environ.setdefault("DILOCO_WORLD_RANK", str(world_rank))
+    _cp = chaos.plane()
+    if _cp is not None:
+        # scope rank-targeted faults (straggle_worker, kill_worker) to us
+        _cp.set_identity(world_rank)
 
     if config.multihost:
         # in-worker multi-host slice: every host of the slice runs this

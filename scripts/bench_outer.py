@@ -124,6 +124,7 @@ def worker_main() -> None:
     # explicitly per sweep
     os.environ["ODTP_PIPELINE"] = args.pipeline
 
+    from opendiloco_tpu.diloco import chaos
     from opendiloco_tpu.diloco.backend import PeerProgress
     from opendiloco_tpu.diloco.tcp import TcpBackend
 
@@ -202,14 +203,19 @@ def worker_main() -> None:
     n = 0
     want = expected_group(args.peers, args.group_cap)
     retries = 0
+    group_sizes: list[int] = []
+    elastic_rounds = 0
     # on a loaded 1-core box the peers drift apart across rounds (codec CPU
     # is serialized), so a matchmaking window that fit round 1 splits round
     # 3. Two mitigations, both deterministic across workers: an untimed
     # barrier before every timed round re-aligns the swarm, and a partial
-    # group is retried with a doubled window instead of becoming an error
-    # row (every member of every partial group sees n < want, so all retry
-    # in lockstep; skipped under --group-cap where a capped group can't
-    # tell a split from a healthy partition)
+    # group is first retried with a doubled window (every member of every
+    # partial group sees n < want, so all retry in lockstep; skipped under
+    # --group-cap where a capped group can't tell a split from a healthy
+    # partition). A partial group that SURVIVES the retries is an ELASTIC
+    # round: its average is correctly rescaled by the actual contributor
+    # count, so it is recorded as data (group size + elastic flag), never
+    # as an error row.
     while len(times) < args.rounds:
         try:
             backend.barrier(timeout=args.timeout)
@@ -222,9 +228,7 @@ def worker_main() -> None:
             data, timeout=args.timeout, group_cap=args.group_cap
         )
         dt = time.perf_counter() - t0
-        if n < want:
-            if args.group_cap or retries >= 3:
-                break  # solo/partial round: must not pass as a result
+        if n < want and not args.group_cap and retries < 3:
             retries += 1
             backend.matchmaking_time = min(backend.matchmaking_time * 2, 120.0)
             print(
@@ -233,9 +237,12 @@ def worker_main() -> None:
                 flush=True,
             )
             continue  # timing discarded; re-run this round
+        if n < want:
+            elastic_rounds += 1
+        group_sizes.append(n)
         times.append(dt)
     timings = {
-        k: round(v, 3)
+        k: (round(v, 3) if isinstance(v, float) else v)
         for k, v in getattr(backend, "last_round_timings", {}).items()
     }
     backend.close()
@@ -246,12 +253,19 @@ def worker_main() -> None:
             flush=True,
         )
         print("TIMINGS " + json.dumps(timings), flush=True)
-    if n < expected_group(args.peers, args.group_cap):
-        # EVERY worker reports its own partial round (with group_cap only
-        # rank 0's group would otherwise be validated); rank 0 printed its
-        # RESULT first so the parent can still classify its row
-        print(f"PARTIAL n={n} in rank {args.rank}", flush=True)
-        sys.exit(4)
+    # EVERY worker reports its round health (with group_cap only rank 0's
+    # group would otherwise be visible); the parent aggregates these into
+    # the row instead of classifying partial groups as errors
+    health = {
+        "rank": args.rank,
+        "group_sizes": group_sizes,
+        "elastic_rounds": elastic_rounds,
+        "retries": retries,
+    }
+    cp = chaos.plane()
+    if cp is not None:
+        health["faults"] = dict(cp.counters)
+    print("HEALTH " + json.dumps(health), flush=True)
 
 
 def _append_row(row: dict) -> None:
@@ -427,33 +441,16 @@ def run_sweep(args, server, nbytes, base_env, cap_bps: float) -> None:
                  if l.startswith("RESULT")),
                 None,
             )
-            # classify a partial round (any worker's) before generic
-            # failure: workers exit 4 on a partial group but rank 0 still
-            # prints RESULT
+            # elastic rounds (partial groups that survived the in-worker
+            # retries) are DATA, not errors: every worker prints a HEALTH
+            # line with its per-round group sizes + fault counters, and
+            # the row records them alongside the timings
             want = expected_group(args.peers, args.group_cap)
-            group_n = int(line.split()[-1].split("=")[1]) if line else 0
-            partial = any(
-                l.startswith("PARTIAL") for o in outs for l in o.splitlines()
-            )
-            if line is not None and (group_n < want or partial):
-                print(f"{label:>22}: SOLO/PARTIAL GROUP n={group_n}")
-                _append_row({
-                    "model": args.model, "peers": args.peers,
-                    "codec": compression, **plane,
-                    "error": (
-                        f"matchmade group {group_n} < {want}"
-                        if group_n < want
-                        else "partial group in a non-rank-0 worker"
-                    ),
-                    # the partial worker's tail makes the row diagnosable
-                    # (RETRY lines carry the observed group sizes)
-                    "detail": [
-                        " | ".join(o.splitlines()[-3:])[-400:] for o in outs
-                        if "PARTIAL" in o or "RETRY" in o
-                    ][:4],
-                    **cap_note,
-                })
-                continue
+            healths = [
+                json.loads(l.split(None, 1)[1])
+                for o in outs for l in o.splitlines()
+                if l.startswith("HEALTH ")
+            ]
             if line is None or any(p.returncode for p in procs):
                 print(f"{label:>22}: FAILED")
                 _append_row({
@@ -492,6 +489,17 @@ def run_sweep(args, server, nbytes, base_env, cap_bps: float) -> None:
                 ),
                 3,
             )
+            rank0_health = next(
+                (h for h in healths if h.get("rank") == 0), {}
+            )
+            group_sizes = rank0_health.get("group_sizes") or []
+            elastic_rounds = max(
+                (h.get("elastic_rounds", 0) for h in healths), default=0
+            )
+            faults: dict[str, int] = {}
+            for h in healths:
+                for k, v in (h.get("faults") or {}).items():
+                    faults[k] = faults.get(k, 0) + v
             row = {
                 "model": args.model, "mb_fp32": round(nbytes / 1e6),
                 "peers": args.peers, "codec": compression, **plane,
@@ -510,6 +518,17 @@ def run_sweep(args, server, nbytes, base_env, cap_bps: float) -> None:
                     if kv.get("retries", "0") != "0"
                     else {}
                 ),
+                "group_size": int(kv.get("n", want) or want),
+                "elastic": bool(elastic_rounds),
+                **(
+                    {
+                        "group_sizes": group_sizes,
+                        "elastic_rounds": elastic_rounds,
+                    }
+                    if elastic_rounds
+                    else {}
+                ),
+                **({"faults": faults} if faults else {}),
                 "eff_gbps": round(eff, 3),
                 "loopback_ceiling_gbps": round(ceiling, 3),
                 "normalized_eff": round(eff / norm_base, 4),
@@ -523,10 +542,15 @@ def run_sweep(args, server, nbytes, base_env, cap_bps: float) -> None:
             if not pipelined:
                 serial_mean = trimmed
             _append_row(row)
+            elastic_note = (
+                f"  [elastic: {elastic_rounds} partial round(s), "
+                f"groups {group_sizes}]"
+                if elastic_rounds else ""
+            )
             print(
                 f"{label:>22}: {best * 1e3:8.0f} ms/round best  "
                 f"({eff:5.2f} GB/s eff, ceiling {ceiling:5.2f} GB/s, "
-                f"normalized {eff / norm_base:5.1%}){speed_note}"
+                f"normalized {eff / norm_base:5.1%}){speed_note}{elastic_note}"
             )
 
 

@@ -1,0 +1,263 @@
+#!/usr/bin/env python
+"""Chaos soak: an 8-worker DiLoCo galaxy trained under scripted fire.
+
+Real TCP data plane (one ``python -m opendiloco_tpu.train`` process per
+worker + one rendezvous daemon), 2m model on fake data, with the
+ODTP_CHAOS fault plane armed end to end:
+
+- every worker injects random connection drops + RPC latency
+  (``drop_conn``/``delay_ms``, per-rank seed so runs replay);
+- the rendezvous daemon blacks out mid-soak (``blackout_rdv``) and the
+  workers must failover/backoff through it;
+- the parent SIGKILLs one worker mid-run and restarts it WITHOUT
+  ``--diloco.skip-load-from-peers`` so the straggler re-onboards through
+  the (fp16-compressed) fetch_state path.
+
+The soak passes iff every outer round completed (full or elastic), loss
+descended, and there are zero error rows. The verdict + per-worker
+round/fault accounting is banked to CHAOS_SOAK.json at the repo root:
+
+    python scripts/chaos_soak.py [--workers 8] [--rounds 6] [--out ...]
+"""
+import argparse
+import json
+import os
+import pickle
+import re
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+WORKER_CHAOS = "seed={seed};drop_conn=0.05;delay_ms=5..30"
+DAEMON_CHAOS = "seed=99;blackout_rdv=r3;blackout_s=2.0"
+
+
+def worker_env(rank: int) -> dict:
+    env = dict(os.environ)
+    env["OPENDILOCO_TPU_PLATFORM"] = "cpu"
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["ODTP_CHAOS"] = WORKER_CHAOS.format(seed=7 + rank)
+    return env
+
+
+def spawn_daemon() -> tuple[subprocess.Popen, str]:
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["ODTP_CHAOS"] = DAEMON_CHAOS
+    d = subprocess.Popen(
+        [
+            sys.executable, "-m", "opendiloco_tpu.diloco.rendezvous",
+            "--host", "127.0.0.1", "--port", "0",
+        ],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env, cwd=REPO,
+    )
+    while True:
+        line = d.stdout.readline()
+        assert line, "rendezvous daemon died before announcing its port"
+        if "initial_peers =" in line:
+            return d, line.strip().split()[-1].replace("0.0.0.0", "127.0.0.1")
+
+
+def spawn_worker(
+    rank: int, address: str, log_path: str, args, *, onboard: bool
+) -> subprocess.Popen:
+    cli = [
+        sys.executable, "-m", "opendiloco_tpu.train",
+        "--path-model", args.model,
+        "--fake-data",
+        "--seq-length", "64",
+        "--per-device-train-batch-size", "4",
+        "--total-batch-size", "32",
+        "--lr", "3e-3",
+        "--warmup-steps", "4",
+        "--total-steps", str(args.rounds * args.local_steps),
+        "--precision", "fp32",
+        "--metric-logger-type", "dummy",
+        "--project", log_path,
+        "--no-ckpt.interval",
+        "--diloco.local-steps", str(args.local_steps),
+        "--diloco.initial-peers", address,
+        "--diloco.world-rank", str(rank),
+        "--diloco.galaxy-size", str(args.workers),
+        "--diloco.matchmaking-time", "3.0",
+        "--diloco.averaging-timeout", "60",
+        "--diloco.all-reduce-strategy", "no_wait",
+        "--diloco.backend", "tcp",
+    ]
+    if not onboard:
+        cli.append("--diloco.skip-load-from-peers")
+    return subprocess.Popen(
+        cli, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        env=worker_env(rank), cwd=REPO,
+    )
+
+
+_FAULT_RE = re.compile(r"chaos: injected (\w+)")
+
+
+def fault_counts(*texts: str) -> dict:
+    counts: dict[str, int] = {}
+    for t in texts:
+        for m in _FAULT_RE.finditer(t or ""):
+            counts[m.group(1)] = counts.get(m.group(1), 0) + 1
+    return counts
+
+
+def read_rows(path: str) -> list[dict]:
+    try:
+        with open(path, "rb") as f:
+            return pickle.load(f)
+    except Exception:
+        return []
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--workers", type=int, default=8)
+    ap.add_argument("--model", default="2m")
+    ap.add_argument("--rounds", type=int, default=6)
+    ap.add_argument("--local-steps", type=int, default=3)
+    ap.add_argument("--kill-rank", type=int, default=-1,
+                    help="rank to SIGKILL+restart (default: last)")
+    ap.add_argument("--kill-after-s", type=float, default=50.0)
+    ap.add_argument("--timeout", type=float, default=1200.0)
+    ap.add_argument("--out", default=os.path.join(REPO, "CHAOS_SOAK.json"))
+    ap.add_argument("--workdir", default="/tmp/odtp_chaos_soak")
+    args = ap.parse_args()
+    kill_rank = args.kill_rank if args.kill_rank >= 0 else args.workers - 1
+
+    os.makedirs(args.workdir, exist_ok=True)
+    t0 = time.time()
+    daemon, address = spawn_daemon()
+    print(f"rendezvous (blackout-armed) at {address}")
+
+    logs = {
+        r: os.path.join(args.workdir, f"soak_w{r}.pkl")
+        for r in range(args.workers)
+    }
+    procs = {
+        r: spawn_worker(r, address, logs[r], args, onboard=False)
+        for r in range(args.workers)
+    }
+    print(f"{args.workers} workers up; SIGKILL of rank {kill_rank} in "
+          f"{args.kill_after_s:.0f}s")
+
+    time.sleep(args.kill_after_s)
+    procs[kill_rank].send_signal(signal.SIGKILL)
+    killed_out, killed_err = procs[kill_rank].communicate(timeout=30)
+    print(f"rank {kill_rank} SIGKILLed; restarting with peer onboarding")
+    restart_log = os.path.join(args.workdir, f"soak_w{kill_rank}_restart.pkl")
+    restart = spawn_worker(
+        kill_rank, address, restart_log, args, onboard=True
+    )
+
+    outs: dict[int, tuple[str, str]] = {}
+    deadline = time.time() + args.timeout
+    fails: list[str] = []
+    survivors = {r: p for r, p in procs.items() if r != kill_rank}
+    survivors[kill_rank] = restart
+    for r, p in sorted(survivors.items()):
+        try:
+            outs[r] = p.communicate(timeout=max(10.0, deadline - time.time()))
+        except subprocess.TimeoutExpired:
+            p.kill()
+            o, e = p.communicate(timeout=30)
+            outs[r] = (o, e)
+            fails.append(f"rank {r}: timed out")
+        if p.returncode != 0 and f"rank {r}" not in " ".join(fails):
+            fails.append(
+                f"rank {r}: exit {p.returncode}\n{outs[r][1][-1500:]}"
+            )
+    daemon.terminate()
+    try:
+        daemon_out = daemon.communicate(timeout=15)[0]
+    except subprocess.TimeoutExpired:
+        daemon.kill()
+        daemon_out = daemon.communicate()[0]
+
+    # -- verdict ------------------------------------------------------------
+    per_worker = []
+    error_rows = 0
+    for r in range(args.workers):
+        rows = read_rows(restart_log if r == kill_rank else logs[r])
+        finite = [row for row in rows if np.isfinite(row.get("Loss", np.nan))]
+        error_rows += len(rows) - len(finite)
+        elastic = sum(1 for row in rows if row.get("elastic"))
+        # mean over the first/last 3 rows: single-step loss on fake data
+        # is noise-dominated and a one-row comparison flaps
+        losses = [row["Loss"] for row in finite]
+        per_worker.append({
+            "rank": r,
+            "restarted": r == kill_rank,
+            "steps": len(rows),
+            "final_outer_epoch": rows[-1]["outer_epoch"] if rows else None,
+            "loss_first": round(float(np.mean(losses[:3])), 4)
+            if losses else None,
+            "loss_last": round(float(np.mean(losses[-3:])), 4)
+            if losses else None,
+            "elastic_rounds_seen": elastic,
+            "faults": fault_counts(*(outs.get(r) or ("", ""))),
+        })
+
+    ref = per_worker[0]
+    rounds_completed = ref["final_outer_epoch"] or 0
+    every_round_completed = (
+        not fails
+        and error_rows == 0
+        and rounds_completed >= args.rounds
+        and all(
+            w["steps"] == args.rounds * args.local_steps for w in per_worker
+        )
+    )
+    loss_descended = bool(
+        ref["loss_first"] is not None
+        and ref["loss_last"] is not None
+        and ref["loss_last"] < ref["loss_first"]
+    )
+    daemon_faults = fault_counts(daemon_out)
+    report = {
+        "bench": "chaos_soak",
+        "model": args.model,
+        "workers": args.workers,
+        "rounds": args.rounds,
+        "local_steps": args.local_steps,
+        "backend": "tcp",
+        "chaos": {
+            "worker_spec": WORKER_CHAOS.format(seed="7+rank"),
+            "daemon_spec": DAEMON_CHAOS,
+            "sigkill": {"rank": kill_rank, "after_s": args.kill_after_s,
+                        "restarted_with_onboarding": True},
+        },
+        "every_round_completed": every_round_completed,
+        "loss_descended": loss_descended,
+        "error_rows": error_rows,
+        "failures": fails,
+        "daemon_faults": daemon_faults,
+        "total_faults_injected": sum(
+            sum(w["faults"].values()) for w in per_worker
+        ) + sum(daemon_faults.values()) + sum(
+            fault_counts(killed_out, killed_err).values()
+        ),
+        "per_worker": per_worker,
+        "elapsed_s": round(time.time() - t0, 1),
+    }
+    with open(args.out, "w") as f:
+        json.dump(report, f, indent=2)
+        f.write("\n")
+    print(json.dumps(report, indent=2))
+    ok = every_round_completed and loss_descended
+    print("CHAOS SOAK " + ("PASSED" if ok else "FAILED"))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -28,10 +28,20 @@ from opendiloco_tpu.parallel.mesh import build_mesh
 from opendiloco_tpu.trainer import InnerTrainer, TrainerConfig
 
 
+_next_dev = iter(range(10**9))
+
+
 def make_trainer(tiny_cfg, devices=None, strategy="NO_SHARD"):
     tc = TrainerConfig(
         lr=1e-3, warmup_steps=2, total_steps=200, precision="fp32", remat=False
     )
+    if devices is None:
+        # one distinct single-device mesh per trainer: this file runs
+        # multiple workers as threads, and concurrent multi-device XLA
+        # executions deadlock on the CPU client (same pattern as
+        # test_galaxy_smoke's per-worker meshes)
+        all_dev = jax.devices()
+        devices = [all_dev[next(_next_dev) % len(all_dev)]]
     plan = build_mesh(strategy, devices=devices)
     return InnerTrainer(tiny_cfg, tc, plan)
 

@@ -1,0 +1,114 @@
+"""8-worker galaxy training smoke on the loopback backend.
+
+The full galaxy shape (ROADMAP: 8 DiLoCo workers) driven end-to-end through
+DiLoCoOptimizer on the 2m model -- in-process, socket-free, with a
+wall-clock budget so CI catches pathological slowdowns in the outer loop.
+Two outer rounds: workers train on disjoint shards, re-synchronize exactly
+at each boundary, and the round health ledger records every round at full
+group size.
+"""
+
+import threading
+import time
+
+import jax
+import numpy as np
+
+from opendiloco_tpu.config import DilocoConfig
+from opendiloco_tpu.diloco import DiLoCoOptimizer, LoopbackWorld
+from opendiloco_tpu.models.hf_io import load_config
+from opendiloco_tpu.parallel.mesh import build_mesh
+from opendiloco_tpu.trainer import InnerTrainer, TrainerConfig
+
+N_WORKERS = 8
+LOCAL_STEPS = 2
+N_STEPS = 4  # 2 outer rounds
+WALL_CLOCK_BUDGET_S = 420.0
+
+
+def _batches(seed, vocab, n, global_bs=8, seq=32):
+    rng = np.random.default_rng(seed)
+    for _ in range(n):
+        starts = rng.integers(0, vocab, (global_bs, 1))
+        ids = ((starts + np.arange(seq)) % vocab).astype(np.int32)
+        yield ids, ids.copy()
+
+
+def test_galaxy_8_workers_two_outer_rounds():
+    t_start = time.monotonic()
+    cfg = load_config("2m")
+    world = LoopbackWorld(N_WORKERS)
+    backends = world.make_backends()
+    devices = jax.devices()
+    results = [None] * N_WORKERS
+    errors = []
+
+    def worker(rank):
+        try:
+            tc = TrainerConfig(
+                lr=1e-3, warmup_steps=2, total_steps=100,
+                precision="fp32", remat=False,
+            )
+            plan = build_mesh(
+                "NO_SHARD", devices=[devices[rank % len(devices)]]
+            )
+            trainer = InnerTrainer(cfg, tc, plan)
+            state = trainer.init_state(jax.random.key(7))  # same init everywhere
+            dcfg = DilocoConfig(
+                local_steps=LOCAL_STEPS,
+                outer_nesterov=True,
+                backend="loopback",
+                timeout_waiting_for_peers=60.0,
+                averaging_timeout=120.0,
+            )
+            opt = DiLoCoOptimizer(
+                trainer, backends[rank], dcfg, state, batch_size=8
+            )
+            losses = []
+            for ids, labels in _batches(1000 + rank, cfg.vocab_size, N_STEPS):
+                batch = trainer.shard_batch(ids, labels, accum=1)
+                state, m = opt.step(state, batch)
+                losses.append(float(m["loss"]))
+            results[rank] = (
+                losses, jax.device_get(state["params"]), opt.epoch
+            )
+        except Exception as e:  # pragma: no cover - failure detail
+            errors.append((rank, e))
+
+    threads = [
+        threading.Thread(target=worker, args=(r,)) for r in range(N_WORKERS)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=WALL_CLOCK_BUDGET_S)
+    assert not errors, errors
+    assert all(r is not None for r in results), "a worker never finished"
+
+    # every worker completed both outer rounds with finite losses
+    for losses, _, epoch in results:
+        assert epoch == N_STEPS // LOCAL_STEPS
+        assert all(np.isfinite(losses)), losses
+
+    # outer sync is exact: all workers hold identical params afterwards
+    ref = results[0][1]
+    for losses, params, _ in results[1:]:
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                a, b, rtol=1e-6, atol=1e-7
+            ),
+            ref,
+            params,
+        )
+
+    # health ledger: every outer round ran at full galaxy size, no elastic
+    for be in backends:
+        rounds = [h for h in be.round_ledger if h["group_size"]]
+        assert rounds, "no rounds recorded"
+        assert all(h["group_size"] == N_WORKERS for h in rounds), rounds
+        assert not any(h["elastic"] for h in rounds), rounds
+
+    elapsed = time.monotonic() - t_start
+    assert elapsed < WALL_CLOCK_BUDGET_S, (
+        f"galaxy smoke blew its wall-clock budget: {elapsed:.0f}s"
+    )
