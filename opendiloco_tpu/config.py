@@ -230,7 +230,7 @@ class Config(BaseModel):
 
     # observability
     project: str = "opendiloco_tpu"
-    metric_logger_type: Literal["wandb", "dummy"] = "wandb"
+    metric_logger_type: Literal["wandb", "dummy", "jsonl"] = "wandb"
     log_activations_steps: Optional[int] = None
     # periodic evaluation on the validation split (train_diloco_torch.py:87-110)
     eval_interval: Optional[int] = None

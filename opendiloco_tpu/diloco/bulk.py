@@ -39,7 +39,7 @@ from typing import Callable, Optional
 
 import numpy as np
 
-from opendiloco_tpu import native
+from opendiloco_tpu import native, obs
 from opendiloco_tpu.diloco import chaos
 from opendiloco_tpu.diloco.wire import MAGIC, MAX_HEADER, WireError
 from opendiloco_tpu.utils.logger import get_text_logger
@@ -241,6 +241,7 @@ def send_frame_sync(
     native.sock_sendall(sock, _HDR.pack(MAGIC, len(header)) + header)
     if nbytes:
         _send_payload(sock, payload)
+    obs.count("bulk_tx_bytes", nbytes)
 
 
 def read_frame_sync(sock: socket.socket) -> tuple[str, dict, np.ndarray]:
@@ -255,6 +256,7 @@ def read_frame_sync(sock: socket.socket) -> tuple[str, dict, np.ndarray]:
     payload = np.empty(n, np.uint8)
     if n:
         native.sock_recvall(sock, payload)
+    obs.count("bulk_rx_bytes", n)
     return header["type"], header.get("meta", {}), payload
 
 
@@ -327,6 +329,10 @@ class BulkServer:
                     return
                 if _frame_observer is not None:
                     _frame_observer(header["type"])
+                tr = obs.tracer()
+                if tr is not None:
+                    tr.count("bulk_frames", kind=header["type"])
+                    tr.count("bulk_rx_bytes", header.get("payload_len", 0))
                 if header["type"] == "_stripe":
                     # stripe channel: bytes land straight in the session
                     # buffer; no ack (the main connection acks the frame)

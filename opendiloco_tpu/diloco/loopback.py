@@ -20,6 +20,7 @@ from typing import Any, Callable, Optional
 
 import numpy as np
 
+from opendiloco_tpu import obs
 from opendiloco_tpu.diloco import chaos
 from opendiloco_tpu.diloco.backend import (
     AllReduceError,
@@ -92,6 +93,12 @@ class LoopbackBackend(OuterBackend):
         self.round_ledger.append(health)
         if len(self.round_ledger) > 256:
             del self.round_ledger[:-256]
+        tr = obs.tracer()
+        if tr is not None:
+            tr.instant("outer/round", worker=self._peer_id, **health)
+            tr.count("outer_rounds")
+            if health["elastic"]:
+                tr.count("outer_rounds_elastic")
 
     @property
     def peer_id(self) -> str:
@@ -114,10 +121,22 @@ class LoopbackBackend(OuterBackend):
             return out, n
         w = self.world
         codec = w.codec
+        # per-worker stage spans mirror the TCP taxonomy: encode (codec
+        # roundtrip), reduce_wait (park until the round mean publishes),
+        # adopt (copy the published result)
+        tr = obs.tracer()
+        round_key = f"{tag}-epoch-{epoch}"
+        t0 = time.perf_counter() if tr is not None else 0.0
         compressed = [
             codec.decode(*_enc(codec, a)) for a in arrays
         ]  # simulate wire roundtrip
+        if tr is not None:
+            tr.add_span(
+                "outer/encode", t0, time.perf_counter(),
+                worker=self._peer_id, round=round_key,
+            )
         deadline = time.monotonic() + (timeout or 3600.0)
+        t_wait = time.perf_counter() if tr is not None else 0.0
         with w.cond:
             my_round = w._round
             w._contrib[self._peer_id] = compressed
@@ -145,8 +164,19 @@ class LoopbackBackend(OuterBackend):
                     w.cond.notify_all()
                     raise AllReduceError(f"{self._peer_id}: all-reduce timed out")
                 w.cond.wait(timeout=min(remaining, 0.1))
+            if tr is not None:
+                tr.add_span(
+                    "outer/reduce_wait", t_wait, time.perf_counter(),
+                    worker=self._peer_id, round=round_key,
+                )
+            t_adopt = time.perf_counter() if tr is not None else 0.0
             result = [a.copy() for a in w._result]
             group = w._result_group
+        if tr is not None:
+            tr.add_span(
+                "outer/adopt", t_adopt, time.perf_counter(),
+                worker=self._peer_id, round=round_key,
+            )
         self._record_round_health(tag, epoch, group)
         return result, group
 

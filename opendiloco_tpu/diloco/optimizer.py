@@ -26,7 +26,7 @@ from typing import Any, Optional
 import jax
 import numpy as np
 
-from opendiloco_tpu import native
+from opendiloco_tpu import native, obs
 from opendiloco_tpu.config import DilocoConfig
 from opendiloco_tpu.diloco.backend import OuterBackend, PeerProgress, wait_for_peers
 from opendiloco_tpu.diloco.outer_optimizer import OuterSGD
@@ -462,6 +462,8 @@ class DiLoCoOptimizer:
             "parameter schema changed mid-epoch"
         )
         t0 = time.monotonic()
+        tr = obs.tracer()
+        t0p = time.perf_counter() if tr is not None else 0.0
         if self._pending is not None:  # at most one round in flight
             state = self._poll_pending(state, block=True)
         self._drain_abandoned()
@@ -489,6 +491,11 @@ class DiLoCoOptimizer:
                 log=log,
             )
         wait_s = time.monotonic() - t0
+        if tr is not None:
+            tr.add_span(
+                "outer/barrier_wait", t0p, time.perf_counter(),
+                epoch=self.epoch,
+            )
         fetcher.join()
         boundary = fetch_result[0]
         self._pg_slot ^= 1
@@ -551,6 +558,11 @@ class DiLoCoOptimizer:
             "outer_wait_s": wait_s,
             "outer_overlapped": 1,
         }
+        if tr is not None:
+            tr.add_span(
+                "outer/launch", t0p, time.perf_counter(), epoch=self.epoch - 1
+            )
+            tr.gauge("outer_wait_s", wait_s)
         self.last_outer_metrics = outer_metrics
         return state, outer_metrics
 
@@ -687,10 +699,20 @@ class DiLoCoOptimizer:
         # before the assignment would open a (new epoch, old master) window
         # for onboarding peers. The finally also clears on failure, where
         # the live state is the correct thing to serve.
+        tr = obs.tracer()
         try:
-            avg, group_size = self._overlap_result(pending, block=block)
+            if tr is not None and block:
+                t_wait = time.perf_counter()
+                avg, group_size = self._overlap_result(pending, block=block)
+                tr.add_span(
+                    "outer/barrier_wait", t_wait, time.perf_counter(),
+                    epoch=pending["epoch"],
+                )
+            else:
+                avg, group_size = self._overlap_result(pending, block=block)
             self._check_group_size(group_size)
 
+            t_apply = time.perf_counter() if tr is not None else 0.0
             master = [m.copy() for m in pending["master_snap"]]
             opt = OuterSGD(
                 lr=self.cfg.outer_lr,
@@ -708,6 +730,11 @@ class DiLoCoOptimizer:
             with self._serve_lock:
                 self.outer_opt = opt
                 self.master = master
+            if tr is not None:
+                tr.add_span(
+                    "outer/apply", t_apply, time.perf_counter(),
+                    epoch=pending["epoch"], group=group_size,
+                )
         finally:
             with self._serve_lock:
                 self._pending = None
@@ -719,6 +746,13 @@ class DiLoCoOptimizer:
             "num_peers": group_size,
             **self._round_health_metrics(),
         }
+        if tr is not None:
+            tr.instant(
+                "outer/landed",
+                epoch=pending["epoch"], group=group_size,
+                landed_s=round(landed_s, 6),
+            )
+            tr.gauge("outer_allreduce_s", landed_s)
         self.last_outer_metrics = dict(self._landed_metrics)
         log.info(
             "outer step %d (overlapped): all-reduce over %d peers landed "
@@ -852,6 +886,8 @@ class DiLoCoOptimizer:
                 "outer_opt": self.outer_opt.state_dict_refs(),
             }
         t0 = time.monotonic()
+        tr = obs.tracer()
+        t0p = time.perf_counter() if tr is not None else 0.0
 
         # overlap the D2H transfer with the straggler wait (SURVEY hard-part
         # 2): the params are final at the boundary, so fetch them while
@@ -889,7 +925,16 @@ class DiLoCoOptimizer:
                 log=log,
             )
         wait_s = time.monotonic() - t0
+        if tr is not None:
+            tr.add_span(
+                "outer/barrier_wait", t0p, time.perf_counter(),
+                epoch=self.epoch,
+            )
         fetcher.join()
+        if tr is not None:
+            # D2H fetch runs concurrently with the straggler wait; the span
+            # covers wait+join, i.e. until the host copy is actually ready
+            tr.add_span("outer/d2h", t0p, time.perf_counter(), epoch=self.epoch)
         device_flat = fetch_result[0]
 
         if frag is not None:
@@ -906,7 +951,15 @@ class DiLoCoOptimizer:
             # slot 0 only)
             pseudo_grad = self._pseudo_grad_into(device_flat, slot=0)
 
+        if tr is not None:
+            sq = 0.0
+            for g in pseudo_grad:
+                v = np.asarray(g, np.float32).reshape(-1)
+                sq += float(np.dot(v, v))
+            tr.gauge("pseudo_grad_norm", float(np.sqrt(sq)))
+
         t1 = time.monotonic()
+        t1p = time.perf_counter() if tr is not None else 0.0
         if self.cfg.outer_mode == "gossip":
             # NoLoCo-style (arxiv 2506.10911): average (master, pseudo_grad)
             # with ONE re-paired partner per epoch -- state mixing keeps the
@@ -931,6 +984,12 @@ class DiLoCoOptimizer:
             )
             self._check_group_size(group_size)
         allreduce_s = time.monotonic() - t1
+        if tr is not None:
+            tr.add_span(
+                "outer/allreduce", t1p, time.perf_counter(),
+                epoch=self.epoch, group=group_size,
+            )
+        t_apply = time.perf_counter() if tr is not None else 0.0
         log.info(
             "outer step %d: %s over %d peers took %.3fs",
             self.epoch,
@@ -978,6 +1037,11 @@ class DiLoCoOptimizer:
             state["params"] = self._leaves_to_device(merged)
         else:
             state = self._write_master_to_device(state)  # [H2D]
+        if tr is not None:
+            # outer SGD (clone-then-rebind) + optional state averaging + H2D
+            tr.add_span(
+                "outer/apply", t_apply, time.perf_counter(), epoch=self.epoch
+            )
 
         with self._serve_lock:
             self.epoch += 1
@@ -995,6 +1059,14 @@ class DiLoCoOptimizer:
             "num_peers": group_size,
             **self._round_health_metrics(),
         }
+        if tr is not None:
+            tr.add_span(
+                "outer/step", t0p, time.perf_counter(),
+                epoch=self.epoch - 1, group=group_size,
+            )
+            tr.gauge("outer_step_s", outer_metrics["outer_step_s"])
+            tr.gauge("outer_allreduce_s", allreduce_s)
+            tr.gauge("outer_wait_s", wait_s)
         self.last_outer_metrics = outer_metrics
         return state, outer_metrics
 

@@ -20,6 +20,7 @@ import uuid
 from dataclasses import dataclass, field
 from typing import Optional
 
+from opendiloco_tpu import obs
 from opendiloco_tpu.diloco import chaos
 from opendiloco_tpu.diloco.wire import STREAM_LIMIT, read_frame, send_frame
 from opendiloco_tpu.utils.logger import get_text_logger
@@ -288,6 +289,7 @@ class RendezvousServer:
             self._writers.discard(writer)
             writer.close()
             return
+        obs.count("rdv_frames", msg=msg)
         try:
             if msg == "register":
                 info = PeerInfo(
@@ -465,7 +467,16 @@ class RendezvousServer:
         ):
             self._close_round(rnd)
 
-        await rnd.event.wait()
+        tr = obs.tracer()
+        if tr is None:
+            await rnd.event.wait()
+        else:
+            t0 = tr.now()
+            await rnd.event.wait()
+            tr.add_span(
+                "rdv/join_group", t0, tr.now(),
+                round=key, joiners=len(rnd.joiners),
+            )
         await send_frame(writer, "ok", {"group": rnd.group_for(pid)})
 
     async def _close_round_later(self, rnd: _GroupRound) -> None:
@@ -475,6 +486,7 @@ class RendezvousServer:
 
     def _close_round(self, rnd: _GroupRound) -> None:
         rnd.closed = True
+        obs.count("rdv_rounds_closed")
         # tombstoned peers that had this FULL matchmaking window to re-join
         # and did not: the swarm has demonstrably moved on without them.
         # A tombstone created after the round opened only had part of the

@@ -32,6 +32,7 @@ from typing import Any, Callable, Optional
 
 import numpy as np
 
+from opendiloco_tpu import obs
 from opendiloco_tpu.diloco import chaos
 from opendiloco_tpu.diloco.backend import AllReduceError, OuterBackend, PeerProgress
 from opendiloco_tpu.diloco.compression import Codec, chunk_bounds, get_codec
@@ -170,6 +171,10 @@ def _decode_obj(obj: Any, arrays: list[np.ndarray]) -> Any:
 
 
 class TcpBackend(OuterBackend):
+    # per-round stage-time accumulator, armed only while ODTP_OBS is set
+    # (all_reduce rounds are serialized per backend, so one slot suffices)
+    _obs_stage: Optional[obs.StageTimes] = None
+
     def __init__(
         self,
         initial_peers: list[str],
@@ -458,6 +463,7 @@ class TcpBackend(OuterBackend):
         re-converge within ``_RDV_FAILBACK_S`` seconds.
         """
         timeout = timeout or self.rpc_timeout
+        obs.count("rdv_rpcs", msg=msg)
         # fail-back probe toward the preferred (lowest-index) daemon
         if self._rdv_idx != 0 and (
             time.monotonic() - self._rdv_last_probe > self._RDV_FAILBACK_S
@@ -620,6 +626,7 @@ class TcpBackend(OuterBackend):
                     asyncio.TimeoutError,  # idle between outer rounds
                 ):
                     break
+                obs.count("peer_frames", msg=msg)
                 if msg in ("push", "result"):
                     if cp is not None:
                         d = cp.delay_s("mailbox")
@@ -640,6 +647,13 @@ class TcpBackend(OuterBackend):
                             if self._bulk_server
                             else 0
                         },
+                    )
+                elif msg == "metrics":
+                    # pull-based Prometheus text exposition on the existing
+                    # per-worker control port (empty body when obs disarmed)
+                    body = obs.export.prometheus_text(obs.tracer()).encode()
+                    await send_frame(
+                        writer, "ok", {"format": "prometheus-0.0.4"}, body
                     )
                 elif msg == "fetch_state":
                     if self._state_provider is None:
@@ -765,6 +779,26 @@ class TcpBackend(OuterBackend):
     async def _send_part(
         self, host: str, port: int, msg: str, meta: dict, payload, *, timeout: float
     ) -> None:
+        stage = self._obs_stage
+        if stage is None:
+            return await self._send_part_inner(
+                host, port, msg, meta, payload, timeout=timeout
+            )
+        nbytes = payload.nbytes if hasattr(payload, "nbytes") else len(payload)
+        t0 = time.perf_counter()
+        try:
+            return await self._send_part_inner(
+                host, port, msg, meta, payload, timeout=timeout
+            )
+        finally:
+            stage.add("wire_send", time.perf_counter() - t0)
+            tr = obs.tracer()
+            if tr is not None:
+                tr.count("wire_tx_bytes", nbytes)
+
+    async def _send_part_inner(
+        self, host: str, port: int, msg: str, meta: dict, payload, *, timeout: float
+    ) -> None:
         """Route one butterfly frame: bulk plane for large payloads, asyncio
         RPC otherwise (and as fallback)."""
         nbytes = payload.nbytes if hasattr(payload, "nbytes") else len(payload)
@@ -828,6 +862,25 @@ class TcpBackend(OuterBackend):
         }
 
     async def _wait_mailbox(self, key: tuple, deadline: float) -> tuple[dict, bytes]:
+        stage = self._obs_stage
+        if stage is None:
+            return await self._wait_mailbox_inner(key, deadline)
+        t0 = time.perf_counter()
+        try:
+            meta, payload = await self._wait_mailbox_inner(key, deadline)
+        finally:
+            stage.add("wire_recv", time.perf_counter() - t0)
+        tr = obs.tracer()
+        if tr is not None:
+            nbytes = (
+                payload.nbytes if hasattr(payload, "nbytes") else len(payload)
+            )
+            tr.count("wire_rx_bytes", nbytes)
+        return meta, payload
+
+    async def _wait_mailbox_inner(
+        self, key: tuple, deadline: float
+    ) -> tuple[dict, bytes]:
         async with self._mailbox_cv:
             while key not in self._mailbox:
                 remaining = deadline - time.monotonic()
@@ -953,6 +1006,16 @@ class TcpBackend(OuterBackend):
         self.round_ledger.append(health)
         if len(self.round_ledger) > self._ledger_cap:
             del self.round_ledger[: -self._ledger_cap]
+        tr = obs.tracer()
+        if tr is not None:
+            # the per-round record obs_report merges across workers
+            tr.instant("outer/round", **health)
+            tr.count("outer_rounds")
+            if elastic:
+                tr.count("outer_rounds_elastic")
+            if self._round_attempt:
+                tr.count("outer_round_retries", self._round_attempt)
+            tr.gauge("outer_group_size", n)
 
     def all_reduce(
         self, arrays, *, timeout=None, tag: str = "grads", epoch=None, group_cap=0
@@ -1025,6 +1088,7 @@ class TcpBackend(OuterBackend):
                 arrays, join_key, deadline, scratch, group_cap=group_cap
             )
         finally:
+            self._obs_stage = None
             for b in scratch:
                 self._checkin_buf(b)
 
@@ -1037,6 +1101,9 @@ class TcpBackend(OuterBackend):
         group_cap=0,
     ):
         timings: dict[str, float] = {}
+        tr = obs.tracer()
+        self._obs_stage = obs.StageTimes() if tr is not None else None
+        t_mm_p = time.perf_counter() if tr is not None else 0.0
         t_mm = time.monotonic()
         # 1. matchmake
         _, meta, _ = await self._rdv_request(
@@ -1083,6 +1150,11 @@ class TcpBackend(OuterBackend):
             )
         if n == 1:
             timings["matchmake_s"] = time.monotonic() - t_mm
+            if tr is not None:
+                tr.add_span(
+                    "outer/rendezvous", t_mm_p, time.perf_counter(),
+                    round=join_key, group=n,
+                )
             self._record_round_health(join_key, n, expected, elastic, timings)
             return [a.copy() for a in arrays], 1
         # fingerprint the membership: retried rounds (same join_key) must not
@@ -1093,6 +1165,11 @@ class TcpBackend(OuterBackend):
         round_key = f"{join_key}:{fp}"
 
         timings["matchmake_s"] = time.monotonic() - t_mm
+        if tr is not None:
+            tr.add_span(
+                "outer/rendezvous", t_mm_p, time.perf_counter(),
+                round=join_key, group=n,
+            )
 
         # 2. flatten + split into n parts (by element count). Contiguous-f32
         # leaves flatten as views; a single leaf needs no copy at all (the
@@ -1113,6 +1190,13 @@ class TcpBackend(OuterBackend):
         bounds = np.linspace(0, flat.size, n + 1).astype(np.int64)
         parts = [flat[bounds[j] : bounds[j + 1]] for j in range(n)]
         timings["flatten_s"] = time.monotonic() - t_ph
+        if tr is not None:
+            tr.add_span(
+                "outer/flatten",
+                time.perf_counter() - timings["flatten_s"],
+                time.perf_counter(),
+                round=join_key,
+            )
 
         # 3-5. exchange: chunk-pipelined by default (encode chunk k+1 while
         # chunk k is on the wire, decode-accumulate as chunks land), serial
@@ -1128,6 +1212,15 @@ class TcpBackend(OuterBackend):
             group, my_idx, n, parts, bounds, flat.size, round_key, deadline,
             scratch, timings,
         )
+        stage = self._obs_stage
+        if stage is not None:
+            # fold fine-grained stage wall-clock (encode / wire_send /
+            # wire_recv / accumulate, summed across overlapping chunk work)
+            # into the round ledger next to the coarse phase timings
+            for name, secs in stage.totals.items():
+                timings[f"{name}_s"] = round(
+                    timings.get(f"{name}_s", 0.0) + secs, 6
+                )
         self._record_round_health(join_key, n, expected, elastic, timings)
 
         # 6. hand back per-array views of the reassembled buffer
@@ -1142,10 +1235,23 @@ class TcpBackend(OuterBackend):
         scratch, timings,
     ):
         """Whole-part exchange: each butterfly frame carries a full part."""
+        stage = self._obs_stage
+        codec = self.codec
+        encode = stage.timed("encode", codec.encode) if stage else codec.encode
+        dec_acc = (
+            stage.timed("accumulate", codec.decode_accumulate)
+            if stage
+            else codec.decode_accumulate
+        )
+        dec_into = (
+            stage.timed("accumulate", codec.decode_into)
+            if stage
+            else codec.decode_into
+        )
 
         # 3. push part j to its owner
         async def push(j):
-            payload, cmeta = self.codec.encode(parts[j])
+            payload, cmeta = encode(parts[j])
             await self._send_part(
                 group[j]["host"],
                 group[j]["port"],
@@ -1177,7 +1283,7 @@ class TcpBackend(OuterBackend):
                 pmeta, payload = await self._wait_mailbox(
                     (round_key, "push", p["peer_id"]), deadline
                 )
-                self.codec.decode_accumulate(payload, pmeta["meta"], acc)
+                dec_acc(payload, pmeta["meta"], acc)
                 # fully folded into acc: recycle bulk-plane receive buffers
                 # so steady-state rounds stop allocating (no-op for asyncio
                 # bytes payloads)
@@ -1186,9 +1292,16 @@ class TcpBackend(OuterBackend):
             return acc
 
         t_ph = time.monotonic()
+        t_ph_p = time.perf_counter()
         results = await asyncio.gather(collect(), *pushes)
         my_avg = results[0]
         timings["scatter_reduce_s"] = time.monotonic() - t_ph
+        tr = obs.tracer()
+        if tr is not None:
+            tr.add_span(
+                "outer/scatter_reduce", t_ph_p, time.perf_counter(),
+                round=round_key, group=n,
+            )
 
         # 5. fan the averaged part back out; gather the other parts.
         # Encode ONCE — the same payload serves every destination (the old
@@ -1197,7 +1310,7 @@ class TcpBackend(OuterBackend):
         # every peer then reconstructs a bit-identical averaged buffer
         # regardless of codec lossiness (hivemind's averaged tensors have
         # the same property: one compressed result, everyone decodes it)
-        result_payload, result_cmeta = self.codec.encode(my_avg)
+        result_payload, result_cmeta = encode(my_avg)
 
         async def send_result(j):
             await self._send_part(
@@ -1228,7 +1341,7 @@ class TcpBackend(OuterBackend):
         async def recv_results():
             from opendiloco_tpu.diloco.bulk import release_buffer
 
-            self.codec.decode_into(
+            dec_into(
                 result_payload,
                 result_cmeta,
                 flat_avg[bounds[my_idx] : bounds[my_idx + 1]],
@@ -1247,16 +1360,22 @@ class TcpBackend(OuterBackend):
                     )
                 # (decode_into additionally validates the actual payload
                 # length against dst.size before any native kernel runs)
-                self.codec.decode_into(payload, rmeta["meta"], dst)
+                dec_into(payload, rmeta["meta"], dst)
                 # fully decoded into flat_avg: recycle bulk-plane receive
                 # buffers (no-op for asyncio bytes payloads)
                 release_buffer(payload)
 
         t_ph = time.monotonic()
+        t_ph_p = time.perf_counter()
         await asyncio.gather(
             recv_results(), *[send_result(j) for j in range(n) if j != my_idx]
         )
         timings["all_gather_s"] = time.monotonic() - t_ph
+        if tr is not None:
+            tr.add_span(
+                "outer/all_gather", t_ph_p, time.perf_counter(),
+                round=round_key, group=n,
+            )
         return flat_avg
 
     def _chunk_sender(self, dest: dict, deadline: float):
@@ -1295,9 +1414,16 @@ class TcpBackend(OuterBackend):
                         )
             if state["stream"] is not None:
                 try:
+                    stage = self._obs_stage
+                    t0 = time.perf_counter()
                     await loop.run_in_executor(
                         None, state["stream"].send, msg, meta, payload
                     )
+                    if stage is not None:
+                        stage.add("wire_send", time.perf_counter() - t0)
+                        tr = obs.tracer()
+                        if tr is not None:
+                            tr.count("wire_tx_bytes", nbytes)
                     return
                 except Exception as e:
                     # the stream poisoned itself and dropped the pooled
@@ -1348,18 +1474,38 @@ class TcpBackend(OuterBackend):
         loop = self._loop
         chunk_elems = _pipeline_chunk_elems()
         align = getattr(self.codec, "chunk_align", 1)
+        stage = self._obs_stage
+        codec = self.codec
+        enc_chunk = (
+            stage.timed("encode", codec.encode_chunk)
+            if stage
+            else codec.encode_chunk
+        )
+        chunk_state_fn = (
+            stage.timed("encode", codec.chunk_state)
+            if stage
+            else codec.chunk_state
+        )
+        dec_acc = (
+            stage.timed("accumulate", codec.decode_accumulate)
+            if stage
+            else codec.decode_accumulate
+        )
+        dec_into = (
+            stage.timed("accumulate", codec.decode_into)
+            if stage
+            else codec.decode_into
+        )
 
         # 3. push part j to its owner, chunk by chunk
         async def push(j):
             part = parts[j]
-            state = await loop.run_in_executor(
-                None, self.codec.chunk_state, part
-            )
+            state = await loop.run_in_executor(None, chunk_state_fn, part)
             grid = chunk_bounds(part.size, chunk_elems, align)
             nchunks = len(grid) - 1
 
             def enc(k):
-                return self.codec.encode_chunk(part[grid[k] : grid[k + 1]], state)
+                return enc_chunk(part[grid[k] : grid[k + 1]], state)
 
             send, close = self._chunk_sender(group[j], deadline)
             nxt = loop.run_in_executor(None, enc, 0)
@@ -1401,7 +1547,7 @@ class TcpBackend(OuterBackend):
                     coff, clen = chunk_span(pmeta, acc.size)
                     await loop.run_in_executor(
                         None,
-                        self.codec.decode_accumulate,
+                        dec_acc,
                         payload,
                         pmeta["meta"],
                         acc[coff : coff + clen],
@@ -1412,23 +1558,30 @@ class TcpBackend(OuterBackend):
             return acc
 
         t_ph = time.monotonic()
+        t_ph_p = time.perf_counter()
         results = await asyncio.gather(
             collect(), *[push(j) for j in range(n) if j != my_idx]
         )
         my_avg = results[0]
         timings["scatter_reduce_s"] = time.monotonic() - t_ph
+        tr = obs.tracer()
+        if tr is not None:
+            tr.add_span(
+                "outer/scatter_reduce", t_ph_p, time.perf_counter(),
+                round=round_key, group=n,
+            )
 
         # 5. fan the averaged part back out chunk by chunk; gather the other
         # parts. Each chunk is encoded ONCE (shared future) and the same
         # payload serves every destination plus the owner's self-adoption of
         # the decoded wire value — the serial path's encode-once invariant
         # at chunk granularity.
-        state = await loop.run_in_executor(None, self.codec.chunk_state, my_avg)
+        state = await loop.run_in_executor(None, chunk_state_fn, my_avg)
         grid = chunk_bounds(my_avg.size, chunk_elems, align)
         nchunks = len(grid) - 1
 
         def enc(k):
-            return self.codec.encode_chunk(my_avg[grid[k] : grid[k + 1]], state)
+            return enc_chunk(my_avg[grid[k] : grid[k + 1]], state)
 
         enc_futs: dict = {}
 
@@ -1471,7 +1624,7 @@ class TcpBackend(OuterBackend):
                 payload, cmeta = await chunk_fut(k)
                 await loop.run_in_executor(
                     None,
-                    self.codec.decode_into,
+                    dec_into,
                     payload,
                     cmeta,
                     my_dst[grid[k] : grid[k + 1]],
@@ -1495,7 +1648,7 @@ class TcpBackend(OuterBackend):
                 # length against the slice size before any native kernel)
                 await loop.run_in_executor(
                     None,
-                    self.codec.decode_into,
+                    dec_into,
                     payload,
                     rmeta["meta"],
                     dst_part[coff : coff + clen],
@@ -1504,12 +1657,18 @@ class TcpBackend(OuterBackend):
                 k += 1
 
         t_ph = time.monotonic()
+        t_ph_p = time.perf_counter()
         await asyncio.gather(
             adopt(),
             *[send_result_to(j) for j in range(n) if j != my_idx],
             *[recv_from(j) for j in range(n) if j != my_idx],
         )
         timings["all_gather_s"] = time.monotonic() - t_ph
+        if tr is not None:
+            tr.add_span(
+                "outer/all_gather", t_ph_p, time.perf_counter(),
+                round=round_key, group=n,
+            )
         return flat_avg
 
     def _peer_id_epoch_key(self) -> str:
@@ -1522,6 +1681,7 @@ class TcpBackend(OuterBackend):
         self._state_provider = get_state
 
     def fetch_state(self) -> Optional[dict]:
+        obs.count("fetch_state_calls")
         try:
             _, meta, _ = self._run(
                 self._rdv_request(
@@ -1554,7 +1714,10 @@ class TcpBackend(OuterBackend):
             return None
 
     def barrier(self, *, timeout: Optional[float] = None) -> None:
-        self.all_reduce([np.zeros(1, np.float32)], timeout=timeout or 60.0, tag="barrier")
+        with obs.span("outer/barrier_wait"):
+            self.all_reduce(
+                [np.zeros(1, np.float32)], timeout=timeout or 60.0, tag="barrier"
+            )
 
     def close(self) -> None:
         try:

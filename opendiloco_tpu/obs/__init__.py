@@ -1,0 +1,43 @@
+"""Unified tracing + metrics plane (spans, counters, gauges, exporters).
+
+Hook-site idiom, mirroring ``chaos.plane()``::
+
+    from opendiloco_tpu import obs
+    tr = obs.tracer()          # None when ODTP_OBS is unset (zero-cost)
+    if tr is not None:
+        t0 = tr.now()
+        ...
+        tr.add_span("outer/encode", t0, tr.now(), round=key, worker=r)
+
+or, in plain synchronous code::
+
+    with obs.span("outer/rendezvous", round=key):
+        ...
+
+See ``obs/trace.py`` for the env knobs and ``obs/export.py`` for the
+Chrome-trace / Prometheus / JSONL exporters.
+"""
+from opendiloco_tpu.obs.trace import (  # noqa: F401
+    StageTimes,
+    Tracer,
+    count,
+    enabled,
+    gauge,
+    reset,
+    span,
+    tracer,
+)
+from opendiloco_tpu.obs import export, mfu  # noqa: F401
+
+__all__ = [
+    "StageTimes",
+    "Tracer",
+    "count",
+    "enabled",
+    "export",
+    "gauge",
+    "mfu",
+    "reset",
+    "span",
+    "tracer",
+]

@@ -1,0 +1,89 @@
+"""Achieved-MFU estimation from the banked roofline numbers.
+
+``AOT_ROOFLINE.json`` (repo root) carries the device peak
+(``peak_flops``) and, per model size, XLA's executed-flops cost
+analysis (``multichip_rows[*].executed_flops_per_device`` /
+``tokens_per_step``). When a row matches the configured model we use
+the measured flops/token; otherwise we fall back to the standard
+``6 * n_params`` analytic estimate. Everything is computed once at
+startup — the per-step cost of the MFU gauge is one multiply.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+_DEFAULT_PEAK = 1.97e14  # TPU v5e bf16, matches the banked roofline
+
+
+def roofline_path() -> Optional[str]:
+    override = os.environ.get("ODTP_ROOFLINE")
+    if override:
+        return override if os.path.exists(override) else None
+    here = os.path.dirname(os.path.abspath(__file__))
+    for _ in range(4):
+        here = os.path.dirname(here)
+        cand = os.path.join(here, "AOT_ROOFLINE.json")
+        if os.path.exists(cand):
+            return cand
+    return None
+
+
+def _model_key(path_model: str) -> str:
+    base = os.path.basename(str(path_model).rstrip("/")).lower()
+    if base.endswith(".json"):
+        base = base[: -len(".json")]
+    if base.startswith("config_"):
+        base = base[len("config_"):]
+    return base
+
+
+def flops_per_token(
+    path_model: str, n_params: Optional[int] = None
+) -> "tuple[Optional[float], float, str]":
+    """-> (total model flops per token or None, per-device peak, source)."""
+    peak = _DEFAULT_PEAK
+    path = roofline_path()
+    rows: list[dict] = []
+    if path is not None:
+        try:
+            with open(path) as f:
+                roofline = json.load(f)
+            peak = float(roofline.get("peak_flops", _DEFAULT_PEAK))
+            rows = roofline.get("multichip_rows") or []
+        except (OSError, ValueError):
+            rows = []
+    key = _model_key(path_model)
+    best: Optional[dict] = None
+    for row in rows:
+        if row.get("model") != key:
+            continue
+        if not row.get("executed_flops_per_device"):
+            continue
+        if not row.get("tokens_per_step"):
+            continue
+        # prefer the largest-scale measurement of this model
+        if best is None or row.get("chips", 0) > best.get("chips", 0):
+            best = row
+    if best is not None:
+        per_token = (
+            float(best["executed_flops_per_device"])
+            * float(best.get("chips", 1))
+            / float(best["tokens_per_step"])
+        )
+        return per_token, peak, "roofline"
+    if n_params:
+        return 6.0 * float(n_params), peak, "analytic_6n"
+    return None, peak, "unavailable"
+
+
+def mfu(
+    tokens_per_second: float,
+    model_flops_per_token: float,
+    n_devices: int,
+    peak_flops_per_device: float = _DEFAULT_PEAK,
+) -> float:
+    """Model FLOPs utilization in [0, ~1] across ``n_devices`` chips."""
+    achieved = model_flops_per_token * tokens_per_second
+    return achieved / (peak_flops_per_device * max(1, n_devices))

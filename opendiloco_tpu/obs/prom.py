@@ -1,0 +1,68 @@
+"""Minimal pull-based Prometheus text endpoint.
+
+A daemon thread accepts plain HTTP GETs and answers with the current
+tracer snapshot rendered by :func:`export.prometheus_text`. Started
+only from ``Tracer.__init__`` when both ``ODTP_OBS`` and
+``ODTP_OBS_PROM_PORT`` are set — with the plane disarmed no socket is
+ever bound.
+"""
+from __future__ import annotations
+
+import socket
+import threading
+
+
+class PromServer:
+    def __init__(self, port: int, tracer) -> None:
+        self._tracer = tracer
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind(("0.0.0.0", port))
+        self._sock.listen(8)
+        self.port = self._sock.getsockname()[1]
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._serve, name="odtp-obs-prom", daemon=True
+        )
+        self._thread.start()
+
+    def _serve(self) -> None:
+        from opendiloco_tpu.obs.export import prometheus_text
+
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            try:
+                conn.settimeout(2.0)
+                try:
+                    conn.recv(4096)  # drain the request; any GET is /metrics
+                except OSError:
+                    pass
+                body = prometheus_text(self._tracer).encode()
+                head = (
+                    "HTTP/1.0 200 OK\r\n"
+                    "Content-Type: text/plain; version=0.0.4\r\n"
+                    f"Content-Length: {len(body)}\r\n\r\n"
+                ).encode()
+                conn.sendall(head + body)
+            except OSError:
+                pass
+            finally:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        self._thread.join(timeout=2.0)
+
+
+def start(port: int, tracer) -> PromServer:
+    return PromServer(port, tracer)

@@ -28,6 +28,7 @@ import jax
 import numpy as np
 
 from opendiloco_tpu import ckpt as ckpt_lib
+from opendiloco_tpu import obs
 from opendiloco_tpu.config import Config, DilocoConfig, parse_argv
 from opendiloco_tpu.diloco import chaos
 from opendiloco_tpu.data.dataloader import get_dataloader
@@ -69,6 +70,9 @@ def train(config: Config, backend: Optional[OuterBackend] = None) -> dict:
     if _cp is not None:
         # scope rank-targeted faults (straggle_worker, kill_worker) to us
         _cp.set_identity(world_rank)
+    _tr = obs.tracer()
+    if _tr is not None:
+        _tr.set_identity(worker=world_rank)
 
     if config.multihost:
         # in-worker multi-host slice: every host of the slice runs this
@@ -226,6 +230,17 @@ def train(config: Config, backend: Optional[OuterBackend] = None) -> dict:
         eval_iter = iter(eval_loader)
 
     tokens_per_step = config.total_batch_size * config.seq_length
+    # one-time MFU setup: flops/token from the banked roofline (or 6N
+    # fallback); the per-step cost is a single multiply in flush()
+    n_params = sum(int(x.size) for x in jax.tree.leaves(state["params"]))
+    model_flops_per_token, peak_flops, mfu_source = obs.mfu.flops_per_token(
+        config.path_model, n_params
+    )
+    n_devices = jax.device_count()
+    if _tr is not None:
+        _tr.set_identity(
+            model=config.path_model, mfu_source=mfu_source, n_params=n_params
+        )
     summary = {"step": start_step, "loss": float("nan")}
     data_iter = iter(loader)
     prefetcher = None
@@ -259,6 +274,22 @@ def train(config: Config, backend: Optional[OuterBackend] = None) -> dict:
             "tokens_per_second": tokens_per_step / dt,
             "grad_norm": float(metrics["grad_norm"]),
         }
+        if model_flops_per_token is not None:
+            row["mfu"] = obs.mfu.mfu(
+                row["tokens_per_second"],
+                model_flops_per_token,
+                n_devices,
+                peak_flops,
+            )
+        tr = obs.tracer()
+        if tr is not None:
+            tr.count("inner_tokens", tokens_per_step)
+            tr.gauge("inner_loss", loss)
+            tr.gauge("inner_grad_norm", row["grad_norm"])
+            tr.gauge("inner_tokens_per_second", row["tokens_per_second"])
+            tr.gauge("inner_step_s", dt)
+            if "mfu" in row:
+                tr.gauge("inner_mfu", row["mfu"])
         if diloco_opt is not None:
             row["num_peers"] = diloco_opt.max_num_peers
             row["outer_epoch"] = diloco_opt.epoch
@@ -372,6 +403,12 @@ def train(config: Config, backend: Optional[OuterBackend] = None) -> dict:
             prefetcher.stop()
         loader.stop()
         metric_logger.finish()
+        _tr_out = obs.tracer()
+        if _tr_out is not None:
+            try:
+                _tr_out.flush()
+            except Exception:
+                log.exception("failed to flush obs trace")
         if owns_backend and backend is not None:
             backend.close()
     return summary

@@ -20,6 +20,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from opendiloco_tpu import obs
 from opendiloco_tpu.models.llama import (
     LlamaConfig,
     RematPolicy,
@@ -581,7 +582,16 @@ class InnerTrainer:
         }
 
     def train_step(self, state: dict, batch: dict):
-        return self._train_step(state, batch)
+        tr = obs.tracer()
+        if tr is None:
+            return self._train_step(state, batch)
+        # dispatch wall only: the jit'd step is async, device time surfaces
+        # in the driver's step gap (train.py logs the synced step time)
+        t0 = tr.now()
+        out = self._train_step(state, batch)
+        tr.add_span("inner/dispatch", t0, tr.now())
+        tr.count("inner_steps")
+        return out
 
     def eval_loss(self, params: dict, input_ids: np.ndarray, labels: np.ndarray) -> float:
         sharding = self.plan.sharding(self.plan.batch_spec(2))

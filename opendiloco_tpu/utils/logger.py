@@ -3,14 +3,56 @@
 Reference parity: open_diloco/utils.py:170-204 -- a ``Logger`` protocol with a
 wandb backend and a pickle-based ``DummyLogger`` used as a metrics spy by the
 integration tests (tests/test_training/test_train.py:59-83).
+
+Every logger routes rows through :func:`normalize_row` so the on-disk schema
+is flat JSON-typed scalars regardless of which backend produced the row
+(numpy scalars and 0-d arrays are coerced, nested dicts are flattened with
+``/`` separators, non-scalar leaves are stringified).
 """
 
 from __future__ import annotations
 
+import json
 import logging
 import os
 import pickle
 from typing import Any, Protocol
+
+
+def normalize_row(metrics: dict[str, Any]) -> dict[str, Any]:
+    """Coerce a metrics row to a flat dict of JSON-typed scalars.
+
+    Shared by every logger backend so DummyLogger pickles, JSONL lines and
+    wandb rows all carry the same schema: numpy scalars / 0-d arrays become
+    python floats, bools and ints pass through, nested dicts flatten to
+    ``outer/inner`` keys, and anything else is stringified.
+    """
+    out: dict[str, Any] = {}
+
+    def put(key: str, value: Any) -> None:
+        if isinstance(value, dict):
+            for k, v in value.items():
+                put(f"{key}/{k}", v)
+            return
+        if isinstance(value, bool) or value is None or isinstance(value, str):
+            out[key] = value
+            return
+        if isinstance(value, int):
+            out[key] = value
+            return
+        if isinstance(value, float):
+            out[key] = value
+            return
+        # numpy scalars, 0-d arrays, jax scalars: anything float()-able
+        try:
+            out[key] = float(value)
+            return
+        except Exception:
+            out[key] = str(value)
+
+    for k, v in metrics.items():
+        put(str(k), v)
+    return out
 
 
 class Logger(Protocol):
@@ -29,14 +71,19 @@ class WandbLogger:
         self._wandb = wandb
 
     def log(self, metrics: dict[str, Any]) -> None:
-        self._wandb.log(metrics)
+        self._wandb.log(normalize_row(metrics))
 
     def finish(self) -> None:
         self._wandb.finish()
 
 
 class DummyLogger:
-    """Accumulates metric dicts and pickles them to ``project`` on finish()."""
+    """Accumulates metric dicts and pickles them to ``project`` on finish().
+
+    finish() is atomic (tmp file + ``os.replace``) so a SIGKILL mid-write --
+    routine under the chaos plane's kill_worker fault -- can never leave a
+    truncated pickle where the metrics spy expects a valid one.
+    """
 
     def __init__(self, project: str, config: dict[str, Any], *_args, **_kwargs):
         self.project = project
@@ -45,11 +92,50 @@ class DummyLogger:
         self.data: list[dict[str, Any]] = []
 
     def log(self, metrics: dict[str, Any]) -> None:
-        self.data.append(metrics)
+        self.data.append(normalize_row(metrics))
 
     def finish(self) -> None:
-        with open(self.project, "wb") as f:
+        tmp = f"{self.project}.tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
             pickle.dump(self.data, f)
+        os.replace(tmp, self.project)
+
+
+class JsonlLogger:
+    """One JSON object per line, appended as rows arrive.
+
+    Crash-tolerant by construction: every row is flushed on write, so a
+    killed worker loses at most the final partial line (which readers skip).
+    Selected with ``metric_logger_type="jsonl"``.
+    """
+
+    def __init__(self, project: str, config: dict[str, Any], *_args, **_kwargs):
+        self.project = project
+        self.config = config
+        self._f = open(project, "a")
+
+    def log(self, metrics: dict[str, Any]) -> None:
+        self._f.write(json.dumps(normalize_row(metrics)) + "\n")
+        self._f.flush()
+
+    def finish(self) -> None:
+        if not self._f.closed:
+            self._f.close()
+
+
+def read_jsonl(path: str) -> list[dict[str, Any]]:
+    """Load a JsonlLogger file, skipping any trailing partial line."""
+    rows = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rows.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue
+    return rows
 
 
 def get_logger(
@@ -59,6 +145,8 @@ def get_logger(
         return WandbLogger(project=project, config=config, resume=resume)
     elif logger_type == "dummy":
         return DummyLogger(project=project, config=config)
+    elif logger_type == "jsonl":
+        return JsonlLogger(project=project, config=config)
     raise ValueError(f"unknown metric_logger_type {logger_type!r}")
 
 

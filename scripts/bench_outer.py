@@ -123,10 +123,17 @@ def worker_main() -> None:
     # two paths key their mailbox frames differently); the parent passes it
     # explicitly per sweep
     os.environ["ODTP_PIPELINE"] = args.pipeline
+    # the bench sources its HEALTH accounting from the obs plane instead of
+    # hand-rolled accumulators: arm it unconditionally (events stay
+    # in-process unless ODTP_OBS_DIR is also set)
+    os.environ.setdefault("ODTP_OBS", "bench")
 
-    from opendiloco_tpu.diloco import chaos
+    from opendiloco_tpu import obs
     from opendiloco_tpu.diloco.backend import PeerProgress
     from opendiloco_tpu.diloco.tcp import TcpBackend
+
+    tr = obs.tracer()
+    tr.set_identity(worker=args.rank, role="bench")
 
     data = make_leaves(args.model, args.rank)
     # the window must cover the slowest peer's join on a box where all
@@ -202,9 +209,10 @@ def worker_main() -> None:
     times = []
     n = 0
     want = expected_group(args.peers, args.group_cap)
-    retries = 0
-    group_sizes: list[int] = []
-    elastic_rounds = 0
+
+    def ctr(name: str) -> int:
+        return int(tr.counters().get((name, ()), 0))
+
     # on a loaded 1-core box the peers drift apart across rounds (codec CPU
     # is serialized), so a matchmaking window that fit round 1 splits round
     # 3. Two mitigations, both deterministic across workers: an untimed
@@ -227,25 +235,29 @@ def worker_main() -> None:
         out, n = backend.all_reduce(
             data, timeout=args.timeout, group_cap=args.group_cap
         )
-        dt = time.perf_counter() - t0
-        if n < want and not args.group_cap and retries < 3:
-            retries += 1
+        t1 = time.perf_counter()
+        dt = t1 - t0
+        if n < want and not args.group_cap and ctr("bench_retries") < 3:
+            tr.count("bench_retries")
             backend.matchmaking_time = min(backend.matchmaking_time * 2, 120.0)
             print(
-                f"RETRY {retries}: group {n} < {want}, window -> "
-                f"{backend.matchmaking_time:.1f}s",
+                f"RETRY {ctr('bench_retries')}: group {n} < {want}, "
+                f"window -> {backend.matchmaking_time:.1f}s",
                 flush=True,
             )
             continue  # timing discarded; re-run this round
         if n < want:
-            elastic_rounds += 1
-        group_sizes.append(n)
+            tr.count("bench_elastic_rounds")
+        # accepted-round ledger lives in the trace: one span per timed
+        # round, group size in the args (the HEALTH line reads these back)
+        tr.add_span("bench/round", t0, t1, group=n)
         times.append(dt)
     timings = {
         k: (round(v, 3) if isinstance(v, float) else v)
         for k, v in getattr(backend, "last_round_timings", {}).items()
     }
     backend.close()
+    retries = ctr("bench_retries")
     if args.rank == 0:
         print(
             "RESULT " + " ".join(f"{t:.4f}" for t in times)
@@ -255,16 +267,28 @@ def worker_main() -> None:
         print("TIMINGS " + json.dumps(timings), flush=True)
     # EVERY worker reports its round health (with group_cap only rank 0's
     # group would otherwise be visible); the parent aggregates these into
-    # the row instead of classifying partial groups as errors
+    # the row instead of classifying partial groups as errors. The values
+    # come straight from the obs plane: per-round spans carry the group
+    # sizes, counters carry retries/elastic, and snapshot() folds the
+    # chaos plane's fault counters in first-class. Keys are unchanged, so
+    # the parent parser and the banked OUTER_BENCH.json schema are too.
+    snap = tr.snapshot()
     health = {
         "rank": args.rank,
-        "group_sizes": group_sizes,
-        "elastic_rounds": elastic_rounds,
+        "group_sizes": [
+            ev["args"]["group"] for ev in tr.events
+            if ev["name"] == "bench/round"
+        ],
+        "elastic_rounds": ctr("bench_elastic_rounds"),
         "retries": retries,
     }
-    cp = chaos.plane()
-    if cp is not None:
-        health["faults"] = dict(cp.counters)
+    faults = {
+        dict(labels).get("kind", "?"): int(v)
+        for (name, labels), v in snap["counters"].items()
+        if name == "chaos_faults"
+    }
+    if faults:
+        health["faults"] = faults
     print("HEALTH " + json.dumps(health), flush=True)
 
 

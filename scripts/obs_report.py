@@ -1,0 +1,344 @@
+#!/usr/bin/env python
+"""Galaxy-wide observability report: run an N-worker DiLoCo galaxy with
+the obs plane armed, merge every worker's trace by round id, and bank a
+per-stage breakdown.
+
+Real TCP data plane (one ``python -m opendiloco_tpu.train`` process per
+worker + one rendezvous daemon, same shape as chaos_soak), 2m model on
+fake data, with ``ODTP_OBS=1`` and ``ODTP_OBS_DIR`` set so every worker
+flushes a ``trace-w<rank>-<pid>.jsonl`` at exit. The parent then:
+
+- merges the per-worker traces on the round id (``grads-epoch-K``),
+- reduces each round to a per-stage wall-clock breakdown
+  (rendezvous / encode / wire / accumulate / barrier_wait / apply),
+- writes OBS_REPORT.json + a merged Chrome trace (OBS_TRACE.json,
+  loadable at ui.perfetto.dev or chrome://tracing) at the repo root.
+
+    python scripts/obs_report.py [--workers 8] [--rounds 3] [--out ...]
+    python scripts/obs_report.py --selftest   # small run + validation (CI)
+"""
+import argparse
+import json
+import os
+import shutil
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# the stage taxonomy the report guarantees per round; values are seconds
+STAGES = ("rendezvous", "encode", "wire", "accumulate", "barrier_wait", "apply")
+
+
+def worker_env(rank: int, trace_dir: str) -> dict:
+    env = dict(os.environ)
+    env["OPENDILOCO_TPU_PLATFORM"] = "cpu"
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["ODTP_OBS"] = "1"
+    env["ODTP_OBS_DIR"] = trace_dir
+    env.pop("ODTP_CHAOS", None)  # a clean baseline run, no fault plane
+    return env
+
+
+def spawn_daemon() -> tuple[subprocess.Popen, str]:
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    d = subprocess.Popen(
+        [
+            sys.executable, "-m", "opendiloco_tpu.diloco.rendezvous",
+            "--host", "127.0.0.1", "--port", "0",
+        ],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env, cwd=REPO,
+    )
+    while True:
+        line = d.stdout.readline()
+        assert line, "rendezvous daemon died before announcing its port"
+        if "initial_peers =" in line:
+            return d, line.strip().split()[-1].replace("0.0.0.0", "127.0.0.1")
+
+
+def spawn_worker(
+    rank: int, address: str, log_path: str, trace_dir: str, args
+) -> subprocess.Popen:
+    cli = [
+        sys.executable, "-m", "opendiloco_tpu.train",
+        "--path-model", args.model,
+        "--fake-data",
+        "--seq-length", "64",
+        "--per-device-train-batch-size", "4",
+        "--total-batch-size", "32",
+        "--lr", "3e-3",
+        "--warmup-steps", "4",
+        "--total-steps", str(args.rounds * args.local_steps),
+        "--precision", "fp32",
+        "--metric-logger-type", "jsonl",
+        "--project", log_path,
+        "--no-ckpt.interval",
+        "--diloco.local-steps", str(args.local_steps),
+        "--diloco.initial-peers", address,
+        "--diloco.world-rank", str(rank),
+        "--diloco.galaxy-size", str(args.workers),
+        "--diloco.matchmaking-time", "3.0",
+        "--diloco.averaging-timeout", "60",
+        "--diloco.all-reduce-strategy", "no_wait",
+        "--diloco.backend", "tcp",
+        "--diloco.skip-load-from-peers",
+    ]
+    return subprocess.Popen(
+        cli, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        env=worker_env(rank, trace_dir), cwd=REPO,
+    )
+
+
+def read_metric_rows(path: str) -> list[dict]:
+    rows = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    try:
+                        rows.append(json.loads(line))
+                    except ValueError:
+                        pass
+    except OSError:
+        pass
+    return rows
+
+
+def _epoch_of(round_id: str) -> int:
+    # "grads-epoch-7" -> 7
+    try:
+        return int(str(round_id).rsplit("epoch-", 1)[1].split(":")[0])
+    except (IndexError, ValueError):
+        return -1
+
+
+def stage_breakdown(events: list[dict]) -> dict[int, dict[str, float]]:
+    """One worker's per-epoch stage seconds, from its trace events.
+
+    The fine-grained totals (encode / wire / accumulate) ride on the
+    ``outer/round`` health instant; barrier_wait and apply come from the
+    optimizer's spans, summed per epoch.
+    """
+    per_epoch: dict[int, dict[str, float]] = {}
+
+    def bucket(epoch: int) -> dict[str, float]:
+        return per_epoch.setdefault(epoch, {s: 0.0 for s in STAGES})
+
+    for ev in events:
+        name, args = ev.get("name"), ev.get("args") or {}
+        if name == "outer/round" and str(args.get("round", "")).startswith(
+            "grads-"
+        ):
+            b = bucket(_epoch_of(args["round"]))
+            b["rendezvous"] += float(args.get("matchmake_s", 0.0))
+            b["encode"] += float(args.get("encode_s", 0.0))
+            b["wire"] += float(args.get("wire_send_s", 0.0)) + float(
+                args.get("wire_recv_s", 0.0)
+            )
+            b["accumulate"] += float(args.get("accumulate_s", 0.0))
+            b["_group"] = int(args.get("group_size", 0))
+            b["_elastic"] = bool(args.get("elastic"))
+        elif name == "outer/barrier_wait" and "epoch" in args:
+            bucket(int(args["epoch"]))["barrier_wait"] += ev["dur"] / 1e6
+        elif name == "outer/apply" and "epoch" in args:
+            bucket(int(args["epoch"]))["apply"] += ev["dur"] / 1e6
+    return {k: v for k, v in per_epoch.items() if k >= 0}
+
+
+def merge_report(trace_dir: str) -> tuple[dict, dict]:
+    """Merge every worker trace in ``trace_dir`` by round id. Returns
+    (report body, merged Chrome trace)."""
+    from opendiloco_tpu.obs import export
+
+    paths = sorted(
+        os.path.join(trace_dir, f)
+        for f in os.listdir(trace_dir)
+        if f.startswith("trace-") and f.endswith(".jsonl")
+    )
+    workers = []
+    for p in paths:
+        events, meta = export.load_jsonl(p)
+        wid = (meta.get("identity") or {}).get("worker", os.path.basename(p))
+        workers.append((wid, events, meta))
+
+    per_round: dict[int, dict] = {}
+    for wid, events, _meta in workers:
+        for epoch, stages in stage_breakdown(events).items():
+            row = per_round.setdefault(
+                epoch,
+                {
+                    "round": f"grads-epoch-{epoch}",
+                    "epoch": epoch,
+                    "workers": {},
+                },
+            )
+            row["workers"][str(wid)] = {
+                s: round(stages[s], 6) for s in STAGES
+            } | {
+                "group_size": stages.get("_group", 0),
+                "elastic": stages.get("_elastic", False),
+            }
+
+    rounds = []
+    for epoch in sorted(per_round):
+        row = per_round[epoch]
+        ws = list(row["workers"].values())
+        stages_s = {}
+        for s in STAGES:
+            vals = [w[s] for w in ws]
+            stages_s[s] = {
+                "mean": round(sum(vals) / len(vals), 6),
+                "max": round(max(vals), 6),
+            }
+        rounds.append({
+            "round": row["round"],
+            "epoch": epoch,
+            "workers_reporting": len(ws),
+            "group_size": max(w["group_size"] for w in ws),
+            "elastic": any(w["elastic"] for w in ws),
+            "stages_s": stages_s,
+            "per_worker": row["workers"],
+        })
+
+    counters: dict[str, float] = {}
+    for _wid, _events, meta in workers:
+        for k, v in (meta.get("counters") or {}).items():
+            counters[k] = counters.get(k, 0.0) + v
+
+    body = {
+        "workers_traced": len(workers),
+        "trace_files": [os.path.basename(p) for p in paths],
+        "per_round": rounds,
+        "counters_total": {k: counters[k] for k in sorted(counters)},
+    }
+    return body, export.chrome_trace(workers)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--workers", type=int, default=8)
+    ap.add_argument("--model", default="2m")
+    ap.add_argument("--rounds", type=int, default=3)
+    ap.add_argument("--local-steps", type=int, default=2)
+    ap.add_argument("--timeout", type=float, default=1200.0)
+    ap.add_argument("--out", default=os.path.join(REPO, "OBS_REPORT.json"))
+    ap.add_argument("--trace-out", default=os.path.join(REPO, "OBS_TRACE.json"))
+    ap.add_argument("--workdir", default="/tmp/odtp_obs_report")
+    ap.add_argument(
+        "--selftest", action="store_true",
+        help="small galaxy (2 workers, 2 rounds) + hard validation of the "
+        "merged report and Chrome trace; exit nonzero on any gap (CI)",
+    )
+    args = ap.parse_args()
+    if args.selftest:
+        args.workers = min(args.workers, 2)
+        args.rounds = min(args.rounds, 2)
+
+    shutil.rmtree(args.workdir, ignore_errors=True)
+    trace_dir = os.path.join(args.workdir, "traces")
+    os.makedirs(trace_dir, exist_ok=True)
+    t0 = time.time()
+    daemon, address = spawn_daemon()
+    print(f"rendezvous at {address}; obs traces -> {trace_dir}")
+
+    logs = {
+        r: os.path.join(args.workdir, f"obs_w{r}.jsonl")
+        for r in range(args.workers)
+    }
+    procs = {
+        r: spawn_worker(r, address, logs[r], trace_dir, args)
+        for r in range(args.workers)
+    }
+
+    fails: list[str] = []
+    deadline = time.time() + args.timeout
+    for r, p in sorted(procs.items()):
+        try:
+            out, err = p.communicate(timeout=max(10.0, deadline - time.time()))
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, err = p.communicate(timeout=30)
+            fails.append(f"rank {r}: timed out")
+        if p.returncode != 0:
+            fails.append(f"rank {r}: exit {p.returncode}\n{err[-1500:]}")
+    daemon.terminate()
+    try:
+        daemon.communicate(timeout=15)
+    except subprocess.TimeoutExpired:
+        daemon.kill()
+        daemon.communicate()
+
+    body, chrome = merge_report(trace_dir)
+
+    losses = []
+    for r in range(args.workers):
+        rows = read_metric_rows(logs[r])
+        if rows:
+            losses.append((rows[0].get("Loss"), rows[-1].get("Loss")))
+    report = {
+        "bench": "obs_report",
+        "model": args.model,
+        "workers": args.workers,
+        "rounds": args.rounds,
+        "local_steps": args.local_steps,
+        "backend": "tcp",
+        "stages": list(STAGES),
+        "failures": fails,
+        **body,
+        "loss_first_last_per_worker": [
+            [round(a, 4) if a is not None else None,
+             round(b, 4) if b is not None else None]
+            for a, b in losses
+        ],
+        "chrome_trace": os.path.basename(args.trace_out),
+        "elapsed_s": round(time.time() - t0, 1),
+    }
+    with open(args.out, "w") as f:
+        json.dump(report, f, indent=1, sort_keys=False)
+        f.write("\n")
+    with open(args.trace_out, "w") as f:
+        json.dump(chrome, f)
+        f.write("\n")
+    print(
+        f"banked {args.out} ({len(report['per_round'])} rounds, "
+        f"{report['workers_traced']} traces) and {args.trace_out} "
+        f"({len(chrome['traceEvents'])} events)"
+    )
+
+    ok = not fails and report["workers_traced"] == args.workers
+    # every worker must report every stage for every merged round
+    for row in report["per_round"]:
+        if row["workers_reporting"] < args.workers:
+            ok = False
+            print(
+                f"GAP: round {row['round']} has "
+                f"{row['workers_reporting']}/{args.workers} workers"
+            )
+        for w, stages in row["per_worker"].items():
+            missing = [s for s in STAGES if s not in stages]
+            if missing:
+                ok = False
+                print(f"GAP: round {row['round']} worker {w}: {missing}")
+    if not report["per_round"]:
+        ok = False
+        print("GAP: no merged rounds")
+    if args.selftest:
+        # the Chrome trace must be a valid trace_event document
+        assert isinstance(chrome.get("traceEvents"), list)
+        assert any(e.get("ph") == "X" for e in chrome["traceEvents"])
+        assert any(e.get("ph") == "M" for e in chrome["traceEvents"])
+    for f_ in fails:
+        print("FAILURE:", f_)
+    print("OBS REPORT " + ("PASSED" if ok else "FAILED"))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
