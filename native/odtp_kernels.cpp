@@ -325,10 +325,36 @@ void odtp_lut256_accumulate(const uint8_t* idx, const float* lut, float* dst,
 #endif
 }
 
+// Fused outer Nesterov SGD step (torch.optim.SGD parity, the normative
+// update of the pure-torch driver): buf = momentum*buf + g, then
+// p -= lr * (nesterov ? g + momentum*buf : buf). One pass over the three
+// arrays instead of the numpy path's two allocated temporaries.
+void odtp_outer_sgd_f32(float* p, const float* g, float* buf, float lr,
+                        float momentum, int nesterov, size_t n) {
+#pragma omp parallel for schedule(static)
+    for (ptrdiff_t i = 0; i < (ptrdiff_t)n; ++i) {
+        float b = momentum * buf[i] + g[i];
+        buf[i] = b;
+        p[i] -= lr * (nesterov ? g[i] + momentum * b : b);
+    }
+}
+
+// Squared L2 norm with a double accumulator (the pseudo_grad_norm gauge:
+// one OMP reduction instead of a serial per-leaf host dot).
+double odtp_sqnorm_f32(const float* a, size_t n) {
+    double s = 0.0;
+#pragma omp parallel for schedule(static) reduction(+ : s)
+    for (ptrdiff_t i = 0; i < (ptrdiff_t)n; ++i) {
+        s += (double)a[i] * (double)a[i];
+    }
+    return s;
+}
+
 // Bumped once per exported symbol-group addition: 1 = base codecs,
 // 2 = fused decode-accumulate, 3 = absmax + fused scaled-fp16 paths,
-// 4 = chunk-granular encode prescans (minmax + quantize-given).
-int odtp_version() { return 4; }
+// 4 = chunk-granular encode prescans (minmax + quantize-given),
+// 5 = fused outer SGD + sqnorm.
+int odtp_version() { return 5; }
 
 }  // extern "C"
 

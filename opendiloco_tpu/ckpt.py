@@ -255,10 +255,27 @@ def delete_old_checkpoints(ckpt_path: str, topk: Optional[int]) -> None:
 # ---------------------------------------------------------------------------
 
 
+def _coerce_host(obj: Any) -> Any:
+    """Coerce any device (jax) arrays in a diloco state tree to host numpy.
+
+    Checkpoints store a host view EITHER outer placement: in
+    ``outer_placement=device`` mode ``DiLoCoOptimizer.state_dict()``
+    already fetches host copies, but this guard keeps the serialized
+    format placement-portable even if a caller packs a tree holding live
+    device arrays."""
+    if isinstance(obj, dict):
+        return {k: _coerce_host(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_coerce_host(v) for v in obj]
+    if hasattr(obj, "__array__") and not isinstance(obj, np.ndarray):
+        return np.asarray(obj)
+    return obj
+
+
 def _pack_tree(tree: dict) -> tuple[dict, bytes]:
     from opendiloco_tpu.diloco.tcp import serialize_state
 
-    return serialize_state(tree)
+    return serialize_state(_coerce_host(tree))
 
 
 def _unpack_tree(meta: dict, blob: bytes) -> dict:

@@ -111,6 +111,17 @@ class DilocoConfig(BaseModel):
     # drops ~N-fold. 0/1 = off (reference full-sync semantics).
     streaming_fragments: int = 0
 
+    # where the outer data plane (master weights + Nesterov momentum) lives:
+    #   "host"   - numpy master, serial host Nesterov step (reference
+    #              hivemind offload_optimizer semantics)
+    #   "device" - sharded device arrays; pseudo-gradient and outer apply
+    #              are fused, donated jit ops at HBM bandwidth and the
+    #              boundary D2H moves wire-width bytes (diloco/outer_device.py)
+    #   "auto"   - device on TPU meshes, host elsewhere
+    # Device placement is single-process allreduce only; gossip and
+    # multihost meshes fall back to host with a warning.
+    outer_placement: Literal["auto", "host", "device"] = "auto"
+
     @model_validator(mode="after")
     def _streaming_constraints(self):
         if self.streaming_fragments > 1:

@@ -296,3 +296,20 @@ def get_codec(name: str) -> Codec:
 def compress_roundtrip(arr: np.ndarray, codec: Codec) -> np.ndarray:
     payload, meta = codec.encode(arr)
     return codec.decode(payload, arr.shape, meta)
+
+
+def device_wire_dtype(name: str) -> str | None:
+    """Device-side encode hook for ``outer_placement=device``.
+
+    Returns the dtype the device plane may pre-cast the pseudo-gradient to
+    INSIDE jit so the D2H boundary copy moves wire-width bytes, or None
+    when the codec offers no safe device pre-cast (full-width D2H).
+
+    Only codecs whose host encode is idempotent under the pre-cast
+    qualify: plain fp16's encode is f16(x) and f16(f32(f16(x))) == f16(x)
+    bit-for-bit, so the bytes that ride the wire are unchanged vs the
+    host placement. scaled-fp16 divides by a host-computed abs-max
+    BEFORE its cast and the 8-bit codecs bucket full-precision values,
+    so a device pre-cast would change the wire bytes on those paths.
+    """
+    return "float16" if name == "fp16" else None

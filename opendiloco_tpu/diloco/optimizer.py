@@ -29,6 +29,7 @@ import numpy as np
 from opendiloco_tpu import native, obs
 from opendiloco_tpu.config import DilocoConfig
 from opendiloco_tpu.diloco.backend import OuterBackend, PeerProgress, wait_for_peers
+from opendiloco_tpu.diloco.outer_device import DeviceOuterPlane
 from opendiloco_tpu.diloco.outer_optimizer import OuterSGD
 from opendiloco_tpu.parallel.world import HostWorld
 from opendiloco_tpu.trainer import InnerTrainer
@@ -46,6 +47,38 @@ _ANNOUNCE_INTERVAL_S = 15.0
 class PeerDropError(RuntimeError):
     """Raised when a DiLoCo worker disappears and fail_rank_drop is set
     (reference: train_fsdp.py:452-457)."""
+
+
+def resolve_outer_placement(cfg: DilocoConfig, trainer, world) -> str:
+    """Resolve ``outer_placement`` to 'host' or 'device'.
+
+    'auto' picks device on TPU meshes (the master fits HBM there; the host
+    offload is a GPU-memory artifact of the reference) and host elsewhere.
+    Device placement requires the configurations it can keep consistent:
+    single-process meshes (the plane is not collective-aware) and the
+    allreduce outer mode (gossip puts the master itself on the wire every
+    round, which would D2H the whole plane anyway) — anything else falls
+    back to host with a warning rather than failing the run."""
+    if cfg.outer_placement == "host":
+        return "host"
+    if cfg.outer_placement == "auto":
+        dev = trainer.plan.mesh.devices.flat[0]
+        if "tpu" not in getattr(dev, "device_kind", "").lower():
+            return "host"
+    if world.process_count > 1:
+        log.warning(
+            "outer_placement=device is single-process only (multihost "
+            "slices replicate the host master); falling back to host"
+        )
+        return "host"
+    if cfg.outer_mode == "gossip":
+        log.warning(
+            "outer_placement=device does not compose with outer_mode="
+            "'gossip' (the master rides the wire every round); falling "
+            "back to host"
+        )
+        return "host"
+    return "device"
 
 
 class DiLoCoOptimizer:
@@ -72,14 +105,32 @@ class DiLoCoOptimizer:
         self.batch_size = batch_size
         self.target_samples = batch_size * cfg.local_steps
 
+        # outer data plane placement: host numpy master (reference
+        # hivemind offload semantics) or a device-resident plane
+        # (diloco/outer_device.py) with fused, donated boundary ops
+        self.placement = resolve_outer_placement(cfg, trainer, self.world)
         # host master copy (float32). Flatten once; treedef is stable.
         # Under multihost the gather is a mesh collective: every process of
         # the slice holds the identical full replica.
         flat_dev, self.treedef = jax.tree.flatten(state["params"])
-        self.master: list[np.ndarray] = [
-            np.array(x, dtype=np.float32)
-            for x in self.world.gather_params(flat_dev)
-        ]
+        self._plane: Optional[DeviceOuterPlane] = None
+        if self.placement == "device":
+            self._plane = DeviceOuterPlane(
+                trainer,
+                flat_dev,
+                lr=cfg.outer_lr,
+                momentum=cfg.outer_momentum,
+                nesterov=cfg.outer_nesterov,
+                compression=cfg.compression,
+            )
+            # the plane owns master + momentum; the host list stays empty
+            # (every device-mode path goes through self._plane)
+            self.master: list[np.ndarray] = []
+        else:
+            self.master = [
+                np.array(x, dtype=np.float32)
+                for x in self.world.gather_params(flat_dev)
+            ]
         self.outer_opt = OuterSGD(
             lr=cfg.outer_lr, momentum=cfg.outer_momentum, nesterov=cfg.outer_nesterov
         )
@@ -88,19 +139,22 @@ class DiLoCoOptimizer:
         # streaming fragment sync (arxiv 2501.18512): size-balanced
         # contiguous partition of leaf indices, derived from the (shared)
         # schema so every peer computes the identical partition with no
-        # coordination; fragment synced at epoch e is e mod N
+        # coordination; fragment synced at epoch e is e mod N. Sizes come
+        # from the device leaves (identical to the master shapes) so both
+        # placements derive the same partition.
         self._fragments: Optional[list[list[int]]] = None
         if cfg.streaming_fragments > 1:
-            n_frag = min(cfg.streaming_fragments, len(self.master))
-            total = sum(m.size for m in self.master)
+            leaf_sizes = [int(x.size) for x in flat_dev]
+            n_frag = min(cfg.streaming_fragments, len(leaf_sizes))
+            total = sum(leaf_sizes)
             target = total / n_frag
             frags: list[list[int]] = []
             cur: list[int] = []
             acc = 0
-            for i, m in enumerate(self.master):
+            for i, sz in enumerate(leaf_sizes):
                 cur.append(i)
-                acc += m.size
-                remaining = len(self.master) - i - 1
+                acc += sz
+                remaining = len(leaf_sizes) - i - 1
                 still_needed = n_frag - len(frags) - 1  # after closing cur
                 # close when the fragment is full OR the leaves left are
                 # only just enough to give every remaining fragment one --
@@ -120,7 +174,7 @@ class DiLoCoOptimizer:
                     f"streaming-fragment partition produced "
                     f"{sum(1 for f in frags if f)} non-empty of {len(frags)} "
                     f"fragments, need exactly {n_frag} from "
-                    f"{len(self.master)} leaves"
+                    f"{len(leaf_sizes)} leaves"
                 )
             self._fragments = frags
         self.epoch = 0  # completed outer steps
@@ -272,7 +326,59 @@ class DiLoCoOptimizer:
             return snap["master"], snap["epoch"], snap["outer_opt"]
         return self.master, self.epoch, self.outer_opt.state_dict_refs()
 
+    def _device_state_for_peers(self) -> dict[str, Any]:
+        """Serve-thread snapshot in device placement: the host view is
+        fetched lazily, only when a peer actually asks. Lock order is
+        plane.lock -> _serve_lock everywhere. The pre-published host
+        snapshot (state-averaging rounds) is checked first under
+        _serve_lock alone so fetches never stall behind a WAN leg."""
+        plane = self._plane
+        with self._serve_lock:
+            snap = self._blocking_snap
+            if snap is not None:
+                master = [m.copy() for m in snap["master"]]
+                opt = snap["outer_opt"]
+                bufs = opt.get("bufs")
+                return {
+                    "master": master,
+                    "epoch": snap["epoch"],
+                    "outer_opt": {
+                        **{k: opt[k] for k in ("lr", "momentum", "nesterov")},
+                        "bufs": None if bufs is None else [b.copy() for b in bufs],
+                    },
+                }
+        # plane.lock held across the whole device fetch: the training
+        # thread's donating apply deletes the old buffers, so a concurrent
+        # device_get would read freed memory. Holding it also pins the
+        # (masters, epoch) pair — every device-mode mutator advances the
+        # epoch while still inside plane.lock.
+        with plane.lock:
+            with self._serve_lock:
+                p = self._pending
+                if p is not None and "plane_pre" in p:
+                    # overlapped round in flight: epoch already advanced,
+                    # plane possibly rebound to the eager estimate — serve
+                    # the retained pre-round device arrays instead
+                    m_refs, b_refs = p["plane_pre"]
+                    epoch = p["epoch"]
+                else:
+                    m_refs, b_refs = plane.masters, plane.bufs
+                    epoch = self.epoch
+            master, bufs = plane.host_state((m_refs, b_refs))
+        return {
+            "master": master,
+            "epoch": epoch,
+            "outer_opt": {
+                "lr": plane.lr,
+                "momentum": plane.momentum,
+                "nesterov": plane.nesterov,
+                "bufs": bufs,
+            },
+        }
+
     def _state_for_peers(self) -> dict[str, Any]:
+        if self._plane is not None:
+            return self._device_state_for_peers()
         # the lock makes the flag checks + reference reads atomic against
         # the round-boundary publications (all of which also hold the lock):
         # without it, a fetch that passes the flag checks just before a
@@ -340,6 +446,28 @@ class DiLoCoOptimizer:
             remote = self._broadcast_remote_state(remote)
         if remote is None:
             return None
+        if self._plane is not None:
+            opt = remote["outer_opt"]
+            with self._plane.lock:
+                self._plane.load(
+                    remote["master"],
+                    opt.get("bufs"),
+                    lr=opt.get("lr"),
+                    momentum=opt.get("momentum"),
+                    nesterov=opt.get("nesterov"),
+                )
+                # scalar mirror only; the plane owns the momentum bufs
+                self.outer_opt.load_state_dict({**opt, "bufs": None})
+                with self._serve_lock:
+                    self._blocking_snap = None
+                    self.epoch = int(remote["epoch"])
+                    self.local_step = 0
+                    self.samples_in_epoch = 0
+                leaves = self._plane.sync_params(jax.tree.leaves(state["params"]))
+                state["params"] = jax.tree.unflatten(self.treedef, leaves)
+            return self.trainer.force_step_position(
+                state, self.epoch * self.cfg.local_steps
+            )
         with self._serve_lock:
             self._blocking_snap = None  # superseded pre-round snapshot
             self.master = [
@@ -458,6 +586,8 @@ class DiLoCoOptimizer:
         estimated from the local pseudo-gradient immediately and corrects
         with (M'_true - M'_est) on arrival.
         """
+        if self._plane is not None:
+            return self._outer_step_overlapped_device(state)
         assert schema_fingerprint(state["params"]) == self._schema, (
             "parameter schema changed mid-epoch"
         )
@@ -552,6 +682,107 @@ class DiLoCoOptimizer:
             self.epoch += 1
             self.local_step = 0
             self.samples_in_epoch = 0
+        self._epoch_t0 = time.monotonic()
+        outer_metrics = {
+            "outer_step_s": time.monotonic() - t0,
+            "outer_wait_s": wait_s,
+            "outer_overlapped": 1,
+        }
+        if tr is not None:
+            tr.add_span(
+                "outer/launch", t0p, time.perf_counter(), epoch=self.epoch - 1
+            )
+            tr.gauge("outer_wait_s", wait_s)
+        self.last_outer_metrics = outer_metrics
+        return state, outer_metrics
+
+    def _outer_step_overlapped_device(self, state: dict) -> tuple[dict, dict]:
+        """Device-placement overlapped launch: pseudo-gradient and (eager)
+        estimate are fused device ops; the boundary params never need a
+        full-width D2H (the wire fetch is wire-width, the f32
+        pseudo-gradient is retained ON DEVICE for the landing math instead
+        of a host boundary/master snapshot)."""
+        plane = self._plane
+        assert schema_fingerprint(state["params"]) == self._schema, (
+            "parameter schema changed mid-epoch"
+        )
+        t0 = time.monotonic()
+        tr = obs.tracer()
+        t0p = time.perf_counter() if tr is not None else 0.0
+        if self._pending is not None:  # at most one round in flight
+            state = self._poll_pending(state, block=True)
+        self._drain_abandoned()
+
+        # overlap the (wire-width) pseudo-gradient D2H with the straggler
+        # wait; device placement is single-process, so this process IS the
+        # messenger and both pg forms are always needed (host for the wire,
+        # f32 device for the landing delta)
+        device_leaves = jax.tree.leaves(state["params"])
+        # device copy of the boundary params: both overlap modes compute the
+        # deferred boundary rewrite as new_master - boundary (the SAME
+        # associativity as the host path's (m - lr*d) - boundary); deriving
+        # it from the pseudo-gradient instead rounds at pg scale and drifts
+        # ~1e3 ulps over a few rounds once inner AdamW amplifies it
+        eager = self.cfg.overlap_comm == "eager"
+        boundary_dev = plane.copy_leaves(device_leaves)
+        fetch_result: list = []
+
+        def _fetch():
+            fetch_result.append(
+                plane.pseudo_grad(
+                    device_leaves,
+                    with_norm=tr is not None,
+                    keep_device=eager,
+                )
+            )
+
+        fetcher = threading.Thread(target=_fetch)
+        fetcher.start()
+        wait_for_peers(
+            self.backend,
+            target_samples=self.target_samples,
+            own_epoch=self.epoch,
+            strategy=self.cfg.all_reduce_strategy,
+            timeout_waiting_for_peers=self.cfg.timeout_waiting_for_peers,
+            log=log,
+        )
+        wait_s = time.monotonic() - t0
+        if tr is not None:
+            tr.add_span(
+                "outer/barrier_wait", t0p, time.perf_counter(),
+                epoch=self.epoch,
+            )
+        fetcher.join()
+        pg_host, pg_norm, pg_dev = fetch_result[0]
+        if tr is not None and pg_norm is not None:
+            tr.gauge("pseudo_grad_norm", pg_norm)
+
+        pending: dict[str, Any] = {
+            "epoch": self.epoch,
+            "t_launch": t0,
+            "future": self._spawn_all_reduce(pg_host, self.epoch),
+        }
+        # the plane mutation (eager estimate), the pending publication, and
+        # the epoch advance must appear atomically to the serve thread's
+        # device path (which takes plane.lock then _serve_lock)
+        with plane.lock:
+            pending["plane_pre"] = (plane.masters, plane.bufs)
+            if eager:
+                # immediate update from the local pseudo-gradient; the
+                # estimate rebinds the live plane (pg_dev and the boundary
+                # copy are donated) and returns the device delta for the
+                # inner params
+                delta = plane.estimate(pg_dev, boundary_dev)
+                state = self._apply_delta_to_device(state, delta)
+            else:
+                # delayed: the landing rewrites the boundary params to the
+                # true new master, so it needs the retained boundary copy
+                pending["boundary_dev"] = boundary_dev
+            with self._serve_lock:
+                self._pending = pending
+                self.epoch += 1
+                self.local_step = 0
+                self.samples_in_epoch = 0
         self._epoch_t0 = time.monotonic()
         outer_metrics = {
             "outer_step_s": time.monotonic() - t0,
@@ -713,23 +944,44 @@ class DiLoCoOptimizer:
             self._check_group_size(group_size)
 
             t_apply = time.perf_counter() if tr is not None else 0.0
-            master = [m.copy() for m in pending["master_snap"]]
-            opt = OuterSGD(
-                lr=self.cfg.outer_lr,
-                momentum=self.cfg.outer_momentum,
-                nesterov=self.cfg.outer_nesterov,
-            )
-            opt.load_state_dict(pending["opt_snap"])
-            opt.step(master, avg)
+            if "plane_pre" in pending:
+                # device placement: fused landing. plane.lock is held from
+                # the donating land op until the pending round is cleared —
+                # the serve thread's device path could otherwise pick up
+                # the just-donated pre-round refs from _pending and
+                # device_get freed buffers.
+                plane = self._plane
+                pre_m, pre_b = pending["plane_pre"]
+                with plane.lock:
+                    if "boundary_dev" in pending:  # delayed
+                        delta = plane.land_delayed(
+                            pre_m, pre_b, pending["boundary_dev"], avg
+                        )
+                    else:  # eager: correct the estimated update
+                        delta = plane.land_eager(pre_m, pre_b, avg)
+                    state = self._apply_delta_to_device(state, delta)
+                    with self._serve_lock:
+                        self._pending = None
+            else:
+                master = [m.copy() for m in pending["master_snap"]]
+                opt = OuterSGD(
+                    lr=self.cfg.outer_lr,
+                    momentum=self.cfg.outer_momentum,
+                    nesterov=self.cfg.outer_nesterov,
+                )
+                opt.load_state_dict(pending["opt_snap"])
+                opt.step(master, avg)
 
-            if "est_master" in pending:  # eager: correct the estimated update
-                delta = [t - e for t, e in zip(master, pending["est_master"])]
-            else:  # delayed: the deferred boundary rewrite
-                delta = [t - b for t, b in zip(master, pending["boundary"])]
-            state = self._apply_delta_to_device(state, delta)
-            with self._serve_lock:
-                self.outer_opt = opt
-                self.master = master
+                if "est_master" in pending:  # eager: correct the estimate
+                    delta = [
+                        t - e for t, e in zip(master, pending["est_master"])
+                    ]
+                else:  # delayed: the deferred boundary rewrite
+                    delta = [t - b for t, b in zip(master, pending["boundary"])]
+                state = self._apply_delta_to_device(state, delta)
+                with self._serve_lock:
+                    self.outer_opt = opt
+                    self.master = master
             if tr is not None:
                 tr.add_span(
                     "outer/apply", t_apply, time.perf_counter(),
@@ -859,7 +1111,165 @@ class DiLoCoOptimizer:
         avg, meta = self._messenger_fanout(produce, [a.shape for a in arrays])
         return avg, int(meta["n"]), int(meta["live"])
 
+    def _outer_step_device(self, state: dict) -> tuple[dict, dict]:
+        """Blocking outer round, device placement: the pseudo-gradient and
+        the Nesterov apply are fused, donated jit ops; D2H moves wire-width
+        bytes and H2D returns only the averaged pseudo-gradient. No
+        clone-then-rebind and no pre-round host snapshot for normal rounds
+        — donation makes the apply atomic under plane.lock, which the
+        serve thread's device path also takes. State-averaging rounds do
+        pre-publish a host snapshot (their WAN leg would otherwise stall
+        onboarding fetches behind plane.lock)."""
+        plane = self._plane
+        if self._pending is not None:  # a blocking round supersedes overlap
+            state = self._poll_pending(state, block=True)
+        self._drain_abandoned()
+        assert schema_fingerprint(state["params"]) == self._schema, (
+            "parameter schema changed mid-epoch"
+        )
+        state_avg = self._is_state_avg_epoch()
+        if state_avg:
+            master_snap, buf_snap = plane.host_state()
+            with self._serve_lock:
+                self._blocking_snap = {
+                    "master": master_snap,
+                    "epoch": self.epoch,
+                    "outer_opt": {
+                        "lr": plane.lr,
+                        "momentum": plane.momentum,
+                        "nesterov": plane.nesterov,
+                        "bufs": buf_snap,
+                    },
+                }
+        t0 = time.monotonic()
+        tr = obs.tracer()
+        t0p = time.perf_counter() if tr is not None else 0.0
+
+        frag: Optional[list[int]] = None
+        device_leaves = jax.tree.leaves(state["params"])
+        if self._fragments is not None:
+            frag = self._fragments[self.epoch % len(self._fragments)]
+        fetch_result: list = []
+
+        def _fetch():
+            # wire-width D2H of this boundary's fragment; the norm rides
+            # the same jit as one HBM reduction when the tracer is armed
+            fetch_result.append(
+                plane.pseudo_grad(
+                    device_leaves if frag is None
+                    else [device_leaves[i] for i in frag],
+                    frag,
+                    with_norm=tr is not None,
+                )
+            )
+
+        fetcher = threading.Thread(target=_fetch)
+        fetcher.start()
+        wait_for_peers(
+            self.backend,
+            target_samples=self.target_samples,
+            own_epoch=self.epoch,
+            strategy=self.cfg.all_reduce_strategy,
+            timeout_waiting_for_peers=self.cfg.timeout_waiting_for_peers,
+            log=log,
+        )
+        wait_s = time.monotonic() - t0
+        if tr is not None:
+            tr.add_span(
+                "outer/barrier_wait", t0p, time.perf_counter(),
+                epoch=self.epoch,
+            )
+        fetcher.join()
+        if tr is not None:
+            tr.add_span("outer/d2h", t0p, time.perf_counter(), epoch=self.epoch)
+        pseudo_grad, pg_norm, _ = fetch_result[0]
+        if tr is not None and pg_norm is not None:
+            tr.gauge("pseudo_grad_norm", pg_norm)
+
+        t1 = time.monotonic()
+        t1p = time.perf_counter() if tr is not None else 0.0
+        averaged, group_size, _ = self._wan_all_reduce(
+            pseudo_grad, timeout=self.cfg.averaging_timeout, epoch=self.epoch
+        )
+        self._check_group_size(group_size)
+        allreduce_s = time.monotonic() - t1
+        if tr is not None:
+            tr.add_span(
+                "outer/allreduce", t1p, time.perf_counter(),
+                epoch=self.epoch, group=group_size,
+            )
+        t_apply = time.perf_counter() if tr is not None else 0.0
+        log.info(
+            "outer step %d: all-reduce over %d peers took %.3fs",
+            self.epoch,
+            group_size,
+            allreduce_s,
+        )
+
+        if state_avg:
+            # fused apply, then the full-state averaging leg: master D2H'd
+            # on demand, averaged over the WAN, adopted back. The
+            # pre-published _blocking_snap keeps onboarding fetches
+            # consistent (and unblocked) throughout.
+            plane.apply_average(averaged, frag)
+            master_host, _ = plane.host_state()
+            averaged_state, n, _ = self._wan_all_reduce(
+                master_host, timeout=self.cfg.averaging_timeout, tag="state"
+            )
+            plane.load_masters(averaged_state)
+            log.info(
+                "averaged full state over %d peers at epoch %d", n, self.epoch
+            )
+            with plane.lock:
+                leaves = plane.sync_params(device_leaves, frag)
+                state["params"] = jax.tree.unflatten(self.treedef, leaves)
+                with self._serve_lock:
+                    self.epoch += 1
+                    self.local_step = 0
+                    self.samples_in_epoch = 0
+                    self._blocking_snap = None
+        else:
+            # plane.lock spans the donating apply, the params sync, and
+            # the epoch advance: a serve-thread fetch sees exactly the
+            # pre- or the post-round (plane, epoch) pair, never a mix.
+            # sync= folds the params <- master overwrite into the apply
+            # jit (donating the old param buffers) — one dispatch and one
+            # fewer full-model pass than apply + sync_params
+            with plane.lock:
+                leaves = plane.apply_average(
+                    averaged, frag, sync=device_leaves
+                )
+                state["params"] = jax.tree.unflatten(self.treedef, leaves)
+                with self._serve_lock:
+                    self.epoch += 1
+                    self.local_step = 0
+                    self.samples_in_epoch = 0
+        if tr is not None:
+            tr.add_span(
+                "outer/apply", t_apply, time.perf_counter(), epoch=self.epoch - 1
+            )
+        self._epoch_t0 = time.monotonic()
+        outer_metrics = {
+            "outer_step_s": time.monotonic() - t0,
+            "outer_allreduce_s": allreduce_s,
+            "outer_wait_s": wait_s,
+            "num_peers": group_size,
+            **self._round_health_metrics(),
+        }
+        if tr is not None:
+            tr.add_span(
+                "outer/step", t0p, time.perf_counter(),
+                epoch=self.epoch - 1, group=group_size,
+            )
+            tr.gauge("outer_step_s", outer_metrics["outer_step_s"])
+            tr.gauge("outer_allreduce_s", allreduce_s)
+            tr.gauge("outer_wait_s", wait_s)
+        self.last_outer_metrics = outer_metrics
+        return state, outer_metrics
+
     def outer_step(self, state: dict) -> tuple[dict, dict]:
+        if self._plane is not None:
+            return self._outer_step_device(state)
         if self._pending is not None:  # a blocking round supersedes overlap
             state = self._poll_pending(state, block=True)
         # an abandoned overlapped round (desync re-onboard -> drop_pending)
@@ -952,10 +1362,12 @@ class DiLoCoOptimizer:
             pseudo_grad = self._pseudo_grad_into(device_flat, slot=0)
 
         if tr is not None:
+            # fused OMP dot (native fallback: np.dot) instead of a serial
+            # per-leaf host reduction; device placement computes this norm
+            # inside the pseudo-gradient jit instead (outer_device.py)
             sq = 0.0
             for g in pseudo_grad:
-                v = np.asarray(g, np.float32).reshape(-1)
-                sq += float(np.dot(v, v))
+                sq += native.sqnorm(np.asarray(g, np.float32).reshape(-1))
             tr.gauge("pseudo_grad_norm", float(np.sqrt(sq)))
 
         t1 = time.monotonic()
@@ -1095,6 +1507,22 @@ class DiLoCoOptimizer:
                 "state_dict() with an outer round in flight; call "
                 "flush(state) first for a master that includes it"
             )
+        if self._plane is not None:
+            # host view either placement: checkpoints are
+            # placement-portable (ckpt.py serializes numpy trees)
+            master, bufs = self._plane.host_state()
+            return {
+                "master": master,
+                "outer_opt": {
+                    "lr": self._plane.lr,
+                    "momentum": self._plane.momentum,
+                    "nesterov": self._plane.nesterov,
+                    "bufs": bufs,
+                },
+                "epoch": self.epoch,
+                "local_step": self.local_step,
+                "samples_in_epoch": self.samples_in_epoch,
+            }
         return {
             "master": [m.copy() for m in self.master],
             "outer_opt": self.outer_opt.state_dict(),
@@ -1104,6 +1532,31 @@ class DiLoCoOptimizer:
         }
 
     def load_state_dict(self, sd: dict) -> None:
+        if self._plane is not None:
+            # lock order is plane.lock -> _serve_lock (the serve thread's
+            # device path takes them in that order too)
+            opt = sd["outer_opt"]
+            with self._plane.lock:
+                self._plane.load(
+                    sd["master"],
+                    opt.get("bufs"),
+                    lr=opt.get("lr"),
+                    momentum=opt.get("momentum"),
+                    nesterov=opt.get("nesterov"),
+                )
+                # scalar mirror only; the plane owns the momentum bufs
+                self.outer_opt.load_state_dict({**opt, "bufs": None})
+                with self._serve_lock:
+                    self._blocking_snap = None
+                    self.epoch = int(sd["epoch"])
+                    self.local_step = int(sd["local_step"])
+                    self.samples_in_epoch = int(
+                        sd.get(
+                            "samples_in_epoch",
+                            self.local_step * self.batch_size,
+                        )
+                    )
+            return
         with self._serve_lock:
             self._blocking_snap = None  # superseded pre-round snapshot
             self.master = [

@@ -1,0 +1,524 @@
+"""Device-resident outer plane: master + Nesterov momentum in HBM.
+
+The reference keeps the DiLoCo master and outer-optimizer state on host
+purely as a hivemind ``offload_optimizer`` artifact of GPU-memory-poor
+workers (open_diloco/hivemind_diloco.py:399-400). On TPU the master fits
+HBM, so ``outer_placement=device`` moves the whole outer data plane onto
+the mesh:
+
+  pseudo-gradient   pg  = master - params          one fused jit op
+  outer Nesterov    buf = m*buf + g                one fused, DONATED jit
+                    p  -= lr*(g + m*buf)           op at HBM bandwidth
+
+Donation replaces the host path's clone-then-rebind double copies (the
+old buffers are handed to XLA for reuse instead of being copied for the
+serve thread), and the master/momentum never cross the host boundary.
+The D2H boundary transfer shrinks to wire width: for the plain ``fp16``
+codec the pseudo-gradient is cast to float16 INSIDE jit (f16 round-trip
+is idempotent, so the bytes that later ride the wire are unchanged — see
+``compression.device_wire_dtype``) and the host fetch moves half-width
+bytes. The H2D return carries only the averaged pseudo-gradient; the
+apply runs on device.
+
+Thread contract: every mutating entry point takes ``self.lock`` (an
+RLock) around the donating jit call AND the rebind, and the serve
+thread's lazy host snapshot (``host_state``) holds the same lock while
+it fetches — a donated buffer is deleted at call time, so a fetch racing
+a donation would read freed memory. The DiLoCoOptimizer wraps its
+(plane mutation, epoch advance, pending publish) sequences in this lock
+too, so a snapshot is always epoch-consistent.
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from opendiloco_tpu.diloco.compression import device_wire_dtype
+
+
+def _sqsum(leaves):
+    total = jnp.zeros((), jnp.float32)
+    for g in leaves:
+        total = total + jnp.sum(jnp.square(g.astype(jnp.float32)))
+    return total
+
+
+def _nesterov_step(masters, bufs, grads, lr, momentum, nesterov, has_mom):
+    """The load-bearing SGD rule (torch.optim.SGD parity — the same update
+    OuterSGD.step_indices runs on host):
+      buf = momentum*buf + g;  d = g + momentum*buf (nesterov) | buf;
+      p -= lr*d.  Returns (new_masters, new_bufs, d)."""
+    if not has_mom:
+        d = grads
+        return [m - lr * g for m, g in zip(masters, grads)], [], d
+    if not bufs:  # first armed step: momentum starts at zero
+        bufs = [jnp.zeros_like(m) for m in masters]
+    new_b = [momentum * b + g for b, g in zip(bufs, grads)]
+    if nesterov:
+        d = [g + momentum * b for g, b in zip(grads, new_b)]
+    else:
+        d = new_b
+    new_m = [m - lr * dd for m, dd in zip(masters, d)]
+    return new_m, new_b, d
+
+
+# -- jitted entry points -----------------------------------------------------
+# Lists of leaves are pytree args, so the jit cache is keyed by fragment
+# length + avals: the fragment partition is fixed at construction, giving a
+# small bounded set of executables that never recompiles across rounds.
+
+
+@functools.partial(jax.jit, static_argnames=("with_norm",))
+def _pg_f32(masters, params, with_norm):
+    pg = [m - p for m, p in zip(masters, params)]
+    return pg, (_sqsum(pg) if with_norm else jnp.zeros((), jnp.float32))
+
+
+@functools.partial(
+    jax.jit, static_argnames=("wire_dtype", "with_norm", "keep32")
+)
+def _pg_wire(masters, params, wire_dtype, with_norm, keep32):
+    """Pseudo-gradient with the wire cast fused in: the D2H fetch of
+    ``wire`` moves wire-width (half for f16) bytes. ``keep32`` retains the
+    f32 pseudo-gradient on device for the overlap landing math."""
+    pg = [m - p for m, p in zip(masters, params)]
+    wire = [g.astype(wire_dtype) for g in pg]
+    sq = _sqsum(pg) if with_norm else jnp.zeros((), jnp.float32)
+    return (pg if keep32 else []), wire, sq
+
+
+@functools.partial(
+    jax.jit, static_argnames=("nesterov", "has_mom"), donate_argnums=(0, 1, 2)
+)
+def _apply_fused(masters, bufs, avg, lr, momentum, *, nesterov, has_mom):
+    """Blocking apply: donated masters/momentum stepped in one fused op.
+    ``avg`` is dead after the step, so it is donated too — its hot pages
+    become XLA scratch instead of a fresh (page-faulting) allocation."""
+    new_m, new_b, _ = _nesterov_step(
+        masters, bufs, avg, lr, momentum, nesterov, has_mom
+    )
+    return new_m, new_b
+
+
+@functools.partial(
+    jax.jit, static_argnames=("nesterov", "has_mom"),
+    donate_argnums=(0, 1, 2, 3),
+)
+def _apply_sync_fused(
+    masters, bufs, avg, params, lr, momentum, *, nesterov, has_mom
+):
+    """Blocking apply + params <- master in ONE dispatch: the new master
+    is written to both outputs while hot instead of re-read by a separate
+    ``_overwrite_fused`` launch — one fewer full-model pass per boundary.
+    The old param buffers are donated (the caller is replacing them); the
+    add-zero keeps the fresh params from aliasing the live masters (see
+    ``_overwrite_fused`` for why that aliasing would be fatal)."""
+    new_m, new_b, _ = _nesterov_step(
+        masters, bufs, avg, lr, momentum, nesterov, has_mom
+    )
+    new_p = [m + jnp.zeros((), m.dtype) for m in new_m]
+    return new_m, new_b, new_p
+
+
+@functools.partial(
+    jax.jit, static_argnames=("nesterov", "has_mom"), donate_argnums=(2, 3)
+)
+def _estimate_fused(
+    masters, bufs, pg, boundary, lr, momentum, *, nesterov, has_mom
+):
+    """Eager-overlap launch: the update estimated from the LOCAL
+    pseudo-gradient. Masters/bufs are NOT donated (the pre-round arrays
+    stay live for the correction on landing); pg and the boundary copy
+    are consumed. delta = est_m - boundary matches the host path's
+    associativity exactly — computing it as pg - lr*d instead rounds at
+    the pseudo-gradient's scale and drifts ~1e3 ulps over a few rounds."""
+    est_m, est_b, _ = _nesterov_step(
+        masters, bufs, pg, lr, momentum, nesterov, has_mom
+    )
+    delta = [e - b for e, b in zip(est_m, boundary)]
+    return est_m, est_b, delta
+
+
+@functools.partial(
+    jax.jit, static_argnames=("nesterov", "has_mom"),
+    donate_argnums=(0, 1, 2, 3),
+)
+def _land_delayed_fused(
+    masters, bufs, boundary, avg, lr, momentum, *, nesterov, has_mom
+):
+    """Delayed-overlap landing: true outer step from the pre-round
+    masters + the deferred boundary rewrite as a delta,
+    delta = new_m - boundary (same associativity as the host path; the
+    boundary copy is donated — last use)."""
+    new_m, new_b, _ = _nesterov_step(
+        masters, bufs, avg, lr, momentum, nesterov, has_mom
+    )
+    delta = [m - b for m, b in zip(new_m, boundary)]
+    return new_m, new_b, delta
+
+
+@functools.partial(
+    jax.jit, static_argnames=("nesterov", "has_mom"),
+    donate_argnums=(0, 1, 2, 3),
+)
+def _land_eager_fused(masters, bufs, est_m, avg, lr, momentum, *, nesterov, has_mom):
+    """Eager-overlap landing: true step from the pre-round masters/bufs
+    (donated) corrected against the estimated masters (donated — the live
+    plane rebinds to the returned true arrays)."""
+    new_m, new_b, _ = _nesterov_step(
+        masters, bufs, avg, lr, momentum, nesterov, has_mom
+    )
+    delta = [t - e for t, e in zip(new_m, est_m)]
+    return new_m, new_b, delta
+
+
+@functools.partial(jax.jit, donate_argnums=(1,))
+def _overwrite_fused(masters, params):
+    # params <- master. The add-zero is load-bearing: a bare passthrough
+    # would let jax forward the master arrays themselves as outputs, and
+    # the caller binds these as train-state leaves that the next
+    # train_step DONATES — which would delete the live masters.
+    return [m + jnp.zeros((), m.dtype) for m in masters]
+
+
+@jax.jit
+def _copy_fused(leaves):
+    # fresh buffers (see _overwrite_fused for why the add-zero matters)
+    return [x.astype(jnp.float32) + jnp.zeros((), jnp.float32) for x in leaves]
+
+
+def _own(x: np.ndarray) -> np.ndarray:
+    """Force a host array to own its memory. On the CPU backend
+    ``device_get`` returns zero-copy views of the device buffer; a later
+    donation deletes that buffer under the view."""
+    if x.dtype != np.float32:
+        return x.astype(np.float32)
+    if x.base is not None or not x.flags.c_contiguous:
+        return np.array(x, np.float32)
+    return x
+
+
+def _host_f32(x: np.ndarray) -> np.ndarray:
+    """Widen a fetched wire array to f32 WITHOUT forcing ownership: a
+    ``device_get`` view's base keeps its device buffer alive, so the copy
+    is only needed when that buffer is later donated (see pseudo_grad for
+    the one aliasing case that must use ``_own``). At model scale the
+    skipped copy is a full extra memory pass per boundary."""
+    return x if x.dtype == np.float32 else x.astype(np.float32)
+
+
+@functools.lru_cache(maxsize=None)
+def _device_put_copies() -> bool:
+    """Whether ``device_put`` copies host numpy memory on this backend.
+    When it does (every current backend), ``_h2d`` can skip its defensive
+    pre-copy of pooled-buffer views — the put itself already yields an
+    independent device buffer; when a CPU jax zero-copy ALIASES instead,
+    the pre-copy is load-bearing (see ``_h2d``). Probed once at first
+    boundary, not assumed from version strings."""
+    a = np.zeros(8, np.float32)
+    d = jax.device_put(a)
+    jax.block_until_ready(d)
+    a[0] = 1.0
+    return float(d[0]) == 0.0
+
+
+class DeviceOuterPlane:
+    """Sharded device master + momentum and the fused outer-boundary ops."""
+
+    def __init__(
+        self,
+        trainer,
+        param_leaves: Sequence[jax.Array],
+        *,
+        lr: float,
+        momentum: float,
+        nesterov: bool,
+        compression: str = "none",
+    ):
+        self.lr = float(lr)
+        self.momentum = float(momentum)
+        self.nesterov = bool(nesterov)
+        wire = device_wire_dtype(compression)
+        self._wire_dtype = jnp.dtype(wire) if wire is not None else None
+        self.shardings = jax.tree.leaves(trainer.state_shardings["params"])
+        if len(self.shardings) != len(list(param_leaves)):
+            raise ValueError("param leaves / shardings mismatch")
+        self.lock = threading.RLock()
+        # fresh f32 device copies — the master never aliases live params
+        self.masters: list[jax.Array] = _copy_fused(list(param_leaves))
+        self.bufs: Optional[list[jax.Array]] = None
+
+    # -- helpers -----------------------------------------------------------
+
+    def _sel(self, leaves, frag: Optional[list[int]]):
+        if leaves is None:
+            return []
+        return list(leaves) if frag is None else [leaves[i] for i in frag]
+
+    def _put_back(self, attr: str, frag: Optional[list[int]], new: list) -> None:
+        cur = getattr(self, attr)
+        if frag is None:
+            setattr(self, attr, list(new))
+            return
+        merged = list(cur)
+        for j, i in enumerate(frag):
+            merged[i] = new[j]
+        setattr(self, attr, merged)
+
+    def _ensure_bufs(self) -> None:
+        if self.momentum != 0.0 and self.bufs is None:
+            # zeros for ALL leaves at the first armed step (OuterSGD
+            # semantics: untouched fragments keep their momentum frozen)
+            self.bufs = [
+                jax.device_put(np.zeros(m.shape, np.float32), s)
+                for m, s in zip(self.masters, self.shardings)
+            ]
+
+    def _h2d(self, host_leaves, frag: Optional[list[int]]) -> list[jax.Array]:
+        """Averaged pseudo-gradient H2D. all_reduce results are views into
+        pooled backend buffers the next call reclaims, so a zero-copy CPU
+        device_put (which would ALIAS them) needs a pre-copy; a copying
+        device_put already yields independent device memory and the
+        pre-copy would just double the H2D cost — probed, not assumed."""
+        sh = self._sel(self.shardings, frag)
+        if _device_put_copies():
+            return [
+                jax.device_put(np.asarray(a, dtype=np.float32), s)
+                for a, s in zip(host_leaves, sh)
+            ]
+        return [
+            jax.device_put(np.array(a, dtype=np.float32), s)
+            for a, s in zip(host_leaves, sh)
+        ]
+
+    def _scalars(self):
+        return np.float32(self.lr), np.float32(self.momentum)
+
+    @property
+    def _has_mom(self) -> bool:
+        return self.momentum != 0.0
+
+    # -- boundary ops ------------------------------------------------------
+
+    def pseudo_grad(
+        self,
+        param_leaves: Sequence[jax.Array],
+        frag: Optional[list[int]] = None,
+        *,
+        with_norm: bool = False,
+        keep_device: bool = False,
+    ) -> tuple[list[np.ndarray], Optional[float], Optional[list[jax.Array]]]:
+        """(host f32 pseudo-gradient, ||pg|| or None, device f32 pg or None).
+
+        The D2H fetch moves wire-width bytes when the codec has a device
+        pre-cast (fp16); the host widens back to f32 for the backend. The
+        norm rides the same jit (one extra HBM reduction, only when the
+        tracer is armed) instead of a serial per-leaf host dot."""
+        with self.lock:
+            m = self._sel(self.masters, frag)
+            p = list(param_leaves)
+            if self._wire_dtype is not None:
+                pg32, wire, sq = _pg_wire(
+                    m, p, wire_dtype=self._wire_dtype,
+                    with_norm=with_norm, keep32=keep_device,
+                )
+            else:
+                pg32, sq = _pg_f32(m, p, with_norm=with_norm)
+                wire = pg32
+            fetched = jax.device_get(wire)
+        # the fetched views keep their device buffers alive, so no copy —
+        # EXCEPT the eager f32 case, where ``wire`` IS the kept-on-device
+        # pseudo-gradient that ``_estimate_fused`` will DONATE while the
+        # all-reduce thread is still reading the host views
+        aliased = keep_device and self._wire_dtype is None
+        host = [(_own(x) if aliased else _host_f32(x)) for x in fetched]
+        norm = float(np.sqrt(float(sq))) if with_norm else None
+        return host, norm, (pg32 if keep_device else None)
+
+    def apply_average(
+        self,
+        averaged: Sequence[np.ndarray],
+        frag: Optional[list[int]] = None,
+        sync: Optional[Sequence[jax.Array]] = None,
+    ) -> Optional[list[jax.Array]]:
+        """Blocking apply: H2D the averaged pseudo-gradient and run the
+        fused, donated Nesterov step; masters/momentum rebind in place
+        under the lock. With ``sync`` (the live param leaves), the
+        params <- master overwrite rides the SAME jit — the synced leaves'
+        old buffers are donated — and the merged fresh leaves are
+        returned, saving ``sync_params``'s extra full-model pass."""
+        with self.lock:
+            self._ensure_bufs()
+            avg = self._h2d(averaged, frag)
+            m = self._sel(self.masters, frag)
+            b = self._sel(self.bufs, frag)
+            lr, mom = self._scalars()
+            if sync is None:
+                new_m, new_b = _apply_fused(
+                    m, b, avg, lr, mom,
+                    nesterov=self.nesterov, has_mom=self._has_mom,
+                )
+                new_p = None
+            else:
+                p = self._sel(list(sync), frag)
+                new_m, new_b, new_p = _apply_sync_fused(
+                    m, b, avg, p, lr, mom,
+                    nesterov=self.nesterov, has_mom=self._has_mom,
+                )
+            self._put_back("masters", frag, new_m)
+            if self._has_mom:
+                self._put_back("bufs", frag, new_b)
+        if sync is None:
+            return None
+        if frag is None:
+            return list(new_p)
+        merged = list(sync)
+        for j, i in enumerate(frag):
+            merged[i] = new_p[j]
+        return merged
+
+    def copy_leaves(self, leaves: Sequence[jax.Array]) -> list[jax.Array]:
+        """Fresh f32 device copies (the overlap paths' boundary snapshot:
+        the live param buffers get donated by the next train_step)."""
+        return _copy_fused(list(leaves))
+
+    def estimate(
+        self, pg_dev: list[jax.Array], boundary: list[jax.Array]
+    ) -> list[jax.Array]:
+        """Eager-overlap launch: rebind the live masters/momentum to the
+        locally-estimated step (pre-round arrays stay untouched for the
+        landing correction) and return the device delta for the params.
+        Donates pg_dev and the boundary copy."""
+        with self.lock:
+            # no _ensure_bufs: the first armed round's pre-round bufs stay
+            # None (the jit zero-initializes), matching the host opt_snap
+            lr, mom = self._scalars()
+            est_m, est_b, delta = _estimate_fused(
+                self.masters, self.bufs or [], pg_dev, boundary, lr, mom,
+                nesterov=self.nesterov, has_mom=self._has_mom,
+            )
+            self.masters = est_m
+            if self._has_mom:
+                self.bufs = est_b
+            return delta
+
+    def land_delayed(
+        self,
+        pre_masters: list[jax.Array],
+        pre_bufs: Optional[list[jax.Array]],
+        boundary: list[jax.Array],
+        averaged: Sequence[np.ndarray],
+    ) -> list[jax.Array]:
+        """Delayed-overlap landing: fused true step + deferred boundary
+        rewrite. Donates the pre-round arrays and the boundary copy."""
+        with self.lock:
+            avg = self._h2d(averaged, None)
+            lr, mom = self._scalars()
+            new_m, new_b, delta = _land_delayed_fused(
+                pre_masters, pre_bufs or [], boundary, avg, lr, mom,
+                nesterov=self.nesterov, has_mom=self._has_mom,
+            )
+            self.masters = new_m
+            if self._has_mom:
+                self.bufs = new_b
+            return delta
+
+    def land_eager(
+        self,
+        pre_masters: list[jax.Array],
+        pre_bufs: Optional[list[jax.Array]],
+        averaged: Sequence[np.ndarray],
+    ) -> list[jax.Array]:
+        """Eager-overlap landing: true step from the pre-round arrays,
+        corrected against the live (estimated) masters. Donates both."""
+        with self.lock:
+            avg = self._h2d(averaged, None)
+            lr, mom = self._scalars()
+            new_m, new_b, delta = _land_eager_fused(
+                pre_masters, pre_bufs or [], self.masters, avg, lr, mom,
+                nesterov=self.nesterov, has_mom=self._has_mom,
+            )
+            self.masters = new_m
+            if self._has_mom:
+                self.bufs = new_b
+            return delta
+
+    def sync_params(
+        self,
+        param_leaves: Sequence[jax.Array],
+        frag: Optional[list[int]] = None,
+    ) -> list[jax.Array]:
+        """params <- master for the synced leaves (old param buffers are
+        donated); unsynced fragment leaves pass through live."""
+        with self.lock:
+            m = self._sel(self.masters, frag)
+            p = self._sel(list(param_leaves), frag)
+            fresh = _overwrite_fused(m, p)
+        if frag is None:
+            return list(fresh)
+        merged = list(param_leaves)
+        for j, i in enumerate(frag):
+            merged[i] = fresh[j]
+        return merged
+
+    # -- host boundary (serve / checkpoint / state averaging) --------------
+
+    def host_state(
+        self, refs: Optional[tuple] = None
+    ) -> tuple[list[np.ndarray], Optional[list[np.ndarray]]]:
+        """Lazily fetched host snapshot (f32 copies that own their memory).
+        Holding the lock for the whole fetch is the point: a donation
+        racing the device_get would read freed buffers. Pass an explicit
+        ``(masters, bufs)`` tuple to snapshot a pending round's pre-round
+        arrays (``bufs`` may be None there even when the live plane has
+        momentum — the round started before the first armed step)."""
+        with self.lock:
+            masters, bufs = refs if refs is not None else (self.masters, self.bufs)
+            m = jax.device_get(masters)
+            b = jax.device_get(bufs) if bufs else None
+        return [_own(x) for x in m], (None if b is None else [_own(x) for x in b])
+
+    def load(
+        self,
+        masters_np: Sequence[np.ndarray],
+        bufs_np: Optional[Sequence[np.ndarray]],
+        *,
+        lr: Optional[float] = None,
+        momentum: Optional[float] = None,
+        nesterov: Optional[bool] = None,
+    ) -> None:
+        """Adopt a host master/momentum state (checkpoint restore or peer
+        onboarding); optionally adopt the serialized optimizer scalars."""
+        with self.lock:
+            if lr is not None:
+                self.lr = float(lr)
+            if momentum is not None:
+                self.momentum = float(momentum)
+            if nesterov is not None:
+                self.nesterov = bool(nesterov)
+            self.masters = [
+                jax.device_put(np.array(m, dtype=np.float32), s)
+                for m, s in zip(masters_np, self.shardings)
+            ]
+            if bufs_np is None or self.momentum == 0.0:
+                self.bufs = None
+            else:
+                self.bufs = [
+                    jax.device_put(np.array(b, dtype=np.float32), s)
+                    for b, s in zip(bufs_np, self.shardings)
+                ]
+
+    def load_masters(self, masters_np: Sequence[np.ndarray]) -> None:
+        """Adopt averaged full-state masters (average_state_every leg);
+        momentum is untouched, matching the host path."""
+        with self.lock:
+            self.masters = [
+                jax.device_put(np.array(m, dtype=np.float32), s)
+                for m, s in zip(masters_np, self.shardings)
+            ]

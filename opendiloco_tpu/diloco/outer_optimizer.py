@@ -18,6 +18,8 @@ from typing import Optional
 
 import numpy as np
 
+from opendiloco_tpu import native
+
 
 class OuterSGD:
     def __init__(
@@ -55,6 +57,12 @@ class OuterSGD:
             self.bufs = [np.zeros_like(p) for p in params]
         for j, i in enumerate(idxs):
             p, g, buf = params[i], grads[j], self.bufs[i]
+            # fused OMP kernel: one pass over (p, g, buf) instead of the
+            # numpy body's two allocated temporaries (d and momentum*buf)
+            if native.outer_sgd_step(
+                p, g, buf, self.lr, self.momentum, self.nesterov
+            ):
+                continue
             np.multiply(buf, self.momentum, out=buf)
             buf += g
             if self.nesterov:

@@ -102,6 +102,14 @@ def get_lib() -> Optional[ctypes.CDLL]:
         ]
     except AttributeError:
         pass
+    try:  # version-5 kernels (fused outer SGD + sqnorm)
+        lib.odtp_outer_sgd_f32.argtypes = [
+            f32p, f32p, f32p, ctypes.c_float, ctypes.c_float, ctypes.c_int, st,
+        ]
+        lib.odtp_sqnorm_f32.argtypes = [f32p, st]
+        lib.odtp_sqnorm_f32.restype = ctypes.c_double
+    except AttributeError:
+        pass
     for fn in (lib.odtp_sendall, lib.odtp_recvall):
         fn.argtypes = [ctypes.c_int, ctypes.c_void_p, st]
         fn.restype = ctypes.c_int
@@ -596,3 +604,53 @@ def quantile_edges(flat: np.ndarray) -> np.ndarray:
     out = np.empty(257, np.float32)
     lib.odtp_quantile_edges(_f32p(flat), flat.size, _f32p(out))
     return out
+
+
+def outer_sgd_step(
+    p: np.ndarray,
+    g: np.ndarray,
+    buf: np.ndarray,
+    lr: float,
+    momentum: float,
+    nesterov: bool,
+) -> bool:
+    """Fused momentum outer-SGD update of one leaf, all in place:
+    ``buf = momentum*buf + g; p -= lr*(g + momentum*buf | buf)``.
+    Returns False when the native path can't run (no lib, stale .so, or a
+    non-contiguous/non-f32 in-place target) — caller keeps the numpy body.
+    ``p`` and ``buf`` must be written through, so unlike the codec wrappers
+    there is no ascontiguousarray coercion on them (a coerced copy would
+    discard the update)."""
+    lib = get_lib()
+    if (
+        not _has(lib, "odtp_outer_sgd_f32")
+        or p.dtype != np.float32
+        or buf.dtype != np.float32
+        or not p.flags.c_contiguous
+        or not buf.flags.c_contiguous
+        or g.shape != p.shape
+        or buf.shape != p.shape
+    ):
+        return False
+    g = np.ascontiguousarray(g, np.float32)
+    lib.odtp_outer_sgd_f32(
+        _f32p(p),
+        _f32p(g),
+        _f32p(buf),
+        ctypes.c_float(lr),
+        ctypes.c_float(momentum),
+        ctypes.c_int(1 if nesterov else 0),
+        p.size,
+    )
+    return True
+
+
+def sqnorm(a: np.ndarray) -> float:
+    """sum(a*a) with a double accumulator (one OMP reduction pass); the
+    pseudo_grad_norm gauge's per-leaf term."""
+    lib = get_lib()
+    a = np.ascontiguousarray(a, np.float32).reshape(-1)
+    if not _has(lib, "odtp_sqnorm_f32"):
+        v = a.astype(np.float64, copy=False)
+        return float(np.dot(v, v))
+    return float(lib.odtp_sqnorm_f32(_f32p(a), a.size))

@@ -182,6 +182,11 @@ def train(config: Config, backend: Optional[OuterBackend] = None) -> dict:
             batch_size=config.total_batch_size,
             world=world,
         )
+        log.info(
+            "outer data plane: placement=%s (requested %s)",
+            diloco_opt.placement,
+            config.diloco.outer_placement,
+        )
 
     # resume (ckpt_utils.py:23-45 + train_fsdp.py:313-344)
     start_step = 0

@@ -21,6 +21,7 @@ import jax
 import numpy as np
 
 from opendiloco_tpu.data.dataloader import get_dataloader
+from opendiloco_tpu.diloco.outer_device import DeviceOuterPlane
 from opendiloco_tpu.diloco.outer_optimizer import OuterSGD
 from opendiloco_tpu.models import hf_io
 from opendiloco_tpu.parallel.mesh import build_mesh
@@ -54,6 +55,14 @@ def main(argv=None) -> None:
     ap.add_argument("--lr", type=float, default=4e-4)
     ap.add_argument("--outer-lr", type=float, default=0.7)
     ap.add_argument("--outer-momentum", type=float, default=0.9)
+    ap.add_argument(
+        "--outer-placement",
+        choices=["auto", "host", "device"],
+        default="auto",
+        help="where the master + outer momentum live: host numpy (reference "
+        "semantics) or a device-resident plane with fused boundary ops "
+        "(auto = device on TPU)",
+    )
     ap.add_argument("--precision", default="bf16-mixed")
     ap.add_argument("--eval-interval", type=int, default=0)
     ap.add_argument("--eval-batches", type=int, default=8)
@@ -93,10 +102,29 @@ def main(argv=None) -> None:
     iters = [iter(l) for l in loaders]
     eval_iter = iters[0]
 
-    # host master copy + outer optimizer (get_offloaded_param parity)
-    flat0, treedef = jax.tree.flatten(jax.device_get(states[0]["params"]))
-    master = [np.array(x, np.float32) for x in flat0]
+    # outer plane: host master copy (get_offloaded_param parity) or the
+    # device-resident plane with fused boundary ops
+    placement = args.outer_placement
+    if placement == "auto":
+        dev0 = plan.mesh.devices.flat[0]
+        on_tpu = "tpu" in getattr(dev0, "device_kind", "").lower()
+        placement = "device" if on_tpu else "host"
+    log.info("outer data plane: placement=%s", placement)
+    _, treedef = jax.tree.flatten(states[0]["params"])
+    plane = None
+    master: list[np.ndarray] = []
     outer = OuterSGD(args.outer_lr, args.outer_momentum, nesterov=True)
+    if placement == "device":
+        plane = DeviceOuterPlane(
+            trainer,
+            jax.tree.leaves(states[0]["params"]),
+            lr=args.outer_lr,
+            momentum=args.outer_momentum,
+            nesterov=True,
+        )
+    else:
+        flat0 = jax.tree.leaves(jax.device_get(states[0]["params"]))
+        master = [np.array(x, np.float32) for x in flat0]
 
     for step in range(1, args.total_steps + 1):
         t0 = time.perf_counter()
@@ -109,21 +137,44 @@ def main(argv=None) -> None:
         if step % args.local_steps == 0:
             # pseudo-grad = master - worker params, averaged over workers
             # (train_diloco_torch.py:336-353: all_reduce(AVG) + outer step)
-            grads = None
-            for r in range(args.num_workers):
-                flat = [
-                    np.asarray(x, np.float32)
-                    for x in jax.tree.leaves(jax.device_get(states[r]["params"]))
-                ]
-                g = [m_ - f for m_, f in zip(master, flat)]
-                grads = g if grads is None else [a + b for a, b in zip(grads, g)]
-            grads = [g / args.num_workers for g in grads]
-            outer.step(master, grads)
-            new_params = jax.tree.unflatten(treedef, master)
-            for r in range(args.num_workers):
-                states[r]["params"] = jax.device_put(
-                    new_params, trainer.state_shardings["params"]
-                )
+            if plane is not None:
+                grads = None
+                for r in range(args.num_workers):
+                    g, _, _ = plane.pseudo_grad(
+                        jax.tree.leaves(states[r]["params"])
+                    )
+                    grads = (
+                        g if grads is None
+                        else [a + b for a, b in zip(grads, g)]
+                    )
+                grads = [g / args.num_workers for g in grads]
+                plane.apply_average(grads)  # fused device Nesterov step
+                for r in range(args.num_workers):
+                    leaves = plane.sync_params(
+                        jax.tree.leaves(states[r]["params"])
+                    )
+                    states[r]["params"] = jax.tree.unflatten(treedef, leaves)
+            else:
+                grads = None
+                for r in range(args.num_workers):
+                    flat = [
+                        np.asarray(x, np.float32)
+                        for x in jax.tree.leaves(
+                            jax.device_get(states[r]["params"])
+                        )
+                    ]
+                    g = [m_ - f for m_, f in zip(master, flat)]
+                    grads = (
+                        g if grads is None
+                        else [a + b for a, b in zip(grads, g)]
+                    )
+                grads = [g / args.num_workers for g in grads]
+                outer.step(master, grads)
+                new_params = jax.tree.unflatten(treedef, master)
+                for r in range(args.num_workers):
+                    states[r]["params"] = jax.device_put(
+                        new_params, trainer.state_shardings["params"]
+                    )
             log.info("outer step at %d (epoch %d)", step, step // args.local_steps)
         if step % 10 == 0 or step == 1:
             log.info(

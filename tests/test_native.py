@@ -274,3 +274,82 @@ def test_quantile_assign_matches_searchsorted_adversarial():
     ).astype(np.uint8)
     want[np.isnan(nanny)] = 0
     np.testing.assert_array_equal(got, want)
+
+
+def _numpy_outer_sgd(p, g, buf, lr, momentum, nesterov):
+    np.multiply(buf, momentum, out=buf)
+    buf += g
+    d = g + momentum * buf if nesterov else buf
+    p -= lr * d
+
+
+@pytest.mark.parametrize("nesterov", [True, False])
+def test_outer_sgd_step_matches_numpy(nesterov):
+    rng = np.random.default_rng(7)
+    p0 = rng.normal(scale=0.03, size=10_001).astype(np.float32)
+    g = rng.normal(scale=1e-3, size=10_001).astype(np.float32)
+    buf0 = rng.normal(scale=1e-3, size=10_001).astype(np.float32)
+    p_ref, buf_ref = p0.copy(), buf0.copy()
+    _numpy_outer_sgd(p_ref, g, buf_ref, 0.7, 0.9, nesterov)
+    p, buf = p0.copy(), buf0.copy()
+    if native.outer_sgd_step(p, g, buf, 0.7, 0.9, nesterov):
+        np.testing.assert_allclose(p, p_ref, rtol=1e-6, atol=1e-8)
+        np.testing.assert_allclose(buf, buf_ref, rtol=1e-6, atol=1e-8)
+    else:
+        # no toolchain / stale .so: the caller keeps the numpy body
+        np.testing.assert_array_equal(p, p0)
+        np.testing.assert_array_equal(buf, buf0)
+
+
+def test_outer_sgd_step_refuses_unwritable_targets():
+    """p and buf are written through in place: a shape/dtype/layout the
+    kernel would have to copy first must fall back (False), not corrupt."""
+    p = np.zeros(8, np.float32)
+    g = np.zeros(8, np.float32)
+    assert not native.outer_sgd_step(
+        np.zeros(8, np.float64), g, p.copy(), 0.7, 0.9, True
+    )
+    assert not native.outer_sgd_step(
+        np.zeros(16, np.float32)[::2], g, p.copy(), 0.7, 0.9, True
+    )
+    assert not native.outer_sgd_step(
+        p.copy(), np.zeros(4, np.float32), p.copy(), 0.7, 0.9, True
+    )
+
+
+def test_outer_sgd_in_optimizer_matches_pure_numpy():
+    """OuterSGD.step (which prefers the fused kernel) must equal the pure
+    numpy rule whether or not the kernel is available."""
+    from opendiloco_tpu.diloco.outer_optimizer import OuterSGD
+
+    rng = np.random.default_rng(11)
+    params = [rng.normal(scale=0.03, size=s).astype(np.float32) for s in (513, 2048)]
+    ref = [x.copy() for x in params]
+    opt = OuterSGD(0.7, 0.9, nesterov=True)
+    bufs = None
+    for _ in range(3):
+        grads = [
+            rng.normal(scale=1e-3, size=x.shape).astype(np.float32)
+            for x in params
+        ]
+        opt.step(params, [x.copy() for x in grads])
+        if bufs is None:
+            bufs = [np.zeros_like(x) for x in ref]
+        for x, g, b in zip(ref, grads, bufs):
+            _numpy_outer_sgd(x, g, b, 0.7, 0.9, True)
+    for a, b in zip(params, ref):
+        np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-8)
+    for a, b in zip(opt.bufs, bufs):
+        np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-8)
+
+
+def test_sqnorm_matches_numpy():
+    rng = np.random.default_rng(13)
+    for n in (0, 1, 1000, 10_001):
+        a = rng.normal(scale=0.1, size=n).astype(np.float32)
+        want = float(np.dot(a.astype(np.float64), a.astype(np.float64)))
+        assert native.sqnorm(a) == pytest.approx(want, rel=1e-12, abs=1e-30)
+    # 2-D input is flattened, not rejected
+    m = rng.normal(size=(37, 5)).astype(np.float32)
+    v = m.astype(np.float64).ravel()
+    assert native.sqnorm(m) == pytest.approx(float(np.dot(v, v)), rel=1e-12)
