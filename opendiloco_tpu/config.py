@@ -122,6 +122,14 @@ class DilocoConfig(BaseModel):
     # multihost meshes fall back to host with a warning.
     outer_placement: Literal["auto", "host", "device"] = "auto"
 
+    # bandwidth-aware adaptive outer transport (diloco/linkstate.py):
+    # capacity-proportional butterfly partitioning, BDP-derived
+    # striping/chunking, straggler hedging. True forces it on for this
+    # worker; False defers to the ODTP_LINK_ADAPT env switch (so a swarm
+    # can be flipped without touching configs). Off = bit-identical to the
+    # uniform butterfly.
+    link_adapt: bool = False
+
     @model_validator(mode="after")
     def _streaming_constraints(self):
         if self.streaming_fragments > 1:

@@ -40,7 +40,7 @@ from typing import Callable, Optional
 import numpy as np
 
 from opendiloco_tpu import native, obs
-from opendiloco_tpu.diloco import chaos
+from opendiloco_tpu.diloco import chaos, linkstate
 from opendiloco_tpu.diloco.wire import MAGIC, MAX_HEADER, WireError
 from opendiloco_tpu.utils.logger import get_text_logger
 
@@ -160,12 +160,22 @@ def egress_bucket() -> Optional[_TokenBucket]:
     """The process-wide egress bucket, rebuilt when the env knob changes
     (the bench sweeps several caps in one parent process). Shared with the
     asyncio RPC path: bytes that bypass the bulk plane (small frames, bulk
-    fallback) must drain the same budget or capped bench rows lie."""
+    fallback) must drain the same budget or capped bench rows lie.
+
+    The chaos plane's ``egress_bps`` folds into the same bucket (the lower
+    of the two caps binds): that is how a bench emulates a bandwidth-skewed
+    galaxy — every worker shares ODTP_BULK_BANDWIDTH_BPS, one worker's
+    ODTP_CHAOS tightens its own link."""
     global _rate_bucket, _rate_bps
     try:
         bps = float(os.environ.get("ODTP_BULK_BANDWIDTH_BPS", "0") or 0.0)
     except ValueError:
         bps = 0.0
+    cp = chaos.plane()
+    if cp is not None:
+        cbps = cp.egress_bps()
+        if cbps > 0:
+            bps = min(bps, cbps) if bps > 0 else cbps
     with _rate_lock:
         if bps != _rate_bps:
             _rate_bps = bps
@@ -261,14 +271,22 @@ def read_frame_sync(sock: socket.socket) -> tuple[str, dict, np.ndarray]:
 
 
 class _Session:
-    """Reassembly state for one striped frame."""
+    """Reassembly state for one striped frame.
 
-    __slots__ = ("views", "remaining", "failed")
+    ``done`` / ``inflight`` exist for hedged transfers: a stripe may arrive
+    twice (original + hedge copy, byte-identical), so completion is counted
+    per stripe index, and the buffer is only handed to the consumer once no
+    writer still holds a view into it."""
 
-    def __init__(self, views: list, remaining: int):
+    __slots__ = ("views", "remaining", "failed", "done", "inflight", "hedged")
+
+    def __init__(self, views: list, remaining: int, hedged: bool = False):
         self.views = views
         self.remaining = remaining
         self.failed = False
+        self.done: set[int] = set()
+        self.inflight = 0
+        self.hedged = hedged
 
 
 class BulkServer:
@@ -363,22 +381,49 @@ class BulkServer:
         deadline = time.monotonic() + _stripe_wait_s()
         with self._sess_cond:
             while sid not in self._sessions:
-                if sid in self._dead_sessions:  # tombstoned: fail fast
+                if sid in self._dead_sessions:  # tombstoned
+                    if header.get("hedge") and header.get("len") is not None:
+                        # late copy of a stripe whose sibling already
+                        # completed the session: the bytes are in flight on
+                        # this connection, so drain them to keep the stream
+                        # in sync instead of killing the pooled connection
+                        break
                     raise WireError(f"stripe {j} for finished session {sid}")
                 left = deadline - time.monotonic()
                 if left <= 0 or self._stop.is_set():
                     raise WireError(f"stripe {j} for unknown session {sid}")
                 self._sess_cond.wait(timeout=min(left, 1.0))
-            sess = self._sessions[sid]
+            sess = self._sessions.get(sid)
+            if sess is not None:
+                sess.inflight += 1
+        if sess is None:
+            n = int(header["len"])
+            scratch = _pool.get(n)
+            try:
+                if n:
+                    native.sock_recvall(conn, scratch)
+            finally:
+                _pool.release(scratch)
+            return
         try:
+            # duplicate arrivals (hedge + original) carry identical bytes,
+            # so receiving into the view unconditionally is benign; only
+            # the first arrival advances ``remaining``
             native.sock_recvall(conn, sess.views[j])
         except Exception:
             with self._sess_cond:
-                sess.failed = True
+                sess.inflight -= 1
+                if not sess.hedged:
+                    # a hedged sender may still deliver this stripe via its
+                    # hedge copy; don't poison the session on one bad leg
+                    sess.failed = True
                 self._sess_cond.notify_all()
             raise
         with self._sess_cond:
-            sess.remaining -= 1
+            sess.inflight -= 1
+            if j not in sess.done:
+                sess.done.add(j)
+                sess.remaining -= 1
             self._sess_cond.notify_all()
 
     def _assemble(self, conn: socket.socket, header: dict) -> np.ndarray:
@@ -391,7 +436,9 @@ class BulkServer:
         for ln in lens:
             offs.append(offs[-1] + ln)
         views = [payload[offs[i] : offs[i + 1]] for i in range(len(lens))]
-        sess = _Session(views, remaining=len(lens) - 1)
+        sess = _Session(
+            views, remaining=len(lens) - 1, hedged=bool(header.get("hedged"))
+        )
         with self._sess_cond:
             self._sessions[sid] = sess
             self._sess_cond.notify_all()
@@ -399,7 +446,12 @@ class BulkServer:
             native.sock_recvall(conn, views[0])
             deadline = time.monotonic() + _stripe_wait_s()
             with self._sess_cond:
-                while sess.remaining > 0 and not sess.failed:
+                # wait for every stripe AND for every writer to let go of
+                # its view: a slow duplicate writer must not scribble into
+                # the buffer after it is handed out (and pooled/reused)
+                while (
+                    sess.remaining > 0 or sess.inflight > 0
+                ) and not sess.failed:
                     left = deadline - time.monotonic()
                     if left <= 0 or self._stop.is_set():
                         raise WireError(f"striped frame {sid} timed out")
@@ -444,6 +496,38 @@ class BulkSender:
         self._locks: dict[tuple, threading.Lock] = {}
         self._meta_lock = threading.Lock()
         self._id = uuid.uuid4().hex[:12]
+        # per-destination link estimates (bps, rtt_s) fed by the adaptive
+        # layer (tcp.py) and a multiplicative stripe-count backoff applied
+        # on top of the BDP plan when a striped send fails
+        self._links: dict[tuple, tuple[float, float]] = {}
+        self._stripe_scale: dict[tuple, float] = {}
+
+    def set_link(self, host: str, port: int, bps: float, rtt_s: float) -> None:
+        """Record the current link estimate toward one destination; used to
+        derive stripe counts from bandwidth-delay product when
+        ODTP_LINK_ADAPT is on."""
+        with self._meta_lock:
+            self._links[(host, port)] = (float(bps), float(rtt_s))
+
+    def _plan_streams(self, key: tuple, nbytes: int) -> int:
+        # a hint only exists when the owning backend runs adaptive (config
+        # kwarg or ODTP_LINK_ADAPT) — its presence is the gate
+        with self._meta_lock:
+            hint = self._links.get(key)
+            scale = self._stripe_scale.get(key, 1.0)
+        if hint is not None and hint[0] > 0:
+            streams = linkstate.stripes_for(nbytes, hint[0], hint[1])
+        else:
+            streams = _num_streams()
+        return max(1, int(streams * scale))
+
+    def _scale_stripes(self, key: tuple, ok: bool) -> None:
+        """Multiplicative backoff on striped-send failure, slow recovery on
+        success (halve / grow 25%, clamped to [1/8, 1])."""
+        with self._meta_lock:
+            s = self._stripe_scale.get(key, 1.0)
+            s = min(1.0, s * 1.25) if ok else max(0.125, s * 0.5)
+            self._stripe_scale[key] = s
 
     def _connect(self, host: str, port: int) -> socket.socket:
         sock = socket.create_connection((host, port), timeout=self._timeout)
@@ -484,12 +568,12 @@ class BulkSender:
             nbytes = (
                 payload.nbytes if isinstance(payload, np.ndarray) else len(payload)
             )
-            streams = _num_streams()
+            streams = self._plan_streams(key, nbytes)
             striped = streams > 1 and nbytes >= max(_stripe_min(), streams)
             cp = chaos.plane()
             for attempt in (0, 1):
                 if cp is not None:
-                    d = cp.delay_s("bulk_send")
+                    d = cp.delay_s("bulk_send") + cp.straggle_s()
                     if d:
                         time.sleep(d)
                     if cp.drop_conn("bulk_send"):
@@ -502,6 +586,7 @@ class BulkSender:
                 try:
                     if striped:
                         self._send_striped(key, msg, meta, payload, streams)
+                        self._scale_stripes(key, ok=True)
                     else:
                         sock = self._get_conns(key, 1)[0]
                         send_frame_sync(sock, msg, meta, payload)
@@ -510,6 +595,8 @@ class BulkSender:
                 except (ConnectionError, OSError, WireError):
                     # stale pooled connections: reconnect once, then give up
                     self._drop(key)
+                    if striped:
+                        self._scale_stripes(key, ok=False)
                     if attempt == 1:
                         raise
         finally:
@@ -548,7 +635,12 @@ class BulkSender:
     ) -> None:
         """Pump ~equal contiguous slices over ``streams`` connections; the
         header (with the stripe table + session id) and slice 0 go on
-        connection 0, which also carries the single ack."""
+        connection 0, which also carries the single ack.
+
+        With a link estimate and ODTP_LINK_ADAPT on, the send is *hedged*:
+        a stripe still in flight past a deadline derived from the estimated
+        bandwidth/RTT is re-dispatched over an idle connection, first
+        arrival wins (the receiver dedups per stripe index)."""
         data = memoryview(payload).cast("B")
         n = len(data)
         conns = self._get_conns(key, streams)
@@ -557,6 +649,15 @@ class BulkSender:
         offs = [min(i * step, n) for i in range(streams + 1)]
         lens = [offs[i + 1] - offs[i] for i in range(streams)]
 
+        hedge_s = 0.0
+        with self._meta_lock:
+            hint = self._links.get(key)
+        if hint is not None and hint[0] > 0:
+            hedge_s = linkstate.hedge_deadline_s(
+                max(lens), hint[0], hint[1], streams
+            )
+        hedged = hedge_s > 0.0 and streams > 1
+
         header = json.dumps(
             {
                 "type": msg,
@@ -564,20 +665,24 @@ class BulkSender:
                 "payload_len": n,
                 "stripe_lens": lens,
                 "session": sid,
+                **({"hedged": 1} if hedged else {}),
             }
         ).encode()
         errors: list[BaseException] = []
+        done = [threading.Event() for _ in range(streams)]
 
         def pump(j: int) -> None:
             try:
                 sub = json.dumps(
-                    {"type": "_stripe", "session": sid, "stripe": j}
+                    {"type": "_stripe", "session": sid, "stripe": j,
+                     "len": lens[j]}
                 ).encode()
                 native.sock_sendall(conns[j], _HDR.pack(MAGIC, len(sub)) + sub)
                 if lens[j]:
                     _send_payload(conns[j], data[offs[j] : offs[j + 1]])
+                done[j].set()
             except BaseException as e:  # surfaced on the main thread
-                errors.append(e)
+                errors.append((j, e))
 
         threads = [
             threading.Thread(target=pump, args=(j,), daemon=True)
@@ -588,11 +693,80 @@ class BulkSender:
         native.sock_sendall(conns[0], _HDR.pack(MAGIC, len(header)) + header)
         if lens[0]:
             _send_payload(conns[0], data[offs[0] : offs[1]])
+        done[0].set()
+        if not hedged:
+            for t in threads:
+                t.join()
+            if errors:
+                raise errors[0][1]
+            self._await_ack(conns[0])
+            return
+        # hedged path: give laggards until the deadline, then re-send any
+        # stripe that has not completed (slow OR failed leg) over an idle
+        # pooled connection / a fresh dial. The ack still rides conn 0 and
+        # is the single source of truth for delivery.
+        deadline = time.monotonic() + hedge_s
         for t in threads:
-            t.join()
-        if errors:
-            raise errors[0]
+            t.join(max(0.0, deadline - time.monotonic()))
+        laggards = [j for j in range(1, streams) if not done[j].is_set()]
+        hedged_ok: set[int] = set()
+        for hedge_idx, j in enumerate(laggards):
+            try:
+                self._hedge_stripe(
+                    key, sid, j, data[offs[j] : offs[j + 1]],
+                    conns, streams + hedge_idx,
+                )
+                hedged_ok.add(j)
+                obs.count("bulk_stripe_hedges")
+            except Exception as e:
+                log.warning("stripe %d hedge to %s failed (%s)", j, key, e)
+        # a stripe whose original leg already errored AND whose hedge failed
+        # can never arrive -- fail fast instead of blocking on the ack
+        dead = [e for j, e in list(errors) if j not in hedged_ok]
+        if dead:
+            raise dead[0]
         self._await_ack(conns[0])
+        # bounded cleanup: original legs usually finish right behind the
+        # hedge; a leg wedged past that is a dead socket — drop the pool so
+        # the zombie writer errors out instead of corrupting a later frame
+        for t in threads:
+            t.join(5.0)
+        if any(t.is_alive() for t in threads):
+            log.warning("bulk stripes to %s wedged after hedge; dropping", key)
+            self._drop(key)
+        elif errors:
+            # ack arrived, so delivery completed via the hedge copies; the
+            # sockets behind the failed legs are still suspect for reuse
+            log.warning(
+                "bulk send to %s recovered via hedging (%d failed leg(s))",
+                key, len(errors),
+            )
+            self._drop(key)
+
+    def _hedge_stripe(
+        self,
+        key: tuple,
+        sid: str,
+        j: int,
+        view,
+        conns: list,
+        idle_idx: int,
+    ) -> None:
+        """Re-dispatch stripe ``j`` over the fastest idle connection: a
+        pooled connection beyond the active stripe set (already-warm TCP
+        window) when one exists, else a fresh dial that joins the pool."""
+        if idle_idx < len(conns):
+            sock = conns[idle_idx]
+        else:
+            sock = self._connect(*key)
+            conns.append(sock)
+        sub = json.dumps(
+            {"type": "_stripe", "session": sid, "stripe": j,
+             "len": len(view), "hedge": 1}
+        ).encode()
+        native.sock_sendall(sock, _HDR.pack(MAGIC, len(sub)) + sub)
+        if len(view):
+            _send_payload(sock, view)
 
     def _drop(self, key: tuple) -> None:
         for sock in self._conns.pop(key, []):
@@ -639,7 +813,7 @@ class BulkStream:
             raise WireError(f"bulk stream to {self._key} is broken")
         cp = chaos.plane()
         if cp is not None:
-            d = cp.delay_s("bulk_stream")
+            d = cp.delay_s("bulk_stream") + cp.straggle_s()
             if d:  # write-side latency on the pipelined chunk path
                 time.sleep(d)
         try:

@@ -29,6 +29,12 @@ Grammar — ``;``-separated ``key=value`` items:
 - ``straggle_ms=A..B``  extra latency for this process's outer contributions
                         (straggler throttling); scope with
                         ``straggle_worker=W`` + ``set_identity(W)``.
+- ``egress_bps=N``      cap this process's bulk/wire payload egress at N
+                        bytes/second (token bucket, same machinery as
+                        ``ODTP_BULK_BANDWIDTH_BPS``; when both are set the
+                        LOWER cap binds). This is how a bench emulates a
+                        bandwidth-skewed galaxy: give one worker's process
+                        a chaos spec with a lower cap than its peers.
 
 Design constraints:
 
@@ -111,6 +117,7 @@ def parse_spec(spec: str) -> dict:
         "blackout_s": 3.0,
         "straggle_ms": (0.0, 0.0),
         "straggle_worker": None,
+        "egress_bps": 0.0,
     }
     for item in filter(None, (s.strip() for s in spec.split(";"))):
         if "=" not in item:
@@ -142,6 +149,10 @@ def _parse_item(p: dict, k: str, v: str) -> None:
         p["blackout_s"] = float(v)
     elif k == "straggle_worker":
         p["straggle_worker"] = int(v.lstrip("wW"))
+    elif k == "egress_bps":
+        p["egress_bps"] = float(v)
+        if p["egress_bps"] < 0:
+            raise ChaosSpecError(f"egress_bps={v} must be >= 0")
     else:
         raise ChaosSpecError(f"unknown chaos spec key {k!r}")
 
@@ -229,6 +240,13 @@ class ChaosPlane:
         if d > 0.0:
             self._record("straggle", "outer_round", ms=round(d * 1000.0, 3))
         return d
+
+    def egress_bps(self) -> float:
+        """Emulated egress cap for this process (0 = none). Consumed by
+        bulk.egress_bucket(), which folds it into the shared token bucket
+        (lower of this and ODTP_BULK_BANDWIDTH_BPS binds) — so every
+        payload path that honors the env cap honors the chaos cap too."""
+        return float(self.params["egress_bps"])
 
     # -- schedules -----------------------------------------------------------
 

@@ -1026,6 +1026,12 @@ class DiLoCoOptimizer:
             out["expected_peers"] = int(health.get("expected", 0))
         if health.get("retries"):
             out["round_retries"] = int(health["retries"])
+        # adaptive-transport fields (tcp.py records them when armed): the
+        # plan hash and per-part shares of the butterfly this round ran on
+        if health.get("link_plan"):
+            out["link_plan"] = health["link_plan"]
+        if health.get("link_shares"):
+            out["link_shares"] = list(health["link_shares"])
         return out
 
     def _check_group_size(self, group_size: int) -> None:

@@ -36,6 +36,11 @@ class PeerInfo:
     host: str
     port: int
     last_seen: float = field(default_factory=time.monotonic)
+    # stored and replayed VERBATIM (the native daemon keeps the raw JSON the
+    # same way): workers ride extra keys on it — notably the adaptive
+    # transport's "links" vector (diloco/linkstate.py), which reaches every
+    # group member through the join_group reply's group snapshot. Daemons
+    # MUST NOT normalize or filter this dict.
     progress: Optional[dict] = None
     serves_state: bool = False
     # the worker's embedded rendezvous port (0 = none): lets the swarm

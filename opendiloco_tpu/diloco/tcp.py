@@ -33,12 +33,13 @@ from typing import Any, Callable, Optional
 import numpy as np
 
 from opendiloco_tpu import obs
-from opendiloco_tpu.diloco import chaos
+from opendiloco_tpu.diloco import chaos, linkstate
 from opendiloco_tpu.diloco.backend import AllReduceError, OuterBackend, PeerProgress
 from opendiloco_tpu.diloco.compression import Codec, chunk_bounds, get_codec
 from opendiloco_tpu.diloco.wire import (
     STREAM_LIMIT,
     WireError,
+    check_plan,
     chunk_fields,
     chunk_span,
     read_frame,
@@ -186,6 +187,7 @@ class TcpBackend(OuterBackend):
         matchmaking_time: float = 5.0,
         rpc_timeout: float = 30.0,
         expect_peers: int = 0,
+        link_adapt: Optional[bool] = None,
     ):
         if not initial_peers:
             raise ValueError("TcpBackend needs at least one rendezvous address")
@@ -223,6 +225,12 @@ class TcpBackend(OuterBackend):
         self.expect_peers = int(
             expect_peers or os.environ.get("ODTP_EXPECT_PEERS", 0) or 0
         )
+        # adaptive outer transport (diloco/linkstate.py): per-peer link
+        # telemetry + capacity-proportional butterfly partitioning. The
+        # kwarg (config) forces it on; None defers to ODTP_LINK_ADAPT,
+        # re-read per round so tests/benches can flip it on a live backend
+        self._link_adapt = link_adapt
+        self.links = linkstate.LinkEstimator(self._peer_id)
 
         # every worker is also a rendezvous node (hivemind's every-peer-is-
         # a-DHT-node property, train_fsdp.py:205-212): an embedded server,
@@ -333,6 +341,29 @@ class TcpBackend(OuterBackend):
     def rendezvous(self) -> tuple[str, int]:
         return self.rendezvous_list[self._rdv_idx]
 
+    def _adaptive(self) -> bool:
+        """Adaptive transport on? config kwarg wins, else the env switch."""
+        if self._link_adapt is not None:
+            return bool(self._link_adapt)
+        return linkstate.enabled()
+
+    def _progress_meta(self, progress: Optional[PeerProgress]) -> dict:
+        """The ``progress`` dict for a rendezvous announce. When adaptive,
+        this worker's link vector rides along: daemons store and replay
+        progress verbatim, so the join_group reply hands every group member
+        an identical snapshot of the galaxy's link matrix for free."""
+        prog = {
+            "epoch": progress.epoch if progress else 0,
+            "samples": progress.samples if progress else 0,
+            "samples_per_second": (
+                progress.samples_per_second if progress else 0.0
+            ),
+            "timestamp": progress.timestamp if progress else 0.0,
+        }
+        if self._adaptive():
+            prog["links"] = self.links.publish()
+        return prog
+
     def _identity_meta(self) -> dict:
         """The registration identity triple+1: what a daemon needs to
         (re-)register this worker. Shared by register/progress announces
@@ -432,18 +463,12 @@ class TcpBackend(OuterBackend):
         )
         self._note_peers(meta, source=addr)
         if self._own_progress is not None:
-            p = self._own_progress
             await request(
                 *addr,
                 "progress",
                 {
                     **self._register_meta(),
-                    "progress": {
-                        "epoch": p.epoch,
-                        "samples": p.samples,
-                        "samples_per_second": p.samples_per_second,
-                        "timestamp": p.timestamp,
-                    },
+                    "progress": self._progress_meta(self._own_progress),
                     "serves_state": self._state_provider is not None,
                 },
                 timeout=timeout,
@@ -638,6 +663,11 @@ class TcpBackend(OuterBackend):
                         self._gc_mailbox()
                         self._mailbox_cv.notify_all()
                     await send_frame(writer, "ok", {})
+                elif msg == "probe":
+                    # link micro-probe: empty payload = RTT sample, sized
+                    # payload = bandwidth sample (the frame read above
+                    # already drained it); the reply closes the timing
+                    await send_frame(writer, "ok", {})
                 elif msg == "bulk_hello":
                     await send_frame(
                         writer,
@@ -744,6 +774,74 @@ class TcpBackend(OuterBackend):
                     raise
         raise AssertionError("unreachable")
 
+    async def _probe_links(self, group: list[dict]) -> None:
+        """Seed link estimates for group peers this worker has never sent a
+        real part to: one empty probe frame for RTT, one sized probe
+        (ODTP_LINK_PROBE_BYTES) for a first goodput figure. Best-effort and
+        bounded — a failed or slow probe just leaves the peer unseeded (the
+        planner fills unknowns with the median known capacity)."""
+        pb = linkstate.probe_bytes()
+
+        async def probe_one(p: dict) -> None:
+            pid = p["peer_id"]
+            try:
+                t0 = time.monotonic()
+                await self._peer_request(
+                    p["host"], p["port"], "probe", {}, timeout=5.0
+                )
+                rtt = time.monotonic() - t0
+                self.links.observe_rtt(pid, rtt)
+                if pb > 0:
+                    blob = b"\x00" * pb
+                    t0 = time.monotonic()
+                    await self._peer_request(
+                        p["host"], p["port"], "probe", {}, blob, timeout=10.0
+                    )
+                    dt = max(time.monotonic() - t0 - rtt, 1e-6)
+                    self.links.seed(pid, pb / dt, rtt)
+            except Exception as e:
+                log.debug("link probe to %s failed: %s", pid, e)
+
+        targets = [
+            p
+            for p in group
+            if p["peer_id"] != self._peer_id
+            and self.links.needs_probe(p["peer_id"])
+        ]
+        if not targets:
+            return
+        try:
+            await asyncio.wait_for(
+                asyncio.gather(
+                    *(probe_one(p) for p in targets), return_exceptions=True
+                ),
+                timeout=3.0,
+            )
+        except asyncio.TimeoutError:
+            log.debug("link probe sweep timed out; continuing unseeded")
+
+    async def _announce_links(self) -> None:
+        """Post-round fire-and-forget progress announce carrying the fresh
+        link vector: the daemon's stored progress is replaced per announce,
+        so without this the estimates measured during round k would only
+        reach the galaxy when the trainer next reports progress."""
+        try:
+            _, meta, _ = await self._rdv_request(
+                "progress",
+                {
+                    **self._register_meta(),
+                    "progress": self._progress_meta(self._own_progress),
+                    "serves_state": self._state_provider is not None,
+                },
+                timeout=self.rpc_timeout,
+            )
+            for p in meta.get("peers", []):
+                self.links.merge_remote(
+                    p.get("peer_id", ""), (p.get("progress") or {}).get("links")
+                )
+        except Exception as e:
+            log.debug("links announce failed: %s", e)
+
     def _deliver_bulk(self, msg: str, meta: dict, payload) -> None:
         """Mailbox delivery from a bulk-server handler thread."""
         if msg not in ("push", "result"):
@@ -777,18 +875,21 @@ class TcpBackend(OuterBackend):
         return self._bulk_ports[key]
 
     async def _send_part(
-        self, host: str, port: int, msg: str, meta: dict, payload, *, timeout: float
+        self, host: str, port: int, msg: str, meta: dict, payload, *,
+        timeout: float, peer_id: Optional[str] = None,
     ) -> None:
         stage = self._obs_stage
         if stage is None:
             return await self._send_part_inner(
-                host, port, msg, meta, payload, timeout=timeout
+                host, port, msg, meta, payload, timeout=timeout,
+                peer_id=peer_id,
             )
         nbytes = payload.nbytes if hasattr(payload, "nbytes") else len(payload)
         t0 = time.perf_counter()
         try:
             return await self._send_part_inner(
-                host, port, msg, meta, payload, timeout=timeout
+                host, port, msg, meta, payload, timeout=timeout,
+                peer_id=peer_id,
             )
         finally:
             stage.add("wire_send", time.perf_counter() - t0)
@@ -797,14 +898,27 @@ class TcpBackend(OuterBackend):
                 tr.count("wire_tx_bytes", nbytes)
 
     async def _send_part_inner(
-        self, host: str, port: int, msg: str, meta: dict, payload, *, timeout: float
+        self, host: str, port: int, msg: str, meta: dict, payload, *,
+        timeout: float, peer_id: Optional[str] = None,
     ) -> None:
         """Route one butterfly frame: bulk plane for large payloads, asyncio
-        RPC otherwise (and as fallback)."""
+        RPC otherwise (and as fallback). With the adaptive layer on, the
+        wall-clock of every send feeds the per-peer goodput EWMA (the
+        timing wraps the whole transfer, chaos emulation included — an
+        emulated slow link measures slow, which is the point)."""
         nbytes = payload.nbytes if hasattr(payload, "nbytes") else len(payload)
+        adaptive = peer_id is not None and self._adaptive()
+        t_send = time.monotonic() if adaptive else 0.0
         if self._bulk_sender is not None and nbytes >= self._bulk_threshold:
             bulk_port = await self._bulk_port_of(host, port)
             if bulk_port:
+                if adaptive:
+                    bps = self.links.bps_to(peer_id)
+                    if bps:
+                        self._bulk_sender.set_link(
+                            host, bulk_port, bps,
+                            self.links.rtt_to(peer_id) or 0.0,
+                        )
                 try:
                     await self._loop.run_in_executor(
                         None,
@@ -812,6 +926,10 @@ class TcpBackend(OuterBackend):
                             host, bulk_port, msg, meta, payload
                         ),
                     )
+                    if adaptive:
+                        self.links.observe_send(
+                            peer_id, nbytes, time.monotonic() - t_send
+                        )
                     return
                 except Exception as e:
                     # forget the cached bulk port: the peer may have
@@ -832,10 +950,17 @@ class TcpBackend(OuterBackend):
         # under-report throughput, never flatter it
         from opendiloco_tpu.diloco.bulk import egress_bucket
 
+        cp = chaos.plane()
+        if cp is not None:
+            d = cp.straggle_s()
+            if d:  # the bulk plane applies straggle inside BulkSender.send
+                await asyncio.sleep(d)
         bucket = egress_bucket()
         if bucket is not None and nbytes:
             await self._loop.run_in_executor(None, bucket.acquire, nbytes)
         await self._peer_request(host, port, msg, meta, payload, timeout=timeout)
+        if adaptive:
+            self.links.observe_send(peer_id, nbytes, time.monotonic() - t_send)
 
     def _close_conn_pool(self) -> None:
         for _, writer in self._conn_pool.values():
@@ -917,12 +1042,7 @@ class TcpBackend(OuterBackend):
                     "progress",
                     {
                         **self._register_meta(),
-                        "progress": {
-                            "epoch": progress.epoch,
-                            "samples": progress.samples,
-                            "samples_per_second": progress.samples_per_second,
-                            "timestamp": progress.timestamp,
-                        },
+                        "progress": self._progress_meta(progress),
                         "serves_state": self._state_provider is not None,
                     },
                     timeout=self.rpc_timeout,
@@ -936,6 +1056,7 @@ class TcpBackend(OuterBackend):
         cache = []
         for p in meta.get("peers", []):
             prog = p.get("progress") or {}
+            self.links.merge_remote(p.get("peer_id", ""), prog.get("links"))
             cache.append(
                 PeerProgress(
                     peer_id=p["peer_id"],
@@ -984,12 +1105,14 @@ class TcpBackend(OuterBackend):
                 del self._free_bufs[min(self._free_bufs)]
 
     def _record_round_health(
-        self, join_key: str, n: int, expected: int, elastic: bool, timings: dict
+        self, join_key: str, n: int, expected: int, elastic: bool, timings: dict,
+        extra: Optional[dict] = None,
     ) -> None:
         """Append one row to the round health ledger (and keep the legacy
         ``last_round_timings`` view in sync). Solo and elastic rounds are
         recorded as data, not errors: the bench/soak layers read this
-        instead of inferring health from exceptions."""
+        instead of inferring health from exceptions. ``extra`` carries
+        adaptive-transport fields (link_plan, link_shares) when armed."""
         self.last_round_timings = timings
         health = {
             "round": join_key,
@@ -998,6 +1121,7 @@ class TcpBackend(OuterBackend):
             "elastic": elastic,
             "retries": self._round_attempt,
             **{k: round(v, 6) for k, v in timings.items()},
+            **(extra or {}),
         }
         cp = chaos.plane()
         if cp is not None:
@@ -1016,6 +1140,14 @@ class TcpBackend(OuterBackend):
             if self._round_attempt:
                 tr.count("outer_round_retries", self._round_attempt)
             tr.gauge("outer_group_size", n)
+            if extra and "link_shares" in extra:
+                tr.count("outer_rounds_adaptive")
+                own = self.links.publish().get("peers", {})
+                for pid, vec in own.items():
+                    if vec.get("bps"):
+                        tr.gauge("link_bps", vec["bps"], peer=pid)
+                    if vec.get("rtt_ms"):
+                        tr.gauge("link_rtt_ms", vec["rtt_ms"], peer=pid)
 
     def all_reduce(
         self, arrays, *, timeout=None, tag: str = "grads", epoch=None, group_cap=0
@@ -1171,6 +1303,15 @@ class TcpBackend(OuterBackend):
                 round=join_key, group=n,
             )
 
+        # adaptive partitioning: probe never-measured links, then plan part
+        # bounds from the group snapshot every member received identically.
+        # Planning is pure and snapshot-only, so every member computes the
+        # same bounds; the plan hash on every frame makes that assumption
+        # load-bearing instead of hopeful.
+        adaptive = self._adaptive()
+        if adaptive and n > 1:
+            await self._probe_links(group)
+
         # 2. flatten + split into n parts (by element count). Contiguous-f32
         # leaves flatten as views; a single leaf needs no copy at all (the
         # copy cost matters: the host core also feeds the sockets)
@@ -1187,7 +1328,17 @@ class TcpBackend(OuterBackend):
             flat = self._checkout_buf(sum(f.size for f in flats))
             scratch.append(flat)
             np.concatenate(flats, out=flat)
-        bounds = np.linspace(0, flat.size, n + 1).astype(np.int64)
+        bounds = linkstate.plan_bounds(flat.size, group) if adaptive else None
+        plan_meta: dict = {}
+        health_extra: Optional[dict] = None
+        if bounds is None:
+            bounds = np.linspace(0, flat.size, n + 1).astype(np.int64)
+        if adaptive:
+            plan_meta = {"plan": linkstate.plan_hash(bounds)}
+            health_extra = {
+                "link_plan": plan_meta["plan"],
+                "link_shares": linkstate.shares_of(bounds, flat.size),
+            }
         parts = [flat[bounds[j] : bounds[j + 1]] for j in range(n)]
         timings["flatten_s"] = time.monotonic() - t_ph
         if tr is not None:
@@ -1210,7 +1361,7 @@ class TcpBackend(OuterBackend):
         )
         flat_avg = await exchange(
             group, my_idx, n, parts, bounds, flat.size, round_key, deadline,
-            scratch, timings,
+            scratch, timings, plan_meta,
         )
         stage = self._obs_stage
         if stage is not None:
@@ -1221,7 +1372,14 @@ class TcpBackend(OuterBackend):
                 timings[f"{name}_s"] = round(
                     timings.get(f"{name}_s", 0.0) + secs, 6
                 )
-        self._record_round_health(join_key, n, expected, elastic, timings)
+        self._record_round_health(
+            join_key, n, expected, elastic, timings, extra=health_extra
+        )
+        if adaptive:
+            # fresh estimates from this round's transfers reach the daemon
+            # (and therefore the next round's group snapshot) without
+            # waiting for the trainer's next progress report
+            asyncio.ensure_future(self._announce_links())
 
         # 6. hand back per-array views of the reassembled buffer
         out, off = [], 0
@@ -1232,9 +1390,17 @@ class TcpBackend(OuterBackend):
 
     async def _exchange_serial(
         self, group, my_idx, n, parts, bounds, flat_size, round_key, deadline,
-        scratch, timings,
+        scratch, timings, plan_meta=None,
     ):
-        """Whole-part exchange: each butterfly frame carries a full part."""
+        """Whole-part exchange: each butterfly frame carries a full part.
+
+        Accumulation folds contributions in strict GROUP ORDER (not
+        own-part-first): per-element addition order is then a property of
+        the group, not of which peer owns the part, so re-partitioning the
+        butterfly (adaptive bounds) cannot perturb the float sum — the
+        bit-parity the adaptive layer's off/on parity test relies on."""
+        plan_meta = plan_meta or {}
+        my_plan = plan_meta.get("plan")
         stage = self._obs_stage
         codec = self.codec
         encode = stage.timed("encode", codec.encode) if stage else codec.encode
@@ -1261,29 +1427,43 @@ class TcpBackend(OuterBackend):
                     "from": self._peer_id,
                     "meta": cmeta,
                     "shape": [int(parts[j].size)],
+                    **plan_meta,
                 },
                 payload,
                 timeout=max(5.0, deadline - time.monotonic()),
+                peer_id=group[j]["peer_id"],
             )
 
         pushes = [push(j) for j in range(n) if j != my_idx]
 
         # 4. collect everyone's contribution for my part (fused
-        # decode+accumulate; native single-pass kernels when built)
+        # decode+accumulate; native single-pass kernels when built), folded
+        # in group order: the first contributor lands via copy/decode-into,
+        # every later one accumulates
         async def collect():
             from opendiloco_tpu import native as _native
             from opendiloco_tpu.diloco.bulk import release_buffer
 
             acc = self._checkout_buf(parts[my_idx].size)
             scratch.append(acc)
-            np.copyto(acc, parts[my_idx])
+            first = True
             for p in group:
                 if p["peer_id"] == self._peer_id:
+                    if first:
+                        np.copyto(acc, parts[my_idx])
+                    else:
+                        _native.add_inplace(acc, parts[my_idx])
+                    first = False
                     continue
                 pmeta, payload = await self._wait_mailbox(
                     (round_key, "push", p["peer_id"]), deadline
                 )
-                dec_acc(payload, pmeta["meta"], acc)
+                check_plan(pmeta, my_plan)
+                if first:
+                    dec_into(payload, pmeta["meta"], acc)
+                else:
+                    dec_acc(payload, pmeta["meta"], acc)
+                first = False
                 # fully folded into acc: recycle bulk-plane receive buffers
                 # so steady-state rounds stop allocating (no-op for asyncio
                 # bytes payloads)
@@ -1323,9 +1503,11 @@ class TcpBackend(OuterBackend):
                     "from": self._peer_id,
                     "meta": result_cmeta,
                     "shape": [int(my_avg.size)],
+                    **plan_meta,
                 },
                 result_payload,
                 timeout=max(5.0, deadline - time.monotonic()),
+                peer_id=group[j]["peer_id"],
             )
 
         # the result buffer outlives this round (the caller gets views of
@@ -1352,6 +1534,7 @@ class TcpBackend(OuterBackend):
                 rmeta, payload = await self._wait_mailbox(
                     (round_key, "result", j), deadline
                 )
+                check_plan(rmeta, my_plan)
                 dst = flat_avg[bounds[j] : bounds[j + 1]]
                 if int(rmeta["shape"][0]) != dst.size:
                     raise WireError(
@@ -1419,6 +1602,10 @@ class TcpBackend(OuterBackend):
                     await loop.run_in_executor(
                         None, state["stream"].send, msg, meta, payload
                     )
+                    if self._adaptive() and dest.get("peer_id"):
+                        self.links.observe_send(
+                            dest["peer_id"], nbytes, time.perf_counter() - t0
+                        )
                     if stage is not None:
                         stage.add("wire_send", time.perf_counter() - t0)
                         tr = obs.tracer()
@@ -1437,6 +1624,7 @@ class TcpBackend(OuterBackend):
             await self._send_part(
                 dest["host"], dest["port"], msg, meta, payload,
                 timeout=max(5.0, deadline - time.monotonic()),
+                peer_id=dest.get("peer_id"),
             )
 
         async def close() -> None:
@@ -1455,7 +1643,7 @@ class TcpBackend(OuterBackend):
 
     async def _exchange_pipelined(
         self, group, my_idx, n, parts, bounds, flat_size, round_key, deadline,
-        scratch, timings,
+        scratch, timings, plan_meta=None,
     ):
         """Chunk-pipelined exchange: every part travels as fixed-size chunk
         frames, with codec work off the event loop (native kernels release
@@ -1471,6 +1659,9 @@ class TcpBackend(OuterBackend):
         from opendiloco_tpu import native as _native
         from opendiloco_tpu.diloco.bulk import release_buffer
 
+        plan_meta = plan_meta or {}
+        my_plan = plan_meta.get("plan")
+        adaptive = self._adaptive()
         loop = self._loop
         chunk_elems = _pipeline_chunk_elems()
         align = getattr(self.codec, "chunk_align", 1)
@@ -1497,11 +1688,22 @@ class TcpBackend(OuterBackend):
             else codec.decode_into
         )
 
-        # 3. push part j to its owner, chunk by chunk
+        # 3. push part j to its owner, chunk by chunk. With a link estimate
+        # for the destination, the chunk size follows its BDP (whole-part
+        # codec prescan keeps chunked encodes grid-independent, so per-dest
+        # grids cannot perturb the bytes a receiver decodes)
         async def push(j):
             part = parts[j]
+            ce = chunk_elems
+            if adaptive:
+                pid = group[j]["peer_id"]
+                bps = self.links.bps_to(pid)
+                if bps:
+                    ce = linkstate.chunk_elems_for(
+                        bps, self.links.rtt_to(pid) or 0.0, chunk_elems
+                    )
             state = await loop.run_in_executor(None, chunk_state_fn, part)
-            grid = chunk_bounds(part.size, chunk_elems, align)
+            grid = chunk_bounds(part.size, ce, align)
             nchunks = len(grid) - 1
 
             def enc(k):
@@ -1521,6 +1723,7 @@ class TcpBackend(OuterBackend):
                             "from": self._peer_id,
                             "meta": cmeta,
                             "shape": [int(part.size)],
+                            **plan_meta,
                             **chunk_fields(
                                 k, nchunks, grid[k], grid[k + 1] - grid[k]
                             ),
@@ -1530,30 +1733,42 @@ class TcpBackend(OuterBackend):
             finally:
                 await close()
 
-        # 4. fold incoming chunks into my accumulator as they decode
+        # 4. fold incoming chunks into my accumulator as they decode, peers
+        # in group order with chunks in offset order (the serial path's
+        # exact per-element addition order; see _exchange_serial on why
+        # group order — not own-part-first — is what keeps adaptive
+        # re-partitioning bit-transparent)
         async def collect():
             acc = self._checkout_buf(parts[my_idx].size)
             scratch.append(acc)
-            np.copyto(acc, parts[my_idx])
+            first = True
             for p in group:
                 if p["peer_id"] == self._peer_id:
+                    if first:
+                        np.copyto(acc, parts[my_idx])
+                    else:
+                        _native.add_inplace(acc, parts[my_idx])
+                    first = False
                     continue
+                fold = dec_into if first else dec_acc
                 k, nchunks = 0, 1
                 while k < nchunks:
                     pmeta, payload = await self._wait_mailbox(
                         (round_key, "push", p["peer_id"], k), deadline
                     )
+                    check_plan(pmeta, my_plan)
                     nchunks = int(pmeta.get("nchunks", 1))
                     coff, clen = chunk_span(pmeta, acc.size)
                     await loop.run_in_executor(
                         None,
-                        dec_acc,
+                        fold,
                         payload,
                         pmeta["meta"],
                         acc[coff : coff + clen],
                     )
                     release_buffer(payload)
                     k += 1
+                first = False
             _native.scale_inplace(acc, 1.0 / n)
             return acc
 
@@ -1609,6 +1824,7 @@ class TcpBackend(OuterBackend):
                             "from": self._peer_id,
                             "meta": cmeta,
                             "shape": [int(my_avg.size)],
+                            **plan_meta,
                             **chunk_fields(
                                 k, nchunks, grid[k], grid[k + 1] - grid[k]
                             ),
@@ -1637,6 +1853,7 @@ class TcpBackend(OuterBackend):
                 rmeta, payload = await self._wait_mailbox(
                     (round_key, "result", j, k), deadline
                 )
+                check_plan(rmeta, my_plan)
                 nchunks_j = int(rmeta.get("nchunks", 1))
                 if int(rmeta["shape"][0]) != dst_part.size:
                     raise WireError(

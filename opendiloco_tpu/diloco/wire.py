@@ -139,6 +139,22 @@ def chunk_span(meta: dict[str, Any], part_size: int) -> tuple[int, int]:
     return coff, clen
 
 
+def check_plan(meta: dict[str, Any], expected: Any) -> None:
+    """Fail a frame whose sender planned a different butterfly partition.
+
+    Adaptive-transport frames (diloco/linkstate.py) carry a ``plan`` hash
+    of the part-bounds vector. Both sides adaptive -> hashes must match or
+    the parts would silently misalign. A side not carrying/expecting a plan
+    skips the check: a mixed swarm always plans uniform (the planner
+    requires link vectors from EVERY member), so frame shapes still agree
+    and the existing shape/size validation covers the rest."""
+    got = meta.get("plan")
+    if got is not None and expected is not None and got != expected:
+        raise WireError(
+            f"partition plan mismatch: peer planned {got}, local {expected}"
+        )
+
+
 # -- multi-tensor payload packing -------------------------------------------
 
 

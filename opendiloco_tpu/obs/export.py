@@ -109,6 +109,20 @@ def _label_pairs(labels: Iterable) -> str:
     return "{" + ",".join(parts) + "}" if parts else ""
 
 
+# curated help strings for metrics whose meaning isn't obvious from the
+# name; everything else gets the generic family line
+_HELP = {
+    "odtp_link_bps": "EWMA goodput toward labelled peer, bytes/second "
+    "(adaptive outer transport, diloco/linkstate.py)",
+    "odtp_link_rtt_ms": "EWMA round-trip time toward labelled peer, ms "
+    "(adaptive outer transport)",
+    "odtp_outer_rounds_adaptive": "outer rounds run with adaptive "
+    "(link-proportional) butterfly partitioning",
+    "odtp_bulk_stripe_hedges": "lagging bulk stripes re-dispatched over an "
+    "idle connection (straggler hedging)",
+}
+
+
 def _render_family(
     out: list, metrics: dict, kind: str
 ) -> None:
@@ -116,7 +130,8 @@ def _render_family(
     for (name, labels), value in metrics.items():
         by_name.setdefault(_metric_name(name), []).append((labels, value))
     for name in sorted(by_name):
-        out.append(f"# HELP {name} opendiloco_tpu obs {kind}")
+        help_txt = _HELP.get(name, f"opendiloco_tpu obs {kind}")
+        out.append(f"# HELP {name} {help_txt}")
         out.append(f"# TYPE {name} {kind}")
         for labels, value in sorted(by_name[name], key=str):
             out.append(f"{name}{_label_pairs(labels)} {float(value)}")

@@ -55,6 +55,9 @@ def make_backend(cfg: DilocoConfig) -> OuterBackend:
             peer_id=f"worker-{cfg.world_rank}",
             compression=cfg.compression,
             matchmaking_time=cfg.matchmaking_time,
+            # config True forces adaptive transport on; False defers to the
+            # ODTP_LINK_ADAPT env switch (None = backend reads env per round)
+            link_adapt=cfg.link_adapt or None,
         )
     raise ValueError(
         f"backend {cfg.backend!r} has no factory (loopback backends are "

@@ -45,6 +45,12 @@ _OUT = os.environ.get("ODTP_OUTER_BENCH_OUT") or os.path.join(
 _BOUNDARY_OUT = os.environ.get("ODTP_BOUNDARY_BENCH_OUT") or os.path.join(
     REPO, "BOUNDARY_BENCH.json"
 )
+# --hetero mode banks here: uniform-vs-adaptive medians on a bandwidth-skewed
+# galaxy, the artifact the adaptive link layer (ODTP_LINK_ADAPT) is judged
+# against
+_HETERO_OUT = os.environ.get("ODTP_HETERO_BENCH_OUT") or os.path.join(
+    REPO, "HETERO_BENCH.json"
+)
 
 
 def expected_group(peers: int, group_cap: int) -> int:
@@ -56,7 +62,17 @@ def expected_group(peers: int, group_cap: int) -> int:
 
 def make_leaves(model: str, rank: int):
     """Model-shaped fp32 leaves, generated directly in fp32 (a float64
-    intermediate at 1b scale costs 8 GB and minutes on one core)."""
+    intermediate at 1b scale costs 8 GB and minutes on one core).
+
+    ``tiny:N`` is a synthetic model: one flat N-megabyte fp32 leaf, no jax
+    or model-config import — the hetero/CI benches measure the wire plane,
+    not leaf assembly, and worker startup should stay milliseconds."""
+    if model.startswith("tiny:"):
+        mb = float(model.split(":", 1)[1])
+        rng = np.random.default_rng(rank)
+        a = rng.standard_normal(max(1, int(mb * 1e6) // 4), dtype=np.float32)
+        a *= 1e-3
+        return [a]
     from opendiloco_tpu.models.hf_io import load_config
     from opendiloco_tpu.models.llama import shapes
     import jax
@@ -261,6 +277,7 @@ def worker_main() -> None:
         k: (round(v, 3) if isinstance(v, float) else v)
         for k, v in getattr(backend, "last_round_timings", {}).items()
     }
+    lrh = dict(getattr(backend, "last_round_health", {}) or {})
     backend.close()
     retries = ctr("bench_retries")
     if args.rank == 0:
@@ -287,6 +304,11 @@ def worker_main() -> None:
         "elastic_rounds": ctr("bench_elastic_rounds"),
         "retries": retries,
     }
+    # adaptive-transport fields, when the last round planned adaptively:
+    # the hetero bench asserts on these (bytes shifted off the slow link)
+    for k in ("link_plan", "link_shares"):
+        if lrh.get(k) is not None:
+            health[k] = lrh[k]
     faults = {
         dict(labels).get("kind", "?"): int(v)
         for (name, labels), v in snap["counters"].items()
@@ -502,6 +524,169 @@ def _parse_bandwidth(spec: str) -> float:
     return float(s or 0)
 
 
+def _hetero_sweep(
+    args, server, cap_bps: float, skew: float, adapt: bool, warm: int,
+    rounds: int, base_env: dict,
+) -> tuple:
+    """One uniform-or-adaptive pass over the skewed galaxy. Every worker's
+    egress is token-bucketed at ``cap_bps``; worker 0 is additionally capped
+    at ``cap_bps / skew`` through the chaos plane (the LOWER cap binds), so
+    the galaxy has one 4:1-slow link without any kernel-level shaping.
+    Returns (per-round seconds AFTER the ``warm`` learning rounds,
+    rank-0 HEALTH dict)."""
+    nbytes = sum(a.nbytes for a in make_leaves(args.model, 0))
+    round_timeout = max(60.0, 20.0 * nbytes * 2 / (cap_bps / skew))
+    procs = []
+    for i in range(args.peers):
+        env = dict(base_env)
+        env["ODTP_BULK_BANDWIDTH_BPS"] = str(int(cap_bps))
+        env["ODTP_LINK_ADAPT"] = "1" if adapt else "0"
+        if i == 0:
+            env["ODTP_CHAOS"] = f"egress_bps={int(cap_bps / skew)}"
+        procs.append(subprocess.Popen(
+            [
+                sys.executable, os.path.abspath(__file__), "--worker",
+                "--rendezvous", server.address, "--rank", str(i),
+                "--model", args.model, "--compression", "none",
+                "--rounds", str(warm + rounds),
+                "--peers", str(args.peers),
+                "--timeout", str(round_timeout),
+                "--sweep-start", str(time.time()),
+                "--group-cap", "0", "--pipeline", "1",
+            ],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env,
+        ))
+    proc_timeout = (warm + rounds + 2) * round_timeout + 120.0
+    try:
+        outs = [p.communicate(timeout=proc_timeout)[0] for p in procs]
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        for p in procs:
+            try:
+                p.communicate(timeout=10)
+            except Exception:
+                pass
+        raise SystemExit(f"hetero sweep (adapt={adapt}) timed out")
+    if any(p.returncode for p in procs):
+        detail = [" | ".join(o.splitlines()[-3:])[-400:] for o in outs]
+        raise SystemExit(
+            f"hetero sweep (adapt={adapt}) worker failure: {detail}"
+        )
+    line = next(
+        l for o in outs for l in o.splitlines() if l.startswith("RESULT")
+    )
+    times = [float(x) for x in line.split()[1:] if "=" not in x]
+    health = next(
+        (
+            json.loads(l.split(None, 1)[1])
+            for o in outs for l in o.splitlines()
+            if l.startswith("HEALTH ") and '"rank": 0' in l
+        ),
+        {},
+    )
+    return times[warm:], health
+
+
+def hetero_main(args) -> None:
+    """Bandwidth-skewed galaxy A/B: the same chaos-emulated 4:1-slow link,
+    uniform butterfly vs adaptive (ODTP_LINK_ADAPT) partitioning. Banks
+    HETERO_BENCH.json with both medians and the speedup; exits nonzero if
+    the full run regresses below the 1.2x acceptance line.
+
+    The arithmetic the adaptive plan exploits: a slow worker's push-phase
+    egress (everyone else's parts) is irreducible, but its fan-back egress
+    is proportional to its OWN part — shrinking that part moves the
+    fan-back bytes onto fast links, cutting the slow worker's per-round
+    egress from 2*(1-1/n) to (1-s0) + (n-1)*s0 of the payload.
+    """
+    from opendiloco_tpu.diloco.rendezvous import RendezvousServer
+
+    skew = 4.0
+    if args.selftest:
+        args.peers, args.model, rounds, warm = 4, "tiny:8", 2, 1
+        cap_bps = 64e6
+        out_path = os.environ.get("ODTP_HETERO_BENCH_OUT") or os.path.join(
+            os.environ.get("TMPDIR", "/tmp"), "HETERO_BENCH.selftest.json"
+        )
+    else:
+        args.peers, args.model = 8, "tiny:32"
+        rounds, warm = max(args.rounds, 5), 2
+        # low enough that the emulated link time dominates the 1-core
+        # box's scheduler noise (at 128 MB/s the CPU-starvation wait is
+        # additive and similar for every worker, compressing the 4:1
+        # bandwidth ratio out of the per-transfer measurements)
+        cap_bps = 64e6
+        out_path = _HETERO_OUT
+    nbytes = sum(a.nbytes for a in make_leaves(args.model, 0))
+    print(
+        f"hetero bench: {args.peers} peers, {nbytes / 1e6:.0f} MB fp32, "
+        f"egress {cap_bps * 8 / 1e6:.0f} Mbps/worker, worker 0 at "
+        f"1/{skew:.0f} of that, {rounds} measured rounds "
+        f"(+{warm} learning)"
+    )
+    base_env = dict(os.environ)
+    base_env["PYTHONPATH"] = REPO + os.pathsep + base_env.get("PYTHONPATH", "")
+    base_env.setdefault("OPENDILOCO_TPU_PLATFORM", "cpu")
+
+    results = {}
+    server = RendezvousServer(host="127.0.0.1", port=0).start_in_thread()
+    try:
+        for adapt in (False, True):
+            mode = "adaptive" if adapt else "uniform"
+            times, health = _hetero_sweep(
+                args, server, cap_bps, skew, adapt, warm, rounds, base_env
+            )
+            results[mode] = {
+                "rounds_s": [round(t, 3) for t in times],
+                "median_s": round(statistics.median(times), 3),
+                "best_s": round(min(times), 3),
+                **(
+                    {"link_shares": health["link_shares"]}
+                    if "link_shares" in health else {}
+                ),
+            }
+            print(
+                f"{mode:>9}: median {results[mode]['median_s'] * 1e3:7.0f} "
+                f"ms/round  rounds {results[mode]['rounds_s']}"
+            )
+    finally:
+        server.stop()
+
+    speedup = round(
+        results["uniform"]["median_s"] / results["adaptive"]["median_s"], 3
+    )
+    doc = {
+        "peers": args.peers,
+        "model": args.model,
+        "mb_fp32": round(nbytes / 1e6),
+        "bandwidth_mbps": round(cap_bps * 8 / 1e6),
+        "skew": skew,
+        "selftest": bool(args.selftest),
+        "uniform": results["uniform"],
+        "adaptive": results["adaptive"],
+        "speedup": speedup,
+        "updated": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "host": {
+            "cores": os.cpu_count(), "loadavg": round(os.getloadavg()[0], 2)
+        },
+    }
+    with open(out_path, "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+        f.write("\n")
+    print(f"speedup {speedup:.2f}x (banked {out_path})")
+    shares = results["adaptive"].get("link_shares")
+    if shares and shares[0] >= 1.0 / args.peers:
+        raise SystemExit(
+            f"adaptive sweep never shifted bytes off worker 0: {shares}"
+        )
+    if not args.selftest and speedup < 1.2:
+        raise SystemExit(
+            f"hetero speedup {speedup:.2f}x below the 1.2x acceptance line"
+        )
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--peers", type=int, default=2)
@@ -536,7 +721,21 @@ def main() -> None:
         "instead of the wire: in-process host-vs-device sweep over "
         "--codecs, banks BOUNDARY_BENCH.json",
     )
+    ap.add_argument(
+        "--hetero", action="store_true",
+        help="bandwidth-skewed galaxy A/B: chaos-cap worker 0's egress at "
+        "1/4 of the others and bench uniform vs ODTP_LINK_ADAPT adaptive "
+        "partitioning; banks HETERO_BENCH.json",
+    )
+    ap.add_argument(
+        "--selftest", action="store_true",
+        help="with --hetero: small/fast CI shape (4 workers, 8 MB) that "
+        "checks the loop works without asserting the speedup line",
+    )
     args = ap.parse_args()
+    if args.hetero:
+        hetero_main(args)
+        return
     if args.boundary:
         if os.environ.get("MALLOC_MMAP_THRESHOLD_") is None:
             # glibc mmaps (and munmaps on free) every model-sized chunk by
