@@ -111,6 +111,17 @@ class DilocoConfig(BaseModel):
     # drops ~N-fold. 0/1 = off (reference full-sync semantics).
     streaming_fragments: int = 0
 
+    # streaming x overlap stagger (arxiv 2502.12996 "eager updates"
+    # composed with the 2501.18512 fragment schedule): with
+    # streaming_fragments=N AND overlap_comm != "none", EVERY fragment
+    # syncs each epoch on its own mid-phase clock -- fragment k's
+    # all-reduce launches at inner step  min(H, int(k*stagger*H/N)+1)
+    # and lands while the inner loop keeps training. 1.0 spreads the
+    # launches evenly across the whole inner phase; smaller values
+    # front-load them (0.5 packs all launches into the first half,
+    # leaving more time to land before the next epoch's slot).
+    stream_stagger: float = 1.0
+
     # where the outer data plane (master weights + Nesterov momentum) lives:
     #   "host"   - numpy master, serial host Nesterov step (reference
     #              hivemind offload_optimizer semantics)
@@ -138,11 +149,6 @@ class DilocoConfig(BaseModel):
                     "streaming_fragments requires outer_mode='allreduce' "
                     "(gossip mixes full masters per pair)"
                 )
-            if self.overlap_comm != "none":
-                raise ValueError(
-                    "streaming_fragments does not compose with overlap_comm "
-                    "yet; fragment rounds are already ~N-fold shorter"
-                )
             if self.average_state_every:
                 raise ValueError(
                     "streaming_fragments makes average_state_every "
@@ -152,6 +158,10 @@ class DilocoConfig(BaseModel):
                     "would erase the un-synced fragments' local progress "
                     "without it ever forming a pseudo-gradient"
                 )
+        if not (0.0 < self.stream_stagger <= 1.0):
+            raise ValueError(
+                f"stream_stagger must be in (0, 1], got {self.stream_stagger}"
+            )
         return self
 
     @model_validator(mode="after")

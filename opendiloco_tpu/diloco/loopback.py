@@ -41,12 +41,13 @@ class LoopbackWorld:
         self.progress: dict[str, PeerProgress] = {}
         self.state_provider: Optional[Callable[[], dict[str, Any]]] = None
         self.live: set[str] = set()
-        # all-reduce round state
-        self._round = 0
-        self._contrib: dict[str, list[np.ndarray]] = {}
-        self._result: Optional[list[np.ndarray]] = None
-        self._result_group = 0
-        self._result_round = -1
+        # all-reduce round state, keyed by round key (f"{tag}-epoch-{epoch}").
+        # Keyed slots are what let streaming fragment sync run several
+        # tagged rounds CONCURRENTLY through one world; each slot carries
+        # its own generation counter because keys legitimately repeat
+        # (tag "state" resolves epoch from the peer's own progress, which
+        # stays put across back-to-back state-averaging rounds).
+        self._rounds: dict[str, dict] = {}
         # gossip round state: round_key -> {"_partition": [...], chunk: {...}}
         self._gossip: dict = {}
 
@@ -115,6 +116,15 @@ class LoopbackBackend(OuterBackend):
         to model wire compression faithfully. ``group_cap`` partitions the
         live peers into deterministic per-round groups (gossip mode)."""
         self._chaos_gate()
+        # TcpBackend key parity: epoch=None resolves to this peer's own
+        # reported epoch (default 0). Rounds are KEYED now — a raw None in
+        # the key would split a round between callers that pass the epoch
+        # explicitly (the optimizer) and ones that don't (state averaging,
+        # tests), where the old single-slot world happily mixed them.
+        if epoch is None:
+            with self.world.lock:
+                own = self.world.progress.get(self._peer_id)
+            epoch = own.epoch if own else 0
         if group_cap:
             out, n = self._group_reduce(arrays, tag, epoch, group_cap, timeout)
             self._record_round_health(tag, epoch, n)
@@ -138,29 +148,44 @@ class LoopbackBackend(OuterBackend):
         deadline = time.monotonic() + (timeout or 3600.0)
         t_wait = time.perf_counter() if tr is not None else 0.0
         with w.cond:
-            my_round = w._round
-            w._contrib[self._peer_id] = compressed
+            slot = w._rounds.setdefault(
+                round_key,
+                {
+                    "round": 0,
+                    "contrib": {},
+                    "result": None,
+                    "result_group": 0,
+                    "result_round": -1,
+                    "pending": set(),
+                },
+            )
+            my_round = slot["round"]
+            slot["contrib"][self._peer_id] = compressed
             w.cond.notify_all()
-            while w._result_round < my_round:
-                if set(w._contrib) >= w.live and w._contrib:
+            while slot["result_round"] < my_round:
+                if set(slot["contrib"]) >= w.live and slot["contrib"]:
                     # complete: first thread to notice publishes the mean
-                    contribs = list(w._contrib.values())
+                    contribs = list(slot["contrib"].values())
                     n = len(contribs)
-                    w._result = [
+                    slot["result"] = [
                         np.sum([c[i] for c in contribs], axis=0) / n
                         for i in range(len(arrays))
                     ]
-                    w._result_group = n
-                    w._result_round = my_round
-                    w._round += 1
-                    w._contrib = {}
+                    slot["result_group"] = n
+                    slot["result_round"] = my_round
+                    slot["round"] += 1
+                    # collectors of this generation (slot GC: the key's
+                    # state is dropped once every contributor -- or its
+                    # survivor set, if some died -- has copied the result)
+                    slot["pending"] = set(slot["contrib"])
+                    slot["contrib"] = {}
                     w.cond.notify_all()
                     break
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
                     # give up: retract our contribution so a later round
                     # doesn't count a stale tensor from a dead peer
-                    w._contrib.pop(self._peer_id, None)
+                    slot["contrib"].pop(self._peer_id, None)
                     w.cond.notify_all()
                     raise AllReduceError(f"{self._peer_id}: all-reduce timed out")
                 w.cond.wait(timeout=min(remaining, 0.1))
@@ -170,8 +195,17 @@ class LoopbackBackend(OuterBackend):
                     worker=self._peer_id, round=round_key,
                 )
             t_adopt = time.perf_counter() if tr is not None else 0.0
-            result = [a.copy() for a in w._result]
-            group = w._result_group
+            result = [a.copy() for a in slot["result"]]
+            group = slot["result_group"]
+            # GC: keys repeat across epochs (and tags multiply with
+            # streaming fragments) -- drop the slot once every live
+            # contributor has collected and no next generation has begun
+            slot["pending"] = {
+                p for p in slot["pending"]
+                if p != self._peer_id and p in w.live
+            }
+            if not slot["pending"] and not slot["contrib"]:
+                w._rounds.pop(round_key, None)
         if tr is not None:
             tr.add_span(
                 "outer/adopt", t_adopt, time.perf_counter(),

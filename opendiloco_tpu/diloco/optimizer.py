@@ -19,6 +19,7 @@ boundary, so the 500-step inner phases never recompile.
 from __future__ import annotations
 
 import concurrent.futures
+import functools
 import threading
 import time
 from typing import Any, Optional
@@ -31,12 +32,21 @@ from opendiloco_tpu.config import DilocoConfig
 from opendiloco_tpu.diloco.backend import OuterBackend, PeerProgress, wait_for_peers
 from opendiloco_tpu.diloco.outer_device import DeviceOuterPlane
 from opendiloco_tpu.diloco.outer_optimizer import OuterSGD
+from opendiloco_tpu.diloco.streaming import StreamScheduler
 from opendiloco_tpu.parallel.world import HostWorld
 from opendiloco_tpu.trainer import InnerTrainer
 from opendiloco_tpu.utils.debug import schema_fingerprint
 from opendiloco_tpu.utils.logger import get_text_logger
 
 log = get_text_logger(__name__)
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _frag_add(cur, delta):
+    """params += delta over one fragment's leaves (streaming landing/
+    launch). The old param buffers are donated — the caller rebinds the
+    fragment entries to the fresh outputs, so they are dead either way."""
+    return [a + b for a, b in zip(cur, delta)]
+
 
 # join-keepalive cadence: must beat the rendezvous registration TTL (60 s
 # default in both daemons) so a worker stuck in its first multi-minute XLA
@@ -210,6 +220,24 @@ class DiLoCoOptimizer:
         # which could land the next round on the slot an abandoned round is
         # still streaming from
         self._pg_slot = 0
+
+        # streaming x overlap (arxiv 2501.18512 + 2502.12996): staggered
+        # in-phase fragment rounds with eager first-step estimates,
+        # driven from a trainer post-dispatch hook so launches never
+        # leave the inner loop. Single-process only (the scheduler lands
+        # on the training thread and the device plane is not
+        # collective-aware); multihost falls back to the blocking
+        # fragment path, which outer_step already handles.
+        self._stream: Optional[StreamScheduler] = None
+        if self._fragments is not None and cfg.overlap_comm != "none":
+            if self.world.process_count > 1:
+                log.warning(
+                    "streaming_fragments x overlap_comm is single-process "
+                    "only; falling back to blocking fragment sync"
+                )
+            else:
+                self._stream = StreamScheduler(self)
+                trainer.add_post_dispatch_hook(self._stream_tick)
 
         if self.backend is not None:
             self.backend.serve_state(self._state_for_peers)
@@ -555,13 +583,32 @@ class DiLoCoOptimizer:
             metrics.update(self._landed_metrics)
             self._landed_metrics = None
         if self.local_step >= self.cfg.local_steps:
-            overlap = self.cfg.overlap_comm != "none" and not self._is_state_avg_epoch()
-            if overlap:
-                state, outer_metrics = self._outer_step_overlapped(state)
+            if self._stream is not None:
+                # streaming: the fragments already synced mid-phase (or
+                # are still flying); the boundary is pure bookkeeping
+                state, outer_metrics = self._stream.boundary(state)
             else:
-                state, outer_metrics = self.outer_step(state)
+                # the overlapped path is full-model; a fragmented config
+                # (streaming under multihost fallback) takes the blocking
+                # fragment path instead
+                overlap = (
+                    self.cfg.overlap_comm != "none"
+                    and self._fragments is None
+                    and not self._is_state_avg_epoch()
+                )
+                if overlap:
+                    state, outer_metrics = self._outer_step_overlapped(state)
+                else:
+                    state, outer_metrics = self.outer_step(state)
             metrics.update(outer_metrics)
         return state, metrics
+
+    def _stream_tick(self, state: dict) -> dict:
+        """Trainer post-dispatch hook: the streaming scheduler's
+        heartbeat. Fires after every inner dispatch and BEFORE step()
+        increments local_step, so the just-dispatched inner step is
+        ``local_step + 1``."""
+        return self._stream.tick(state, self.local_step + 1)
 
     def _is_state_avg_epoch(self) -> bool:
         """Full-state-averaging epochs run the blocking path (they rewrite
@@ -1046,6 +1093,8 @@ class DiLoCoOptimizer:
         """Abandon an in-flight round (its result will never be applied).
         A running reduce can't be cancelled; it is tracked so the next
         launch drains it before reusing the round key."""
+        if self._stream is not None:
+            self._stream.drop_all()
         if self._pending is not None:
             fut = self._pending["future"]
             if fut is not None and not fut.cancel():
@@ -1056,7 +1105,31 @@ class DiLoCoOptimizer:
         """Resolve any in-flight outer communication (call before
         checkpointing or shutdown so the master reflects every launched
         round)."""
+        if self._stream is not None:
+            state = self._stream.flush(state)
         return self._poll_pending(state, block=True)
+
+    def _apply_frag_delta(self, state: dict, frag: list, delta: list) -> dict:
+        """Apply a fragment-indexed delta to the live params: one donated
+        jit add over the fragment's leaves; untouched leaves pass through
+        live (the H2D — host placement only — moves one fragment, not the
+        model). The jit cache is keyed by the fragment's avals, so a fixed
+        partition compiles exactly N tiny executables."""
+        leaves = jax.tree.leaves(state["params"])
+        cur = [leaves[i] for i in frag]
+        if delta and not isinstance(delta[0], jax.Array):
+            sh = jax.tree.leaves(self.trainer.state_shardings["params"])
+            delta = [
+                jax.device_put(np.asarray(d, np.float32), sh[i])
+                for d, i in zip(delta, frag)
+            ]
+        fresh = _frag_add(cur, delta)
+        merged = list(leaves)
+        for j, i in enumerate(frag):
+            merged[i] = fresh[j]
+        state = dict(state)
+        state["params"] = jax.tree.unflatten(self.treedef, merged)
+        return state
 
     def _apply_delta_to_device(self, state: dict, delta_flat: list) -> dict:
         if self._apply_delta is None:

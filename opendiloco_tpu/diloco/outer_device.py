@@ -178,6 +178,41 @@ def _land_eager_fused(masters, bufs, est_m, avg, lr, momentum, *, nesterov, has_
     return new_m, new_b, delta
 
 
+@functools.partial(
+    jax.jit, static_argnames=("wire_dtype", "nesterov", "has_mom", "eager")
+)
+def _stream_launch_fused(
+    masters, bufs, params, lr, momentum, *, wire_dtype, nesterov, has_mom, eager
+):
+    """Streaming fragment launch: pseudo-gradient + wire cast + (eager)
+    locally-estimated step in ONE dispatch with NOTHING donated — the live
+    fragment masters/bufs/params stay bound. Unlike ``_estimate_fused``,
+    the estimate never rebinds the live plane: the plane stays pre-round
+    until the fragment's all-reduce lands (``stream_land``), which is what
+    lets N fragment rounds be in flight at once without tearing the
+    served master. Every output is freshly computed (no input
+    pass-through), so the comm thread can ``device_get`` the wire arrays
+    lock-free while train steps keep donating the live params.
+
+    eager:   returns (wire, delta, est_m) — delta = est_m - params is the
+             immediately-applied first-step estimate (arxiv 2502.12996),
+             est_m is retained for the landing reconciliation.
+    delayed: returns (wire, boundary, []) — an independent f32 boundary
+             copy for the landing rewrite."""
+    pg = [m - p for m, p in zip(masters, params)]
+    wire = [g.astype(wire_dtype) for g in pg] if wire_dtype is not None else pg
+    if not eager:
+        boundary = [
+            p.astype(jnp.float32) + jnp.zeros((), jnp.float32) for p in params
+        ]
+        return wire, boundary, []
+    est_m, _, _ = _nesterov_step(
+        masters, bufs, pg, lr, momentum, nesterov, has_mom
+    )
+    delta = [e - p for e, p in zip(est_m, params)]
+    return wire, delta, est_m
+
+
 @functools.partial(jax.jit, donate_argnums=(1,))
 def _overwrite_fused(masters, params):
     # params <- master. The add-zero is load-bearing: a bare passthrough
@@ -447,6 +482,83 @@ class DeviceOuterPlane:
             self.masters = new_m
             if self._has_mom:
                 self.bufs = new_b
+            return delta
+
+    def stream_launch(
+        self,
+        param_leaves: Sequence[jax.Array],
+        frag: list[int],
+        *,
+        eager: bool,
+    ) -> tuple[list[jax.Array], Optional[list[jax.Array]], list[jax.Array]]:
+        """Streaming fragment launch: one fused dispatch computes the
+        fragment pseudo-gradient (wire-cast for the D2H fetch), plus the
+        eager first-step estimate when ``eager``. NOTHING is donated and
+        the live plane is NOT rebound — the plane stays pre-round for this
+        fragment until ``stream_land``, so N fragment rounds can be in
+        flight at once without tearing the served master.
+
+        Returns ``(wire, delta, retained)``:
+          wire     — fresh device arrays for the comm thread to
+                     ``device_get`` lock-free (no one ever donates them)
+          delta    — eager only: device delta to apply to the fragment's
+                     param leaves right now (None when delayed)
+          retained — eager: est_m for the landing correction;
+                     delayed: the independent f32 boundary copy
+        """
+        with self.lock:
+            m = self._sel(self.masters, frag)
+            b = self._sel(self.bufs, frag)
+            p = [param_leaves[i] for i in frag]
+            lr, mom = self._scalars()
+            wire, aux, est_m = _stream_launch_fused(
+                m, b, p, lr, mom,
+                wire_dtype=self._wire_dtype, nesterov=self.nesterov,
+                has_mom=self._has_mom, eager=eager,
+            )
+        if eager:
+            return wire, aux, est_m
+        return wire, None, aux
+
+    def stream_land(
+        self,
+        frag: list[int],
+        averaged: Sequence[np.ndarray],
+        *,
+        est_m: Optional[list[jax.Array]] = None,
+        boundary: Optional[list[jax.Array]] = None,
+    ) -> list[jax.Array]:
+        """Streaming fragment landing: true outer step for the fragment
+        from the LIVE plane arrays (still pre-round for this fragment —
+        ``stream_launch`` never rebinds), reconciled against the retained
+        eager estimate (delta = true - est, telescoping with the launch's
+        est - boundary to exactly true - boundary) or the retained
+        boundary copy (delayed). Donates the fragment's live masters/bufs
+        and the retained arrays, rebinds the fragment entries, and returns
+        the device delta for the fragment's param leaves."""
+        with self.lock:
+            if self._has_mom:
+                # full-length zeros if momentum is armed but no round has
+                # landed yet: frag-selected zeros == the implied pre-round
+                # momentum the launch-time estimate zero-initialized
+                self._ensure_bufs()
+            avg = self._h2d(averaged, frag)
+            pre_m = self._sel(self.masters, frag)
+            pre_b = self._sel(self.bufs, frag)
+            lr, mom = self._scalars()
+            if est_m is not None:
+                new_m, new_b, delta = _land_eager_fused(
+                    pre_m, pre_b, est_m, avg, lr, mom,
+                    nesterov=self.nesterov, has_mom=self._has_mom,
+                )
+            else:
+                new_m, new_b, delta = _land_delayed_fused(
+                    pre_m, pre_b, boundary, avg, lr, mom,
+                    nesterov=self.nesterov, has_mom=self._has_mom,
+                )
+            self._put_back("masters", frag, new_m)
+            if self._has_mom:
+                self._put_back("bufs", frag, new_b)
             return delta
 
     def sync_params(

@@ -23,6 +23,7 @@ synchronous bridges (the training loop is synchronous host code).
 from __future__ import annotations
 
 import asyncio
+import contextvars
 import hashlib
 import os
 import threading
@@ -171,10 +172,19 @@ def _decode_obj(obj: Any, arrays: list[np.ndarray]) -> Any:
     return obj
 
 
+# per-round stage-time accumulator, armed only while ODTP_OBS is set.
+# A ContextVar, NOT a backend attribute: streaming fragment sync runs
+# several all-reduce rounds CONCURRENTLY on one backend (one task per
+# fragment), and each round task gets its own context copy — a shared
+# slot would let round A's finally-clear null out round B's accumulator
+# mid-round. Child tasks (asyncio.gather legs) inherit the round task's
+# context at creation, so every exchange helper sees its own round's slot.
+_OBS_STAGE: contextvars.ContextVar[Optional["obs.StageTimes"]] = (
+    contextvars.ContextVar("odtp_obs_stage", default=None)
+)
+
+
 class TcpBackend(OuterBackend):
-    # per-round stage-time accumulator, armed only while ODTP_OBS is set
-    # (all_reduce rounds are serialized per backend, so one slot suffices)
-    _obs_stage: Optional[obs.StageTimes] = None
 
     def __init__(
         self,
@@ -270,8 +280,12 @@ class TcpBackend(OuterBackend):
         # is done. Reference analogue: hivemind averages into the outer
         # optimizer's persistent grad buffers (hivemind_diloco.py:68-119).
         self._free_bufs: dict[int, list[np.ndarray]] = {}
-        self._retired_bufs: list[np.ndarray] = []  # reclaim at next round
-        self._round_attempt = 0  # current all_reduce retry index (ledger)
+        # retired buffers keyed by round TAG: the next all_reduce with the
+        # SAME tag reclaims them. Keying matters — streaming fragment sync
+        # runs per-fragment rounds concurrently on this backend, and a
+        # global retire list would let fragment B's entry reclaim the
+        # buffer fragment A's caller is still reading views of.
+        self._retired_bufs: dict[str, list[np.ndarray]] = {}
         self._pool_lock = threading.Lock()  # caller + event-loop threads
         self._progress_cache: list[PeerProgress] = []
         self._own_progress: Optional[PeerProgress] = None
@@ -878,7 +892,7 @@ class TcpBackend(OuterBackend):
         self, host: str, port: int, msg: str, meta: dict, payload, *,
         timeout: float, peer_id: Optional[str] = None,
     ) -> None:
-        stage = self._obs_stage
+        stage = _OBS_STAGE.get()
         if stage is None:
             return await self._send_part_inner(
                 host, port, msg, meta, payload, timeout=timeout,
@@ -987,7 +1001,7 @@ class TcpBackend(OuterBackend):
         }
 
     async def _wait_mailbox(self, key: tuple, deadline: float) -> tuple[dict, bytes]:
-        stage = self._obs_stage
+        stage = _OBS_STAGE.get()
         if stage is None:
             return await self._wait_mailbox_inner(key, deadline)
         t0 = time.perf_counter()
@@ -1104,22 +1118,34 @@ class TcpBackend(OuterBackend):
             while len(self._free_bufs) > 4:
                 del self._free_bufs[min(self._free_bufs)]
 
+    def _retire_buf(self, round_key: str, buf: np.ndarray) -> None:
+        """Park a result buffer whose views the caller still holds; the
+        next all_reduce with the SAME tag reclaims it (see the lifetime
+        contract on ``all_reduce``)."""
+        tag = round_key.split("-epoch-")[0]
+        with self._pool_lock:
+            self._retired_bufs.setdefault(tag, []).append(buf)
+
     def _record_round_health(
         self, join_key: str, n: int, expected: int, elastic: bool, timings: dict,
-        extra: Optional[dict] = None,
+        extra: Optional[dict] = None, attempt: int = 0,
     ) -> None:
         """Append one row to the round health ledger (and keep the legacy
         ``last_round_timings`` view in sync). Solo and elastic rounds are
         recorded as data, not errors: the bench/soak layers read this
         instead of inferring health from exceptions. ``extra`` carries
-        adaptive-transport fields (link_plan, link_shares) when armed."""
+        adaptive-transport fields (link_plan, link_shares) when armed.
+        ``attempt`` is threaded explicitly from the retry loop — it is set
+        on the CALLER thread, so neither an attribute nor a ContextVar
+        would reach this loop-thread coroutine reliably once several
+        fragment rounds run concurrently."""
         self.last_round_timings = timings
         health = {
             "round": join_key,
             "group_size": n,
             "expected": expected,
             "elastic": elastic,
-            "retries": self._round_attempt,
+            "retries": attempt,
             **{k: round(v, 6) for k, v in timings.items()},
             **(extra or {}),
         }
@@ -1137,8 +1163,8 @@ class TcpBackend(OuterBackend):
             tr.count("outer_rounds")
             if elastic:
                 tr.count("outer_rounds_elastic")
-            if self._round_attempt:
-                tr.count("outer_round_retries", self._round_attempt)
+            if attempt:
+                tr.count("outer_round_retries", attempt)
             tr.gauge("outer_group_size", n)
             if extra and "link_shares" in extra:
                 tr.count("outer_rounds_adaptive")
@@ -1161,13 +1187,16 @@ class TcpBackend(OuterBackend):
 
         RESULT LIFETIME: the returned arrays are views of a pooled internal
         buffer that is recycled on the NEXT all_reduce call on this backend
-        -- consume (or copy) them before calling again. Every in-tree
-        consumer applies the result immediately (optimizer.outer_step); the
-        pooling is what keeps multi-GB rounds from re-faulting freshly
-        mmapped pages every epoch."""
-        # reclaim buffers whose views the caller has consumed by now
+        -- consume (or copy) them before calling again. The lifetime is
+        scoped PER TAG: concurrent rounds with distinct tags (streaming
+        fragment sync) never reclaim each other's result buffers. Every
+        in-tree consumer applies the result immediately
+        (optimizer.outer_step / the fragment landing); the pooling is what
+        keeps multi-GB rounds from re-faulting freshly mmapped pages every
+        epoch."""
+        # reclaim buffers whose views this tag's caller has consumed by now
         with self._pool_lock:
-            reclaim, self._retired_bufs = self._retired_bufs, []
+            reclaim = self._retired_bufs.pop(tag, [])
         for b in reclaim:
             self._checkin_buf(b)
         timeout = timeout or 300.0
@@ -1177,7 +1206,6 @@ class TcpBackend(OuterBackend):
         last_err: Optional[Exception] = None
         retries = chaos.round_retries()
         for attempt in range(retries):
-            self._round_attempt = attempt  # feeds the health ledger
             # each re-formed round gets a FRESH deadline: a round that
             # wedges on a split-brain group (e.g. divergent membership
             # views after a daemon blackout) burns its whole window
@@ -1188,7 +1216,8 @@ class TcpBackend(OuterBackend):
             try:
                 return self._run(
                     self._all_reduce_round(
-                        arrays, round_key, deadline, group_cap=group_cap
+                        arrays, round_key, deadline, group_cap=group_cap,
+                        attempt=attempt,
                     ),
                     timeout=max(1.0, deadline - time.monotonic()) + 10,
                 )
@@ -1212,15 +1241,17 @@ class TcpBackend(OuterBackend):
         raise AllReduceError(f"all-reduce failed: {last_err}")
 
     async def _all_reduce_round(
-        self, arrays: list[np.ndarray], join_key: str, deadline: float, group_cap=0
+        self, arrays: list[np.ndarray], join_key: str, deadline: float,
+        group_cap=0, attempt=0,
     ):
         scratch: list[np.ndarray] = []  # pooled buffers local to this round
         try:
             return await self._all_reduce_round_inner(
-                arrays, join_key, deadline, scratch, group_cap=group_cap
+                arrays, join_key, deadline, scratch, group_cap=group_cap,
+                attempt=attempt,
             )
         finally:
-            self._obs_stage = None
+            _OBS_STAGE.set(None)
             for b in scratch:
                 self._checkin_buf(b)
 
@@ -1231,10 +1262,11 @@ class TcpBackend(OuterBackend):
         deadline: float,
         scratch: list[np.ndarray],
         group_cap=0,
+        attempt=0,
     ):
         timings: dict[str, float] = {}
         tr = obs.tracer()
-        self._obs_stage = obs.StageTimes() if tr is not None else None
+        _OBS_STAGE.set(obs.StageTimes() if tr is not None else None)
         t_mm_p = time.perf_counter() if tr is not None else 0.0
         t_mm = time.monotonic()
         # 1. matchmake
@@ -1287,7 +1319,9 @@ class TcpBackend(OuterBackend):
                     "outer/rendezvous", t_mm_p, time.perf_counter(),
                     round=join_key, group=n,
                 )
-            self._record_round_health(join_key, n, expected, elastic, timings)
+            self._record_round_health(
+                join_key, n, expected, elastic, timings, attempt=attempt
+            )
             return [a.copy() for a in arrays], 1
         # fingerprint the membership: retried rounds (same join_key) must not
         # consume stale mailbox traffic from a differently-shaped group
@@ -1363,7 +1397,7 @@ class TcpBackend(OuterBackend):
             group, my_idx, n, parts, bounds, flat.size, round_key, deadline,
             scratch, timings, plan_meta,
         )
-        stage = self._obs_stage
+        stage = _OBS_STAGE.get()
         if stage is not None:
             # fold fine-grained stage wall-clock (encode / wire_send /
             # wire_recv / accumulate, summed across overlapping chunk work)
@@ -1373,7 +1407,8 @@ class TcpBackend(OuterBackend):
                     timings.get(f"{name}_s", 0.0) + secs, 6
                 )
         self._record_round_health(
-            join_key, n, expected, elastic, timings, extra=health_extra
+            join_key, n, expected, elastic, timings, extra=health_extra,
+            attempt=attempt,
         )
         if adaptive:
             # fresh estimates from this round's transfers reach the daemon
@@ -1401,7 +1436,7 @@ class TcpBackend(OuterBackend):
         bit-parity the adaptive layer's off/on parity test relies on."""
         plan_meta = plan_meta or {}
         my_plan = plan_meta.get("plan")
-        stage = self._obs_stage
+        stage = _OBS_STAGE.get()
         codec = self.codec
         encode = stage.timed("encode", codec.encode) if stage else codec.encode
         dec_acc = (
@@ -1517,8 +1552,7 @@ class TcpBackend(OuterBackend):
         # part decodes STRAIGHT into its slice (one native pass per part,
         # no intermediate array, no reassembly concatenate afterwards).
         flat_avg = self._checkout_buf(flat_size)
-        with self._pool_lock:
-            self._retired_bufs.append(flat_avg)
+        self._retire_buf(round_key, flat_avg)
 
         async def recv_results():
             from opendiloco_tpu.diloco.bulk import release_buffer
@@ -1597,7 +1631,7 @@ class TcpBackend(OuterBackend):
                         )
             if state["stream"] is not None:
                 try:
-                    stage = self._obs_stage
+                    stage = _OBS_STAGE.get()
                     t0 = time.perf_counter()
                     await loop.run_in_executor(
                         None, state["stream"].send, msg, meta, payload
@@ -1665,7 +1699,7 @@ class TcpBackend(OuterBackend):
         loop = self._loop
         chunk_elems = _pipeline_chunk_elems()
         align = getattr(self.codec, "chunk_align", 1)
-        stage = self._obs_stage
+        stage = _OBS_STAGE.get()
         codec = self.codec
         enc_chunk = (
             stage.timed("encode", codec.encode_chunk)
@@ -1806,8 +1840,7 @@ class TcpBackend(OuterBackend):
             return enc_futs[k]
 
         flat_avg = self._checkout_buf(flat_size)
-        with self._pool_lock:
-            self._retired_bufs.append(flat_avg)
+        self._retire_buf(round_key, flat_avg)
 
         async def send_result_to(j):
             send, close = self._chunk_sender(group[j], deadline)

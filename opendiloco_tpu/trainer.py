@@ -256,6 +256,12 @@ class InnerTrainer:
                 )
         self.optimizer = make_inner_optimizer(tc)
         self.schedule = make_schedule(tc)
+        # post-dispatch hooks: state -> state transforms run right after
+        # each train_step dispatch returns (the step itself is async on
+        # device, so hook work overlaps it). The streaming outer scheduler
+        # rides this to launch/land mid-phase fragment rounds without the
+        # driver loop ever knowing.
+        self._post_dispatch_hooks: list = []
 
         self.p_specs = param_specs(model_cfg, plan, for_params=True)
         params_shapes = jax.eval_shape(
@@ -581,17 +587,27 @@ class InnerTrainer:
             "labels": self._to_global(shaped(labels), sharding, 1),
         }
 
+    def add_post_dispatch_hook(self, fn) -> None:
+        """Register a ``state -> state`` callback fired after every
+        ``train_step`` dispatch (on the calling thread, while the step
+        itself still runs on device)."""
+        self._post_dispatch_hooks.append(fn)
+
     def train_step(self, state: dict, batch: dict):
         tr = obs.tracer()
         if tr is None:
-            return self._train_step(state, batch)
-        # dispatch wall only: the jit'd step is async, device time surfaces
-        # in the driver's step gap (train.py logs the synced step time)
-        t0 = tr.now()
-        out = self._train_step(state, batch)
-        tr.add_span("inner/dispatch", t0, tr.now())
-        tr.count("inner_steps")
-        return out
+            state, metrics = self._train_step(state, batch)
+        else:
+            # dispatch wall only: the jit'd step is async, device time
+            # surfaces in the driver's step gap (train.py logs the synced
+            # step time)
+            t0 = tr.now()
+            state, metrics = self._train_step(state, batch)
+            tr.add_span("inner/dispatch", t0, tr.now())
+            tr.count("inner_steps")
+        for hook in self._post_dispatch_hooks:
+            state = hook(state)
+        return state, metrics
 
     def eval_loss(self, params: dict, input_ids: np.ndarray, labels: np.ndarray) -> float:
         sharding = self.plan.sharding(self.plan.batch_spec(2))

@@ -51,6 +51,12 @@ _BOUNDARY_OUT = os.environ.get("ODTP_BOUNDARY_BENCH_OUT") or os.path.join(
 _HETERO_OUT = os.environ.get("ODTP_HETERO_BENCH_OUT") or os.path.join(
     REPO, "HETERO_BENCH.json"
 )
+# --stream mode banks here: blocking vs delayed-overlap vs streaming-eager
+# outer-overhead-% of the inner phase, the artifact the staggered fragment
+# scheduler (streaming_fragments x overlap_comm) is judged against
+_STREAM_OUT = os.environ.get("ODTP_STREAM_BENCH_OUT") or os.path.join(
+    REPO, "STREAM_BENCH.json"
+)
 
 
 def expected_group(peers: int, group_cap: int) -> int:
@@ -687,6 +693,256 @@ def hetero_main(args) -> None:
         )
 
 
+def _stream_batches(seed: int, vocab: int, n: int, bs: int, seq: int):
+    """Learnable deterministic stream (same generator as the convergence
+    oracle): each row is a consecutive-token ramp from a random start."""
+    rng = np.random.default_rng(seed)
+    for _ in range(n):
+        starts = rng.integers(0, vocab, (bs, 1))
+        ids = ((starts + np.arange(seq)) % vocab).astype(np.int32)
+        yield ids, ids.copy()
+
+
+def _stream_arm(
+    label: str, cfg_model, workers: int, warm: int, epochs: int,
+    local_steps: int, bs: int, seq: int, dcfg_kwargs: dict,
+) -> tuple[list, list]:
+    """One arm of the streaming A/B/C: ``workers`` loopback threads in one
+    shared world, each on its OWN single-device mesh (concurrent
+    multi-device XLA executions deadlock on the CPU client — the
+    per-worker-mesh idiom of tests/test_diloco.py). Every worker times
+    every ``opt.step`` to loss-sync; the warm epochs are dropped (inner +
+    outer jit compiles land there). Returns (per-worker step seconds for
+    the measured epochs, per-worker final master leaves)."""
+    import threading as th
+
+    import jax
+
+    from opendiloco_tpu.config import DilocoConfig
+    from opendiloco_tpu.diloco import DiLoCoOptimizer, LoopbackWorld
+    from opendiloco_tpu.parallel.mesh import build_mesh
+    from opendiloco_tpu.trainer import InnerTrainer, TrainerConfig
+
+    n_steps = (warm + epochs) * local_steps
+    world = LoopbackWorld(workers)
+    backends = world.make_backends()
+    times: list[list[float]] = [[] for _ in range(workers)]
+    masters: list = [None] * workers
+    errors: list[str] = []
+    start = th.Barrier(workers)
+
+    def worker(rank: int) -> None:
+        try:
+            tc = TrainerConfig(
+                lr=1e-3, warmup_steps=2, total_steps=n_steps,
+                precision="fp32", remat=False,
+            )
+            dev = jax.devices()[rank % len(jax.devices())]
+            trainer = InnerTrainer(
+                cfg_model, tc, build_mesh("NO_SHARD", devices=[dev])
+            )
+            state = trainer.init_state(jax.random.key(7))
+            opt = DiLoCoOptimizer(
+                trainer,
+                backends[rank],
+                DilocoConfig(
+                    local_steps=local_steps,
+                    outer_nesterov=True,
+                    backend="loopback",
+                    timeout_waiting_for_peers=300.0,
+                    averaging_timeout=600.0,
+                    **dcfg_kwargs,
+                ),
+                state,
+                batch_size=bs,
+            )
+            data = [
+                trainer.shard_batch(ids, labels, accum=1)
+                for ids, labels in _stream_batches(
+                    1000 + rank, cfg_model.vocab_size, n_steps, bs, seq
+                )
+            ]
+            start.wait()
+            for batch in data:
+                t0 = time.perf_counter()
+                state, m = opt.step(state, batch)
+                float(m["loss"])  # sync: the step (and any blocking
+                # boundary work inside it) has fully executed
+                times[rank].append(time.perf_counter() - t0)
+            state = opt.flush(state)  # untimed: land whatever still flies
+            masters[rank] = [np.asarray(x) for x in opt.master]
+        except Exception as e:  # pragma: no cover - surfaced to the parent
+            errors.append(f"{label} worker {rank}: {e!r}")
+            try:
+                start.abort()
+            except Exception:
+                pass
+
+    threads = [th.Thread(target=worker, args=(r,)) for r in range(workers)]
+    t0 = time.time()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    if errors:
+        raise SystemExit("stream bench arm failed: " + "; ".join(errors))
+    print(f"  [{label}: {workers} workers x {n_steps} steps, "
+          f"{time.time() - t0:.1f}s wall]")
+    return [ts[warm * local_steps:] for ts in times], masters
+
+
+def stream_main(args) -> None:
+    """Streaming eager outer sync A/B/C: blocking vs delayed-overlap vs
+    staggered streaming-eager fragment sync on the SAME in-process
+    loopback galaxy, same data/init, same chaos-emulated WAN latency on
+    every all-reduce contribution. The headline per arm is the OUTER
+    OVERHEAD as a % of the inner phase: measured-epoch wall clock against
+    an inner-only ideal priced from the blocking arm's median undisturbed
+    (non-boundary) step. Blocking pays the emulated round-trip on the
+    training thread at every boundary; the overlapped arms pay it on comm
+    threads, where it should vanish under inner compute. Banks
+    STREAM_BENCH.json; the full run exits nonzero if streaming-eager
+    overhead breaches the 5% acceptance line."""
+    if args.selftest:
+        workers, warm, epochs, local_steps = 2, 1, 2, 4
+        fragments, delay_ms, bs = 2, 50, 4
+        out_path = os.environ.get("ODTP_STREAM_BENCH_OUT") or os.path.join(
+            os.environ.get("TMPDIR", "/tmp"), "STREAM_BENCH.selftest.json"
+        )
+    else:
+        # H=32 keeps the inner phase long enough that the per-fragment
+        # launch/land host math AND the comm threads' copy/sum CPU (which
+        # a 1-core box charges against inner steps even when the wire
+        # wait itself is hidden) price under the 5% line — the same ratio
+        # production has, where inner steps are seconds, not milliseconds
+        workers, warm, epochs, local_steps = 8, 2, 3, 32
+        fragments, delay_ms, bs = 4, 300, 8
+        out_path = _STREAM_OUT
+    seq, stagger = 64, 1.0
+    # per-worker single-device meshes need >= ``workers`` host devices;
+    # the flag only takes effect before the first backend init, so set it
+    # before anything imports jax
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={workers}"
+        ).strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from opendiloco_tpu.models.hf_io import get_model
+
+    cfg_model, _ = get_model("2m")
+    # WAN round-trip stand-in: the chaos plane sleeps every all-reduce
+    # contribution for delay_ms before it joins its round (pinned value +
+    # seed => identical schedule across arms). Loopback's in-memory sum is
+    # otherwise free, which would make every arm trivially "overlapped".
+    os.environ["ODTP_CHAOS"] = f"seed=7;delay_ms={delay_ms}"
+    print(
+        f"stream bench: {workers} workers, model 2m, H={local_steps}, "
+        f"{epochs} measured epochs (+{warm} warm), emulated round-trip "
+        f"{delay_ms} ms, streaming N={fragments} stagger={stagger}"
+    )
+
+    arms = [
+        ("blocking", {}),
+        ("delayed", {"overlap_comm": "delayed"}),
+        (
+            "streaming_eager",
+            {
+                "streaming_fragments": fragments,
+                "overlap_comm": "eager",
+                "stream_stagger": stagger,
+            },
+        ),
+    ]
+    H = local_steps
+    results: dict[str, dict] = {}
+    baseline_inner = 0.0
+    for label, kwargs in arms:
+        measured, masters = _stream_arm(
+            label, cfg_model, workers, warm, epochs, H, bs, seq, kwargs
+        )
+        # every arm all-reduces the same values on every peer, so the
+        # masters must agree across workers — guards the bench against
+        # silently timing a broken sync path
+        for a, b in zip(masters[0], masters[-1]):
+            np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+        inner = [
+            t for ts in measured for i, t in enumerate(ts) if i % H != H - 1
+        ]
+        bound = [
+            t for ts in measured for i, t in enumerate(ts) if i % H == H - 1
+        ]
+        if label == "blocking":
+            # the shared inner-only price: blocking's non-boundary steps
+            # carry NO outer work at all (no ticks, no launches), so their
+            # median is the purest contended-inner-step cost available
+            baseline_inner = statistics.median(inner)
+        per_worker_pct = []
+        for ts in measured:
+            ideal = len(ts) * baseline_inner
+            per_worker_pct.append(round(100.0 * (sum(ts) - ideal) / ideal, 2))
+        results[label] = {
+            "outer_overhead_pct": round(statistics.median(per_worker_pct), 2),
+            "per_worker_overhead_pct": per_worker_pct,
+            "median_epoch_s": round(
+                statistics.median(
+                    sum(ts[e * H:(e + 1) * H])
+                    for ts in measured for e in range(epochs)
+                ),
+                4,
+            ),
+            "median_inner_step_s": round(statistics.median(inner), 4),
+            "median_boundary_step_s": round(statistics.median(bound), 4),
+            "epochs_s_w0": [
+                round(sum(measured[0][e * H:(e + 1) * H]), 4)
+                for e in range(epochs)
+            ],
+        }
+        r = results[label]
+        print(
+            f"{label:>16}: overhead {r['outer_overhead_pct']:6.2f}% of inner"
+            f"  (epoch {r['median_epoch_s'] * 1e3:7.0f} ms, inner step "
+            f"{r['median_inner_step_s'] * 1e3:6.0f} ms, boundary step "
+            f"{r['median_boundary_step_s'] * 1e3:6.0f} ms)"
+        )
+    os.environ.pop("ODTP_CHAOS", None)
+
+    doc = {
+        "bench": "stream",
+        "model": "2m",
+        "workers": workers,
+        "local_steps": H,
+        "epochs_measured": epochs,
+        "epochs_warm": warm,
+        "fragments": fragments,
+        "stream_stagger": stagger,
+        "emulated_rtt_ms": delay_ms,
+        "selftest": bool(args.selftest),
+        "baseline_inner_step_s": round(baseline_inner, 4),
+        "arms": results,
+        "updated": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "host": {
+            "cores": os.cpu_count(), "loadavg": round(os.getloadavg()[0], 2)
+        },
+    }
+    with open(out_path, "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+        f.write("\n")
+    stream_pct = results["streaming_eager"]["outer_overhead_pct"]
+    print(
+        f"streaming-eager outer overhead {stream_pct:.2f}% of inner phase "
+        f"(blocking {results['blocking']['outer_overhead_pct']:.2f}%, "
+        f"banked {out_path})"
+    )
+    if not args.selftest and stream_pct >= 5.0:
+        raise SystemExit(
+            f"streaming-eager overhead {stream_pct:.2f}% breaches the 5% "
+            "acceptance line"
+        )
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--peers", type=int, default=2)
@@ -728,11 +984,22 @@ def main() -> None:
         "partitioning; banks HETERO_BENCH.json",
     )
     ap.add_argument(
+        "--stream", action="store_true",
+        help="streaming eager outer sync A/B/C: blocking vs delayed vs "
+        "staggered streaming-eager fragment sync on an in-process "
+        "8-worker loopback galaxy under emulated WAN latency; reports "
+        "outer-overhead-%% of the inner phase per mode and banks "
+        "STREAM_BENCH.json",
+    )
+    ap.add_argument(
         "--selftest", action="store_true",
-        help="with --hetero: small/fast CI shape (4 workers, 8 MB) that "
-        "checks the loop works without asserting the speedup line",
+        help="with --hetero/--stream: small/fast CI shape that checks the "
+        "loop works without asserting the speedup/overhead line",
     )
     args = ap.parse_args()
+    if args.stream:
+        stream_main(args)
+        return
     if args.hetero:
         hetero_main(args)
         return

@@ -33,6 +33,24 @@ LOCAL_STEPS = 25
 BS = 16  # per DiLoCo worker; DDP runs 2*BS
 SEQ = 64
 
+# additive outer-mode arms: (streaming_fragments, DilocoConfig overrides).
+# Every arm shares the data stream, init, and held-out eval with the core
+# diloco-vs-ddp verdict. ``--arms`` re-runs a subset against an already
+# banked complete artifact without disturbing the rest (the core verdict
+# may come from a TPU tunnel window this box can't reproduce).
+ARMS = {
+    # one fragment per boundary, blocking (arxiv 2501.18512)
+    "streaming": (2, {}),
+    "gossip": (0, {"outer_mode": "gossip"}),
+    "overlap_delayed": (0, {"overlap_comm": "delayed"}),
+    "overlap_eager": (0, {"overlap_comm": "eager"}),
+    # staggered in-phase fragment all-reduce with eager first-step
+    # estimates (2501.18512 x 2502.12996): the parity curve for the
+    # streaming eager outer sync path, judged against the blocking
+    # diloco curve banked beside it
+    "streaming_eager": (2, {"overlap_comm": "eager"}),
+}
+
 
 def batches(seed, vocab, n, global_bs, seq=SEQ):
     """Learnable deterministic stream: each row is a consecutive-token
@@ -51,7 +69,7 @@ def _flush(doc):
     os.replace(tmp, _OUT)
 
 
-def main():
+def main(arms: str = "all"):
     import jax
 
     from opendiloco_tpu.config import DilocoConfig
@@ -61,18 +79,41 @@ def main():
     from opendiloco_tpu.trainer import InnerTrainer, TrainerConfig
 
     cfg, _ = get_model("2m")
-    doc = {
-        "model": "2m",
-        "platform": jax.devices()[0].platform,
-        "device": str(jax.devices()[0]),
-        "n_steps": N_STEPS,
-        "local_steps": LOCAL_STEPS,
-        "batch_per_worker": BS,
-        "seq": SEQ,
-        "ts_start": time.time(),
-        "complete": False,
-    }
-    _flush(doc)
+    want = None
+    if arms != "all":
+        want = [a.strip() for a in arms.split(",") if a.strip()]
+        unknown = [a for a in want if a not in ARMS]
+        if unknown:
+            raise SystemExit(f"unknown arms {unknown}; known: {sorted(ARMS)}")
+        try:
+            with open(_OUT) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            doc = None
+        if not doc or not doc.get("complete"):
+            raise SystemExit(
+                "--arms updates a banked artifact additively; run the full "
+                "script first so the core diloco-vs-ddp verdict exists"
+            )
+        if doc.get("n_steps") != N_STEPS:
+            raise SystemExit(
+                f"banked artifact has n_steps={doc.get('n_steps')}, this run "
+                f"would add {N_STEPS}-step curves — incomparable; match "
+                "ODTP_CONV_STEPS to the banked run"
+            )
+    else:
+        doc = {
+            "model": "2m",
+            "platform": jax.devices()[0].platform,
+            "device": str(jax.devices()[0]),
+            "n_steps": N_STEPS,
+            "local_steps": LOCAL_STEPS,
+            "batch_per_worker": BS,
+            "seq": SEQ,
+            "ts_start": time.time(),
+            "complete": False,
+        }
+        _flush(doc)
 
     def make_trainer():
         tc = TrainerConfig(
@@ -140,27 +181,32 @@ def main():
             raise SystemExit(doc["error"])
         return losses, params[0], round(time.time() - t0, 1)
 
-    diloco_l, diloco_p0, doc["diloco_wall_s"] = run_diloco_pair(0)
-    doc["diloco_losses"] = diloco_l[0]
-    _flush(doc)
+    if want is None:
+        diloco_l, diloco_p0, doc["diloco_wall_s"] = run_diloco_pair(0)
+        doc["diloco_losses"] = diloco_l[0]
+        _flush(doc)
 
-    # --- DDP at the same total batch: both shards concatenated ----------
-    trainer = make_trainer()
-    state = trainer.init_state(jax.random.key(7))  # same init
-    ddp_losses = []
-    t0 = time.time()
-    for (i0, l0), (i1, l1) in zip(
-        batches(1000, cfg.vocab_size, N_STEPS, BS),
-        batches(1001, cfg.vocab_size, N_STEPS, BS),
-    ):
-        batch = trainer.shard_batch(
-            np.concatenate([i0, i1]), np.concatenate([l0, l1]), accum=1
-        )
-        state, m = trainer.train_step(state, batch)
-        ddp_losses.append(round(float(m["loss"]), 5))
-    doc["ddp_wall_s"] = round(time.time() - t0, 1)
-    doc["ddp_losses"] = ddp_losses
-    _flush(doc)
+        # --- DDP at the same total batch: both shards concatenated ------
+        trainer = make_trainer()
+        state = trainer.init_state(jax.random.key(7))  # same init
+        ddp_losses = []
+        t0 = time.time()
+        for (i0, l0), (i1, l1) in zip(
+            batches(1000, cfg.vocab_size, N_STEPS, BS),
+            batches(1001, cfg.vocab_size, N_STEPS, BS),
+        ):
+            batch = trainer.shard_batch(
+                np.concatenate([i0, i1]), np.concatenate([l0, l1]), accum=1
+            )
+            state, m = trainer.train_step(state, batch)
+            ddp_losses.append(round(float(m["loss"]), 5))
+        doc["ddp_wall_s"] = round(time.time() - t0, 1)
+        doc["ddp_losses"] = ddp_losses
+        _flush(doc)
+    else:
+        # additive mode: the trainer only provides the (pure, jitted)
+        # eval function; the banked core curves stay untouched
+        trainer = make_trainer()
 
     # --- shared held-out eval -------------------------------------------
     eval_ids, eval_labels = next(batches(9999, cfg.vocab_size, 1, 64))
@@ -173,53 +219,38 @@ def main():
             )
         )
 
-    ev = {
-        "ddp": float(trainer.eval_loss(state["params"], eval_ids, eval_labels)),
-        "diloco_w0": held_out(diloco_p0),
-    }
-    ev["init"] = float(np.log(cfg.vocab_size))
-    ev["ratio"] = ev["diloco_w0"] / ev["ddp"] if ev["ddp"] else None
-    doc["eval"] = {k: round(v, 5) for k, v in ev.items()}
-    doc["ts_end"] = time.time()
-    # the CORE diloco-vs-DDP verdict banks complete FIRST: a tunnel window
-    # dying during the optional streaming arm below must not cost it
-    doc["complete"] = True
-    _flush(doc)
-    print(
-        f"CONVERGENCE complete on {doc['platform']}: "
-        f"ddp {ev['ddp']:.4f} diloco {ev['diloco_w0']:.4f} "
-        f"(init {ev['init']:.2f})"
-    )
+    if want is None:
+        ev = {
+            "ddp": float(
+                trainer.eval_loss(state["params"], eval_ids, eval_labels)
+            ),
+            "diloco_w0": held_out(diloco_p0),
+        }
+        ev["init"] = float(np.log(cfg.vocab_size))
+        ev["ratio"] = ev["diloco_w0"] / ev["ddp"] if ev["ddp"] else None
+        doc["eval"] = {k: round(v, 5) for k, v in ev.items()}
+        doc["ts_end"] = time.time()
+        # the CORE diloco-vs-DDP verdict banks complete FIRST: a tunnel
+        # window dying during an optional arm below must not cost it
+        doc["complete"] = True
+        _flush(doc)
+        print(
+            f"CONVERGENCE complete on {doc['platform']}: "
+            f"ddp {ev['ddp']:.4f} diloco {ev['diloco_w0']:.4f} "
+            f"(init {ev['init']:.2f})"
+        )
+    ev_ddp = doc["eval"]["ddp"]
 
-    # streaming fragment sync (arxiv 2501.18512): same run with one
-    # fragment synced per boundary -- the convergence claim behind the
-    # ~N-fold peak-bandwidth reduction. Appended additively after the core
-    # artifact is already complete.
-    stream_l, stream_p0, doc["streaming_wall_s"] = run_diloco_pair(2)
-    doc["streaming_losses"] = stream_l[0]
-    doc["eval"]["streaming_w0"] = round(held_out(stream_p0), 5)
-    doc["eval"]["streaming_ratio"] = (
-        round(doc["eval"]["streaming_w0"] / ev["ddp"], 5) if ev["ddp"] else None
-    )
-    doc["ts_end"] = time.time()
-    _flush(doc)
-    print(
-        f"CONVERGENCE streaming arm: "
-        f"{doc['eval']['streaming_w0']:.4f} "
-        f"(ratio vs ddp {doc['eval']['streaming_ratio']})"
-    )
-
-    # beyond-ref outer modes (VERDICT r4 ask #5): gossip pairing
-    # (arxiv 2506.10911) and overlapped communication, delayed + eager
-    # (arxiv 2502.12996). These shipped with identity oracles only; the
-    # missing evidence is a multi-round loss curve within the DiLoCo band.
-    for arm, overrides in (
-        ("gossip", {"outer_mode": "gossip"}),
-        ("overlap_delayed", {"overlap_comm": "delayed"}),
-        ("overlap_eager", {"overlap_comm": "eager"}),
-    ):
+    # beyond-ref outer modes, appended additively after the core artifact
+    # is already complete: streaming fragment sync (arxiv 2501.18512),
+    # gossip pairing (arxiv 2506.10911), overlapped communication
+    # (arxiv 2502.12996), and their streaming-eager composition
+    for arm in (list(ARMS) if want is None else want):
+        frags, overrides = ARMS[arm]
         try:
-            arm_l, arm_p0, doc[f"{arm}_wall_s"] = run_diloco_pair(0, **overrides)
+            arm_l, arm_p0, doc[f"{arm}_wall_s"] = run_diloco_pair(
+                frags, **overrides
+            )
         except SystemExit as e:
             # a failed additive arm must not take down the banked core
             # artifact or the remaining arms
@@ -227,11 +258,18 @@ def main():
             doc.pop("error", None)
             _flush(doc)
             continue
+        if "arm_errors" in doc:  # a re-run supersedes a banked failure
+            doc["arm_errors"].pop(arm, None)
+            if not doc["arm_errors"]:
+                del doc["arm_errors"]
         doc[f"{arm}_losses"] = arm_l[0]
         doc["eval"][f"{arm}_w0"] = round(held_out(arm_p0), 5)
         doc["eval"][f"{arm}_ratio"] = (
-            round(doc["eval"][f"{arm}_w0"] / ev["ddp"], 5) if ev["ddp"] else None
+            round(doc["eval"][f"{arm}_w0"] / ev_ddp, 5) if ev_ddp else None
         )
+        # arms may be re-banked on a different box than the core verdict
+        # (e.g. the TPU tunnel window vs this CPU host); record where
+        doc.setdefault("arm_platforms", {})[arm] = jax.devices()[0].platform
         doc["ts_end"] = time.time()
         _flush(doc)
         print(
@@ -241,9 +279,19 @@ def main():
 
 
 if __name__ == "__main__":
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument(
+        "--arms", default="all",
+        help="comma list from: " + ",".join(ARMS) + "; 'all' runs the full "
+        "core-verdict + every arm, a subset updates a banked complete "
+        "artifact additively",
+    )
+    cli = ap.parse_args()
     platform = os.environ.get("OPENDILOCO_TPU_PLATFORM")
     if platform:
         import jax
 
         jax.config.update("jax_platforms", platform)
-    main()
+    main(cli.arms)

@@ -65,6 +65,14 @@ def spawn_daemon() -> tuple[subprocess.Popen, str]:
 def spawn_worker(
     rank: int, address: str, log_path: str, trace_dir: str, args
 ) -> subprocess.Popen:
+    stream_cli = (
+        [
+            "--diloco.streaming-fragments", str(args.fragments),
+            "--diloco.overlap-comm", "eager",
+        ]
+        if args.stream
+        else []
+    )
     cli = [
         sys.executable, "-m", "opendiloco_tpu.train",
         "--path-model", args.model,
@@ -88,7 +96,7 @@ def spawn_worker(
         "--diloco.all-reduce-strategy", "no_wait",
         "--diloco.backend", "tcp",
         "--diloco.skip-load-from-peers",
-    ]
+    ] + stream_cli
     return subprocess.Popen(
         cli, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
         env=worker_env(rank, trace_dir), cwd=REPO,
@@ -116,6 +124,17 @@ def _epoch_of(round_id: str) -> int:
     try:
         return int(str(round_id).rsplit("epoch-", 1)[1].split(":")[0])
     except (IndexError, ValueError):
+        return -1
+
+
+def _frag_of(round_id: str) -> int:
+    # "frag3-epoch-7" -> 3; -1 for non-fragment rounds
+    s = str(round_id)
+    if not s.startswith("frag"):
+        return -1
+    try:
+        return int(s.split("-", 1)[0][4:])
+    except ValueError:
         return -1
 
 
@@ -150,6 +169,51 @@ def stage_breakdown(events: list[dict]) -> dict[int, dict[str, float]]:
         elif name == "outer/apply" and "epoch" in args:
             bucket(int(args["epoch"]))["apply"] += ev["dur"] / 1e6
     return {k: v for k, v in per_epoch.items() if k >= 0}
+
+
+def fragment_breakdown(events: list[dict]) -> dict[tuple[int, int], dict]:
+    """One worker's per-(epoch, fragment) streaming-round ledger.
+
+    Launch/land seconds come from the scheduler's training-thread spans
+    (``outer/fragment_launch`` / ``outer/fragment_land``), flight seconds
+    and group size ride the landing's args, and the wire-plane stage
+    seconds come from the fragment round's ``outer/round`` health instant
+    (``frag{k}-epoch-{e}`` round ids).
+    """
+    out: dict[tuple[int, int], dict] = {}
+
+    def slot(epoch: int, frag: int) -> dict:
+        return out.setdefault((epoch, frag), {
+            "launch_s": 0.0, "land_s": 0.0, "flight_s": 0.0,
+            "group_size": 0, "launched": 0, "landed": 0,
+            "encode_s": 0.0, "wire_s": 0.0, "accumulate_s": 0.0,
+        })
+
+    for ev in events:
+        name, args = ev.get("name"), ev.get("args") or {}
+        if name == "outer/fragment_launch":
+            b = slot(int(args["epoch"]), int(args["frag"]))
+            b["launch_s"] += ev["dur"] / 1e6
+            b["launched"] += 1
+        elif name == "outer/fragment_land":
+            b = slot(int(args["epoch"]), int(args["frag"]))
+            b["land_s"] += ev["dur"] / 1e6
+            b["flight_s"] = max(
+                b["flight_s"], float(args.get("landed_s", 0.0))
+            )
+            b["group_size"] = max(b["group_size"], int(args.get("group", 0)))
+            b["landed"] += 1
+        elif name == "outer/round":
+            frag = _frag_of(args.get("round", ""))
+            epoch = _epoch_of(args.get("round", ""))
+            if frag >= 0 and epoch >= 0:
+                b = slot(epoch, frag)
+                b["encode_s"] += float(args.get("encode_s", 0.0))
+                b["wire_s"] += float(args.get("wire_send_s", 0.0)) + float(
+                    args.get("wire_recv_s", 0.0)
+                )
+                b["accumulate_s"] += float(args.get("accumulate_s", 0.0))
+    return out
 
 
 def merge_report(trace_dir: str) -> tuple[dict, dict]:
@@ -207,6 +271,57 @@ def merge_report(trace_dir: str) -> tuple[dict, dict]:
             "per_worker": row["workers"],
         })
 
+    # streaming fragment rounds (frag{k}-epoch-{e}): boundaries broken out
+    # PER FRAGMENT — launch/land training-thread cost, in-flight seconds,
+    # and the wire stages of each fragment's own all-reduce
+    per_frag: dict[tuple[int, int], dict] = {}
+    for wid, events, _meta in workers:
+        for (epoch, frag), b in fragment_breakdown(events).items():
+            row = per_frag.setdefault(
+                (epoch, frag),
+                {
+                    "round": f"frag{frag}-epoch-{epoch}",
+                    "epoch": epoch,
+                    "fragment": frag,
+                    "workers": {},
+                },
+            )
+            row["workers"][str(wid)] = {
+                k: (round(v, 6) if isinstance(v, float) else v)
+                for k, v in b.items()
+            }
+
+    fragments = []
+    for epoch, frag in sorted(per_frag):
+        row = per_frag[(epoch, frag)]
+        ws = list(row["workers"].values())
+
+        def agg(key: str) -> dict:
+            vals = [w[key] for w in ws]
+            return {
+                "mean": round(sum(vals) / len(vals), 6),
+                "max": round(max(vals), 6),
+            }
+
+        fragments.append({
+            "round": row["round"],
+            "epoch": epoch,
+            "fragment": frag,
+            "workers_reporting": len(ws),
+            "group_size": max(w["group_size"] for w in ws),
+            "launched": sum(w["launched"] for w in ws),
+            "landed": sum(w["landed"] for w in ws),
+            "launch_s": agg("launch_s"),
+            "land_s": agg("land_s"),
+            "flight_s": agg("flight_s"),
+            "wire_stages_s": {
+                "encode": agg("encode_s"),
+                "wire": agg("wire_s"),
+                "accumulate": agg("accumulate_s"),
+            },
+            "per_worker": row["workers"],
+        })
+
     counters: dict[str, float] = {}
     for _wid, _events, meta in workers:
         for k, v in (meta.get("counters") or {}).items():
@@ -216,6 +331,7 @@ def merge_report(trace_dir: str) -> tuple[dict, dict]:
         "workers_traced": len(workers),
         "trace_files": [os.path.basename(p) for p in paths],
         "per_round": rounds,
+        **({"per_fragment": fragments} if fragments else {}),
         "counters_total": {k: counters[k] for k in sorted(counters)},
     }
     return body, export.chrome_trace(workers)
@@ -231,6 +347,16 @@ def main() -> int:
     ap.add_argument("--out", default=os.path.join(REPO, "OBS_REPORT.json"))
     ap.add_argument("--trace-out", default=os.path.join(REPO, "OBS_TRACE.json"))
     ap.add_argument("--workdir", default="/tmp/odtp_obs_report")
+    ap.add_argument(
+        "--stream", action="store_true",
+        help="run the galaxy with streaming eager outer sync "
+        "(--diloco.streaming-fragments + overlap_comm=eager) and validate "
+        "the PER-FRAGMENT boundary breakdown instead of the bulk rounds",
+    )
+    ap.add_argument(
+        "--fragments", type=int, default=2,
+        help="with --stream: fragment count for the staggered schedule",
+    )
     ap.add_argument(
         "--selftest", action="store_true",
         help="small galaxy (2 workers, 2 rounds) + hard validation of the "
@@ -289,6 +415,11 @@ def main() -> int:
         "rounds": args.rounds,
         "local_steps": args.local_steps,
         "backend": "tcp",
+        **(
+            {"streaming_fragments": args.fragments, "overlap_comm": "eager"}
+            if args.stream
+            else {}
+        ),
         "stages": list(STAGES),
         "failures": fails,
         **body,
@@ -326,7 +457,33 @@ def main() -> int:
             if missing:
                 ok = False
                 print(f"GAP: round {row['round']} worker {w}: {missing}")
-    if not report["per_round"]:
+    if args.stream:
+        # streaming galaxies have no bulk grads rounds; coverage lives in
+        # the per-fragment ledger instead: every (epoch, fragment) round
+        # traced by every worker, every launch eventually landed
+        frag_rows = report.get("per_fragment") or []
+        seen = {(r["epoch"], r["fragment"]) for r in frag_rows}
+        want = {
+            (e, k) for e in range(args.rounds) for k in range(args.fragments)
+        }
+        missing = sorted(want - seen)
+        if missing:
+            ok = False
+            print(f"GAP: fragment rounds never traced: {missing}")
+        for row in frag_rows:
+            if row["workers_reporting"] < args.workers:
+                ok = False
+                print(
+                    f"GAP: round {row['round']} has "
+                    f"{row['workers_reporting']}/{args.workers} workers"
+                )
+            if row["landed"] < row["launched"]:
+                ok = False
+                print(
+                    f"GAP: round {row['round']} landed "
+                    f"{row['landed']}/{row['launched']} launches"
+                )
+    elif not report["per_round"]:
         ok = False
         print("GAP: no merged rounds")
     if args.selftest:

@@ -336,11 +336,18 @@ def test_streaming_fragments_sync_one_fragment_per_boundary(tiny_cfg):
 def test_streaming_fragments_config_constraints():
     with pytest.raises(Exception, match="allreduce"):
         DilocoConfig(streaming_fragments=2, outer_mode="gossip")
-    with pytest.raises(Exception, match="overlap"):
-        DilocoConfig(streaming_fragments=2, overlap_comm="delayed")
     with pytest.raises(Exception, match="average_state_every"):
         DilocoConfig(streaming_fragments=2, average_state_every=4)
+    with pytest.raises(Exception, match="stream_stagger"):
+        DilocoConfig(stream_stagger=0.0)
+    with pytest.raises(Exception, match="stream_stagger"):
+        DilocoConfig(stream_stagger=1.5)
     DilocoConfig(streaming_fragments=4)  # valid
+    # streaming x overlap composes now (staggered in-phase fragment rounds)
+    DilocoConfig(streaming_fragments=2, overlap_comm="delayed")
+    DilocoConfig(
+        streaming_fragments=4, overlap_comm="eager", stream_stagger=0.5
+    )
 
 
 def test_two_workers_resync_and_learn(tiny_cfg):

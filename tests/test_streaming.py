@@ -1,0 +1,302 @@
+"""Streaming eager outer sync suite (diloco/streaming.py).
+
+Three contracts pinned here:
+
+1. Cross-peer determinism: the fragment launch schedule and the fragment
+   partition are pure functions of shared config, so every peer opens
+   round ``frag{k}-epoch-{e}`` with identical shapes and no coordination.
+
+2. Eager-estimate -> reconcile parity: with ``local_steps=1`` the launch
+   slot coincides with the boundary, and over a single-worker loopback
+   the all-reduce average IS the local pseudo-gradient, so the eager
+   telescoping (``est - boundary`` at launch, ``true - est`` at land)
+   must reproduce the blocking full-sync rewrite exactly (modulo the
+   ~1-ulp-per-round delta-application error the placement suite also
+   tolerates). Checked for BOTH host and device outer placements.
+
+3. Off-path bit-identity: ``streaming_fragments=0`` must leave the
+   blocking path untouched -- no scheduler, no trainer hook, and two
+   identical runs on the same device produce bit-identical losses and
+   masters.
+"""
+
+import threading
+
+import jax
+import numpy as np
+import pytest
+
+from opendiloco_tpu.config import DilocoConfig
+from opendiloco_tpu.diloco import DiLoCoOptimizer, LoopbackWorld
+from opendiloco_tpu.diloco.streaming import launch_schedule
+from opendiloco_tpu.parallel.mesh import build_mesh
+from opendiloco_tpu.trainer import InnerTrainer, TrainerConfig
+
+_next_dev = iter(range(10**9))
+
+
+def make_trainer(tiny_cfg, devices=None):
+    tc = TrainerConfig(
+        lr=1e-3, warmup_steps=2, total_steps=200, precision="fp32", remat=False
+    )
+    if devices is None:
+        # one distinct single-device mesh per trainer (threaded workers on
+        # the CPU client deadlock on concurrent multi-device executions)
+        all_dev = jax.devices()
+        devices = [all_dev[next(_next_dev) % len(all_dev)]]
+    return InnerTrainer(tiny_cfg, tc, build_mesh("NO_SHARD", devices=devices))
+
+
+def batches(seed, vocab, n, global_bs=8, seq=16):
+    rng = np.random.default_rng(seed)
+    for _ in range(n):
+        starts = rng.integers(0, vocab, (global_bs, 1))
+        ids = ((starts + np.arange(seq)) % vocab).astype(np.int32)
+        yield ids, ids.copy()
+
+
+def run_single(
+    tiny_cfg,
+    placement,
+    *,
+    n_steps=6,
+    local_steps=1,
+    overlap="none",
+    frags=0,
+    stagger=1.0,
+    devices=None,
+):
+    trainer = make_trainer(tiny_cfg, devices=devices)
+    state = trainer.init_state(jax.random.key(7))
+    world = LoopbackWorld(1)
+    (backend,) = world.make_backends()
+    cfg = DilocoConfig(
+        local_steps=local_steps,
+        backend="loopback",
+        outer_placement=placement,
+        overlap_comm=overlap,
+        streaming_fragments=frags,
+        stream_stagger=stagger,
+    )
+    opt = DiLoCoOptimizer(trainer, backend, cfg, state, batch_size=8)
+    losses, ms = [], []
+    for ids, labels in batches(0, tiny_cfg.vocab_size, n_steps):
+        state, m = opt.step(state, trainer.shard_batch(ids, labels, accum=1))
+        losses.append(float(m["loss"]))
+        ms.append(m)
+        if opt._stream is not None:
+            # pin the landing schedule: block-land every round before the
+            # next dispatch, so parity vs blocking isn't timing-dependent
+            # (a round landing at the NEXT step's tick lands after that
+            # step already dispatched on pre-round params)
+            state = opt.flush(state)
+    state = opt.flush(state)
+    return losses, state, opt, ms
+
+
+# ---------------------------------------------------------------------------
+# fragment-schedule determinism
+# ---------------------------------------------------------------------------
+
+
+def test_launch_schedule_matches_formula():
+    # stagger=1.0 spreads launches evenly across the phase
+    assert launch_schedule(8, 4, 1.0) == [1, 3, 5, 7]
+    # smaller stagger front-loads (more landing slack per round)
+    assert launch_schedule(8, 4, 0.5) == [1, 2, 3, 4]
+    # degenerate 1-step phase: every fragment launches at the boundary
+    assert launch_schedule(1, 3, 1.0) == [1, 1, 1]
+    # more fragments than steps still clamps into [1, H]
+    assert launch_schedule(2, 5, 1.0) == [1, 1, 1, 2, 2]
+
+
+def test_launch_schedule_pure_and_bounded():
+    for h in (1, 3, 8, 32):
+        for n in (2, 3, 7):
+            for stagger in (0.25, 0.5, 1.0):
+                s = launch_schedule(h, n, stagger)
+                assert s == launch_schedule(h, n, stagger)  # pure
+                assert len(s) == n
+                assert all(1 <= x <= h for x in s)
+                assert s == sorted(s)  # nondecreasing launch clock
+
+
+def test_schedule_and_partition_identical_across_peers(tiny_cfg):
+    """Two independently constructed optimizers (think: two workers that
+    never exchanged a byte) must derive the same schedule and the same
+    leaf->fragment partition -- this is what keys fragment k's all-reduce
+    to the same round on every peer."""
+
+    def build():
+        trainer = make_trainer(tiny_cfg)
+        state = trainer.init_state(jax.random.key(7))
+        world = LoopbackWorld(1)
+        (backend,) = world.make_backends()
+        cfg = DilocoConfig(
+            local_steps=6,
+            backend="loopback",
+            streaming_fragments=3,
+            overlap_comm="eager",
+        )
+        return DiLoCoOptimizer(trainer, backend, cfg, state, batch_size=8)
+
+    a, b = build(), build()
+    assert a._stream is not None and b._stream is not None
+    assert a._stream.schedule == b._stream.schedule
+    assert a._fragments == b._fragments
+    # every leaf appears in exactly one fragment
+    flat = [i for frag in a._fragments for i in frag]
+    assert sorted(flat) == list(range(len(flat)))
+
+
+def test_stream_arming(tiny_cfg):
+    # fragments alone (no overlap) keeps the blocking one-per-boundary path
+    _, _, opt, _ = run_single(tiny_cfg, "host", n_steps=2, frags=2)
+    assert opt._stream is None
+    assert opt.trainer._post_dispatch_hooks == []
+    # fragments x overlap arms the scheduler and registers the hook
+    _, _, opt, ms = run_single(
+        tiny_cfg, "host", n_steps=3, frags=2, overlap="eager"
+    )
+    assert opt._stream is not None
+    assert len(opt.trainer._post_dispatch_hooks) == 1
+    assert opt._stream.schedule == launch_schedule(1, 2, 1.0)
+    # flush landed everything
+    assert opt._stream._inflight == {}
+    # landings surface in the NEXT step's metrics row (the same deferred
+    # consumption the delayed-overlap path uses)
+    assert any(m.get("outer_fragments_landed", 0) >= 1 for m in ms)
+    assert any(m.get("outer_streaming_fragments") == 2 for m in ms)
+
+
+# ---------------------------------------------------------------------------
+# eager-estimate -> reconcile parity vs blocking
+# ---------------------------------------------------------------------------
+#
+# With local_steps=1 the launch slot IS the boundary step, and over a
+# single-worker loopback avg == own pseudo-gradient, so est == true and
+# the telescoped eager rewrite must equal blocking full sync. The only
+# legitimate divergence is the delta application (params += true - b vs
+# the blocking params <- master rewrite): ~1 f32 ulp per round, amplified
+# by the inner AdamW -- same budget the placement suite pins.
+
+_RT, _AT = 1e-5, 1e-6
+
+
+def _masters(opt):
+    return [np.asarray(x) for x in opt.state_dict()["master"]]
+
+
+def _bufs(opt):
+    bufs = opt.state_dict()["outer_opt"]["bufs"]
+    return None if bufs is None else [np.asarray(x) for x in bufs]
+
+
+@pytest.mark.parametrize("placement", ["host", "device"])
+def test_streaming_eager_matches_blocking(tiny_cfg, placement):
+    l_block, _, opt_block, _ = run_single(tiny_cfg, placement, n_steps=6)
+    l_stream, _, opt_stream, _ = run_single(
+        tiny_cfg, placement, n_steps=6, frags=2, overlap="eager"
+    )
+    np.testing.assert_allclose(l_stream, l_block, rtol=_RT, atol=_AT)
+    assert opt_stream.epoch == opt_block.epoch
+    for a, b in zip(_masters(opt_stream), _masters(opt_block)):
+        np.testing.assert_allclose(a, b, rtol=_RT, atol=_AT)
+    ba, bb = _bufs(opt_stream), _bufs(opt_block)
+    assert (ba is None) == (bb is None)
+    if ba is not None:
+        for a, b in zip(ba, bb):
+            np.testing.assert_allclose(a, b, rtol=_RT, atol=_AT)
+
+
+@pytest.mark.parametrize("placement", ["host", "device"])
+def test_streaming_delayed_matches_blocking(tiny_cfg, placement):
+    """Same construction, delayed reconciliation (no eager estimate):
+    land applies true - boundary in one piece."""
+    l_block, _, opt_block, _ = run_single(tiny_cfg, placement, n_steps=4)
+    l_stream, _, opt_stream, _ = run_single(
+        tiny_cfg, placement, n_steps=4, frags=2, overlap="delayed"
+    )
+    np.testing.assert_allclose(l_stream, l_block, rtol=_RT, atol=_AT)
+    for a, b in zip(_masters(opt_stream), _masters(opt_block)):
+        np.testing.assert_allclose(a, b, rtol=_RT, atol=_AT)
+
+
+def test_two_worker_masters_converge_identically(tiny_cfg):
+    """Cross-peer contract on a real 2-worker galaxy: each fragment round
+    averages the SAME pair of pseudo-gradients on both workers, so the
+    master trajectories must agree bit-for-bit-ish even though the inner
+    data streams differ."""
+    world = LoopbackWorld(2)
+    backends = world.make_backends()
+    results = [None, None]
+    errors = []
+    barrier = threading.Barrier(2)
+
+    def worker(rank):
+        try:
+            trainer = make_trainer(tiny_cfg)
+            state = trainer.init_state(jax.random.key(7))
+            cfg = DilocoConfig(
+                local_steps=3,
+                backend="loopback",
+                streaming_fragments=2,
+                overlap_comm="eager",
+                timeout_waiting_for_peers=60.0,
+                averaging_timeout=120.0,
+            )
+            opt = DiLoCoOptimizer(
+                trainer, backends[rank], cfg, state, batch_size=8
+            )
+            barrier.wait(timeout=60)
+            metrics = {}
+            for ids, labels in batches(100 + rank, tiny_cfg.vocab_size, 9):
+                state, m = opt.step(
+                    state, trainer.shard_batch(ids, labels, accum=1)
+                )
+                metrics = m
+            state = opt.flush(state)
+            results[rank] = (_masters(opt), opt._stream.schedule, metrics)
+        except Exception as e:  # pragma: no cover - surfaced below
+            errors.append(f"worker {rank}: {e!r}")
+            try:
+                barrier.abort()
+            except Exception:
+                pass
+
+    threads = [threading.Thread(target=worker, args=(r,)) for r in (0, 1)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=300)
+    assert not errors, errors
+    (m0, sched0, metrics0), (m1, sched1, _) = results
+    assert sched0 == sched1
+    assert metrics0.get("outer_streaming_fragments", 0) == 2 or (
+        "outer_fragments_landed" in metrics0
+    )
+    for a, b in zip(m0, m1):
+        np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-7)
+
+
+# ---------------------------------------------------------------------------
+# off-path bit-identity
+# ---------------------------------------------------------------------------
+
+
+def test_streaming_off_path_bit_identity(tiny_cfg):
+    """streaming_fragments=0 must leave the blocking path bit-identical:
+    the hook registry stays empty and two identical runs on the SAME
+    device reproduce each other exactly."""
+    dev = [jax.devices()[0]]
+    l1, _, opt1, _ = run_single(
+        tiny_cfg, "host", n_steps=5, local_steps=2, devices=dev
+    )
+    l2, _, opt2, _ = run_single(
+        tiny_cfg, "host", n_steps=5, local_steps=2, devices=dev
+    )
+    assert opt1._stream is None and opt2._stream is None
+    assert opt1.trainer._post_dispatch_hooks == []
+    assert l1 == l2  # exact float equality, not allclose
+    for a, b in zip(_masters(opt1), _masters(opt2)):
+        np.testing.assert_array_equal(a, b)
