@@ -194,6 +194,51 @@ class DilocoConfig(BaseModel):
         return v
 
 
+class ServeConfig(BaseModel):
+    """In-process serving plane (opendiloco_tpu/serve): continuous-batching
+    inference over the live master weights while training runs."""
+
+    model_config = ConfigDict(extra="forbid")
+
+    enabled: bool = False
+    host: str = "127.0.0.1"
+    port: int = 0  # 0 -> ephemeral; collisions downgrade to ephemeral
+    # continuous-batching geometry
+    max_batch: int = 8  # decode slots (concurrent sequences)
+    max_context: int = 1024  # per-slot ring KV page; longer sequences slide
+    # prefill compile-size buckets (prompts pad up to the smallest fit;
+    # prompts beyond the largest bucket are rejected, not truncated)
+    prefill_buckets: list[int] = [64, 256, 1024]
+    max_queue: int = 1024  # backpressure: submits beyond this are rejected
+    # weight hot-swap policy: check every N decode steps; swap when the
+    # serving weights lag the trainer's masters by MORE than
+    # max_stale_rounds outer rounds (0 = adopt every new round)
+    swap_every_steps: int = 16
+    max_stale_rounds: int = 0
+
+    @field_validator("prefill_buckets", mode="before")
+    @classmethod
+    def _coerce_buckets(cls, v: Any) -> Any:
+        if isinstance(v, str):
+            return [int(x) for x in v.split(",") if x.strip()]
+        return v
+
+    @model_validator(mode="after")
+    def _geometry(self):
+        if self.max_batch < 1:
+            raise ValueError("serve.max_batch must be >= 1")
+        if not self.prefill_buckets:
+            raise ValueError("serve.prefill_buckets must be non-empty")
+        if min(self.prefill_buckets) < 1:
+            raise ValueError("serve.prefill_buckets must be positive")
+        if max(self.prefill_buckets) > self.max_context:
+            raise ValueError(
+                "largest prefill bucket exceeds serve.max_context "
+                "(a prompt must fit its slot's KV page)"
+            )
+        return self
+
+
 class Config(BaseModel):
     """Top-level training config (reference: open_diloco/train_fsdp.py:104-129)."""
 
@@ -279,6 +324,8 @@ class Config(BaseModel):
 
     ckpt: CkptConfig = CkptConfig()
     diloco: Optional[DilocoConfig] = None  # None -> plain data-parallel mode
+    # in-process serving plane; None or enabled=False -> training only
+    serve: Optional[ServeConfig] = None
 
     @field_validator("adam_betas", mode="before")
     @classmethod

@@ -426,6 +426,68 @@ class DiLoCoOptimizer:
             },
         }
 
+    # ------------------------------------------------------------------
+    # serve-plane snapshot export (opendiloco_tpu/serve weight hot-swap)
+    # ------------------------------------------------------------------
+
+    def master_snapshot(
+        self, wire_dtype: Optional[str] = None
+    ) -> tuple[int, list[np.ndarray]]:
+        """(epoch, master leaves) for the in-process serving plane — the
+        weights-only sibling of ``_state_for_peers``: same epoch-consistency
+        rules (pending / blocking rounds serve the pre-round snapshot), no
+        momentum fetch, no array copies on the host path (mutators rebind,
+        so captured references stay bit-stable).
+
+        Device placement fetches under ``plane.lock``; ``wire_dtype``
+        (plain-fp16 state codec only) narrows inside jit so the D2H copy
+        moves half-width bytes."""
+        plane = self._plane
+        if plane is None:
+            with self._serve_lock:
+                master, epoch, _ = self._state_refs_unlocked()
+            return epoch, list(master)
+        # mirror _device_state_for_peers: the pre-published host snapshot
+        # is served under _serve_lock alone so a swap pull never stalls
+        # behind a blocking outer round's WAN leg
+        with self._serve_lock:
+            snap = self._blocking_snap
+            if snap is not None:
+                return snap["epoch"], [np.asarray(m) for m in snap["master"]]
+        with plane.lock:
+            with self._serve_lock:
+                p = self._pending
+                if p is not None and "plane_pre" in p:
+                    m_refs, _ = p["plane_pre"]
+                    epoch = p["epoch"]
+                else:
+                    m_refs, epoch = plane.masters, self.epoch
+            masters = plane.host_masters(m_refs, wire_dtype=wire_dtype)
+        return epoch, masters
+
+    def master_snapshot_wire(self) -> tuple[int, list[tuple], str]:
+        """Codec-encoded master snapshot: (epoch, blobs, codec_name) with
+        ``blobs[i] = (payload, meta, shape)`` per master leaf in params
+        flatten order — the serve engine's hot-swap feed.
+
+        Reuses the onboarding ``state_codec`` (fp16 by default,
+        ``ODTP_STATE_CODEC`` overrides) so a swap transfer moves
+        half-width bytes, and the device plane pre-casts the D2H fetch to
+        wire width when the codec's encode is idempotent under it."""
+        from opendiloco_tpu.diloco.compression import device_wire_dtype, get_codec
+        from opendiloco_tpu.diloco.tcp import state_codec
+
+        codec = state_codec(get_codec(self.cfg.compression))
+        epoch, masters = self.master_snapshot(
+            wire_dtype=device_wire_dtype(codec.name)
+        )
+        blobs = []
+        for m in masters:
+            flat = np.ascontiguousarray(m).reshape(-1)
+            payload, meta = codec.encode(flat)
+            blobs.append((payload, meta, tuple(m.shape)))
+        return epoch, blobs, codec.name
+
     def _broadcast_remote_state(self, remote: Optional[dict]) -> Optional[dict]:
         """Fan a fetched swarm state from the messenger to every process of
         the slice (collective: all processes call, followers pass None).

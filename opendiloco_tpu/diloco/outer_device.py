@@ -222,6 +222,14 @@ def _overwrite_fused(masters, params):
     return [m + jnp.zeros((), m.dtype) for m in masters]
 
 
+@functools.partial(jax.jit, static_argnames=("dtype",))
+def _cast_fused(leaves, dtype):
+    # wire-width pre-cast for masters-only host fetches (serve snapshots):
+    # fresh buffers by construction (astype materializes), nothing donates
+    # them, so the fetched host views can stay zero-copy
+    return [x.astype(dtype) for x in leaves]
+
+
 @jax.jit
 def _copy_fused(leaves):
     # fresh buffers (see _overwrite_fused for why the add-zero matters)
@@ -595,6 +603,30 @@ class DeviceOuterPlane:
             m = jax.device_get(masters)
             b = jax.device_get(bufs) if bufs else None
         return [_own(x) for x in m], (None if b is None else [_own(x) for x in b])
+
+    def host_masters(
+        self,
+        refs: Optional[list] = None,
+        wire_dtype: Optional[str] = None,
+    ) -> list[np.ndarray]:
+        """Masters-only host fetch for the serve plane's weight hot-swap.
+
+        With ``wire_dtype`` (``"float16"`` when the state codec is plain
+        fp16 — see ``compression.device_wire_dtype`` for why only the
+        idempotent cast qualifies) the narrowing runs INSIDE jit, so the
+        D2H boundary copy moves half-width bytes and the returned host
+        arrays are f16 for the codec to pass through. Without it this is
+        ``host_state`` minus the momentum fetch. Lock held across the
+        whole fetch for the same donation-race reason as host_state."""
+        with self.lock:
+            masters = list(refs) if refs is not None else self.masters
+            if wire_dtype is not None:
+                masters = _cast_fused(masters, jnp.dtype(wire_dtype))
+                # the cast outputs are private buffers nothing ever
+                # donates; a zero-copy device_get view is safe to hand out
+                return [np.asarray(x) for x in jax.device_get(masters)]
+            fetched = jax.device_get(masters)
+        return [_own(x) for x in fetched]
 
     def load(
         self,

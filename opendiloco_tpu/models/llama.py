@@ -26,7 +26,7 @@ from typing import Any, Literal, Optional, Union
 import jax
 import jax.numpy as jnp
 
-from opendiloco_tpu.ops.attention import xla_attention
+from opendiloco_tpu.ops.attention import decode_attention, xla_attention
 
 
 @dataclasses.dataclass(frozen=True)
@@ -464,6 +464,173 @@ def forward(
     if return_moe_aux:
         return logits, moe_aux
     return logits
+
+
+# ---------------------------------------------------------------------------
+# serving: prefill / incremental decode over a slot-paged ring KV cache
+# (opendiloco_tpu/serve). Dense stacks only — routed-expert decode would
+# need capacity bookkeeping per step and no serving config uses MoE yet.
+# ---------------------------------------------------------------------------
+
+
+def init_kv_cache(
+    cfg: LlamaConfig,
+    num_slots: int,
+    max_context: int,
+    dtype: jnp.dtype = jnp.bfloat16,
+) -> dict:
+    """Zeroed {"k","v"} cache pages [L, S, T, Nkv, Dh]: one fixed-size ring
+    page per batch slot (the degenerate paged layout — page size == slot
+    context). Writes wrap at T, so a sequence that outgrows its page keeps
+    decoding with sliding-window attention over the last T tokens."""
+    shape = (
+        cfg.num_hidden_layers,
+        num_slots,
+        max_context,
+        cfg.kv_heads,
+        cfg.head_dim,
+    )
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+def _require_dense(cfg: LlamaConfig, what: str) -> None:
+    if cfg.num_experts:
+        raise NotImplementedError(f"{what} supports dense FFN stacks only")
+
+
+def prefill_forward(
+    params: dict,
+    input_ids: jax.Array,
+    length: jax.Array,
+    cfg: LlamaConfig,
+    *,
+    compute_dtype: jnp.dtype = jnp.bfloat16,
+):
+    """Prompt prefill for serving: ids [1, P] -> (last-token logits [1, V]
+    f32, per-layer K/V [L, P, Nkv, Dh] in compute dtype).
+
+    ``length`` (traced scalar) is the true prompt length; ``input_ids``
+    may be right-padded to a compile-size bucket. Padding K/V rows do land
+    in the returned stack (and hence the cache) but are never attended:
+    the decode mask stops at the live length and every ring write
+    overwrites index ``len % T`` before index ``len`` becomes visible."""
+    _require_dense(cfg, "prefill_forward")
+    B, P = input_ids.shape
+    Nh, Nkv, Dh = cfg.num_attention_heads, cfg.kv_heads, cfg.head_dim
+    positions = jnp.broadcast_to(jnp.arange(P, dtype=jnp.int32), (B, P))
+    cparams = jax.tree.map(lambda x: x.astype(compute_dtype), params)
+    cos, sin = _rope_tables(positions, Dh, cfg.rope_theta)
+
+    def block(h, layer):
+        x = _rms_norm(h, layer["input_norm"], cfg.rms_norm_eps)
+        q = (x @ layer["q_proj"]).reshape(B, P, Nh, Dh)
+        k = (x @ layer["k_proj"]).reshape(B, P, Nkv, Dh)
+        v = (x @ layer["v_proj"]).reshape(B, P, Nkv, Dh)
+        q = _rope_apply(q, cos, sin)
+        k = _rope_apply(k, cos, sin)
+        attn = xla_attention(q, k, v, causal=True)
+        h = h + attn.reshape(B, P, Nh * Dh) @ layer["o_proj"]
+        x = _rms_norm(h, layer["post_attn_norm"], cfg.rms_norm_eps)
+        ffn = (
+            jax.nn.silu(x @ layer["gate_proj"]) * (x @ layer["up_proj"])
+        ) @ layer["down_proj"]
+        return h + ffn, (k[0], v[0])
+
+    h = jnp.take(cparams["embed_tokens"], input_ids, axis=0)
+    h, (ks, vs) = jax.lax.scan(block, h, cparams["layers"])
+    h_last = jax.lax.dynamic_slice_in_dim(h, length - 1, 1, axis=1)
+    h_last = _rms_norm(h_last, cparams["final_norm"], cfg.rms_norm_eps)
+    head = (
+        cparams["embed_tokens"].T
+        if cfg.tie_word_embeddings
+        else cparams["lm_head"]
+    )
+    logits = (h_last @ head).astype(jnp.float32)
+    return logits[:, 0], ks, vs
+
+
+def cache_insert(
+    cache_k: jax.Array,
+    cache_v: jax.Array,
+    ks: jax.Array,
+    vs: jax.Array,
+    slot: jax.Array,
+) -> tuple[jax.Array, jax.Array]:
+    """Write a prefilled sequence's K/V [L, P, Nkv, Dh] into ``slot``
+    (traced scalar) of the cache [L, S, T, Nkv, Dh] at ring positions
+    [0, P). Stale entries from a previous tenant beyond P stay masked
+    until decode's per-step ring write overwrites them."""
+    L, P = ks.shape[0], ks.shape[1]
+    if P > cache_k.shape[2]:
+        raise ValueError(
+            f"prefill length {P} exceeds slot context {cache_k.shape[2]}"
+        )
+    zero = jnp.int32(0)
+    start = (zero, jnp.asarray(slot, jnp.int32), zero, zero, zero)
+    ck = jax.lax.dynamic_update_slice(cache_k, ks[:, None].astype(cache_k.dtype), start)
+    cv = jax.lax.dynamic_update_slice(cache_v, vs[:, None].astype(cache_v.dtype), start)
+    return ck, cv
+
+
+def decode_forward(
+    params: dict,
+    tokens: jax.Array,
+    lens: jax.Array,
+    cache_k: jax.Array,
+    cache_v: jax.Array,
+    cfg: LlamaConfig,
+    *,
+    compute_dtype: jnp.dtype = jnp.bfloat16,
+):
+    """One incremental decode step over all S slots.
+
+    tokens [S] int32 are each slot's current input token; lens [S] int32
+    are the token counts already cached (== the new token's absolute
+    position); cache_{k,v} are [L, S, T, Nkv, Dh]. Returns (logits [S, V]
+    f32, new_cache_k, new_cache_v): the new K/V is written at ring index
+    ``lens % T`` and attention covers the last ``min(lens + 1, T)``
+    positions. Callers jit this with the caches donated — the cache
+    update is in-place at HBM, never a fresh page copy."""
+    _require_dense(cfg, "decode_forward")
+    S = tokens.shape[0]
+    L, _, T, Nkv, Dh = cache_k.shape
+    Nh = cfg.num_attention_heads
+    cparams = jax.tree.map(lambda x: x.astype(compute_dtype), params)
+    positions = lens[:, None].astype(jnp.int32)  # [S, 1]
+    cos, sin = _rope_tables(positions, Dh, cfg.rope_theta)
+    rows = jnp.arange(S)
+    write_idx = jnp.mod(lens, T)
+
+    def block(h, xs):
+        layer, ck, cv = xs  # ck/cv [S, T, Nkv, Dh]
+        x = _rms_norm(h, layer["input_norm"], cfg.rms_norm_eps)
+        q = (x @ layer["q_proj"]).reshape(S, 1, Nh, Dh)
+        k = (x @ layer["k_proj"]).reshape(S, 1, Nkv, Dh)
+        v = (x @ layer["v_proj"]).reshape(S, 1, Nkv, Dh)
+        q = _rope_apply(q, cos, sin)
+        k = _rope_apply(k, cos, sin)
+        ck = ck.at[rows, write_idx].set(k[:, 0].astype(ck.dtype))
+        cv = cv.at[rows, write_idx].set(v[:, 0].astype(cv.dtype))
+        attn = decode_attention(q[:, 0], ck, cv, lens)
+        h = h + attn.reshape(S, 1, Nh * Dh) @ layer["o_proj"]
+        x = _rms_norm(h, layer["post_attn_norm"], cfg.rms_norm_eps)
+        ffn = (
+            jax.nn.silu(x @ layer["gate_proj"]) * (x @ layer["up_proj"])
+        ) @ layer["down_proj"]
+        return h + ffn, (ck, cv)
+
+    h = jnp.take(cparams["embed_tokens"], tokens, axis=0)[:, None]  # [S, 1, D]
+    h, (new_ck, new_cv) = jax.lax.scan(
+        block, h, (cparams["layers"], cache_k, cache_v)
+    )
+    h = _rms_norm(h, cparams["final_norm"], cfg.rms_norm_eps)
+    head = (
+        cparams["embed_tokens"].T
+        if cfg.tie_word_embeddings
+        else cparams["lm_head"]
+    )
+    logits = (h @ head).astype(jnp.float32)
+    return logits[:, 0], new_ck, new_cv
 
 
 def causal_lm_loss(

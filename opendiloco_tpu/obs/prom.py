@@ -5,11 +5,23 @@ tracer snapshot rendered by :func:`export.prometheus_text`. Started
 only from ``Tracer.__init__`` when both ``ODTP_OBS`` and
 ``ODTP_OBS_PROM_PORT`` are set — with the plane disarmed no socket is
 ever bound.
+
+One registry per process: the tracer is process-wide, so trainer metrics
+and the serve plane's gauges (serve_p50_ms, serve_tokens_per_s, ...)
+come out of the SAME snapshot on the SAME endpoint — the serve plane
+calls :func:`get_or_start` rather than binding a second port. A
+requested port that is already taken (e.g. serve.port colliding with
+``ODTP_OBS_PROM_PORT`` when both are enabled) downgrades to an ephemeral
+port with a warning instead of killing the process; the bound port is
+always ``PromServer.port``.
 """
 from __future__ import annotations
 
+import logging
 import socket
 import threading
+
+log = logging.getLogger(__name__)
 
 
 class PromServer:
@@ -17,7 +29,18 @@ class PromServer:
         self._tracer = tracer
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self._sock.bind(("0.0.0.0", port))
+        try:
+            self._sock.bind(("0.0.0.0", port))
+        except OSError as e:
+            if port == 0:
+                raise
+            log.warning(
+                "prometheus port %d unavailable (%s); "
+                "falling back to an ephemeral port",
+                port,
+                e,
+            )
+            self._sock.bind(("0.0.0.0", 0))
         self._sock.listen(8)
         self.port = self._sock.getsockname()[1]
         self._stop = threading.Event()
@@ -66,3 +89,14 @@ class PromServer:
 
 def start(port: int, tracer) -> PromServer:
     return PromServer(port, tracer)
+
+
+def get_or_start(port: int, tracer) -> PromServer:
+    """The process's single metrics endpoint: reuse the tracer's already-
+    bound server when there is one (its snapshot covers every subsystem's
+    gauges — one registry), else bind now and attach it to the tracer so
+    later callers converge on the same instance."""
+    if tracer.prom is not None:
+        return tracer.prom
+    tracer.prom = start(port, tracer)
+    return tracer.prom

@@ -64,6 +64,39 @@ def xla_attention(
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
+def decode_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    lens: jax.Array,
+) -> jax.Array:
+    """Single-token decode attention over a slot-paged ring KV cache.
+
+    q [S, H, D] is the current token per slot; k/v [S, T, Kh, D] are the
+    cache pages; lens [S] int32 is each slot's token count BEFORE this
+    step (== the current token's absolute position; its K/V has already
+    been written at ring index ``lens % T``). Valid cache entries are
+    indices <= lens until the sequence outgrows the page, after which the
+    whole ring is live (sliding-window attention over the last T tokens).
+
+    Math matches :func:`xla_attention` row-for-row — f32 scores/softmax,
+    probabilities cast back to q.dtype — so incremental decode reproduces
+    the training-mode forward (pinned by tests/test_serve.py).
+    """
+    s, t, nkv, d = k.shape
+    h = q.shape[1]
+    k = _repeat_kv(k, h)
+    v = _repeat_kv(v, h)
+    scale = d**-0.5
+    scores = jnp.einsum("shd,sthd->sht", q, k, preferred_element_type=jnp.float32)
+    scores = scores * scale
+    idx = jax.lax.broadcasted_iota(jnp.int32, (s, t), 1)
+    valid = (idx <= lens[:, None]) | (lens[:, None] >= t)
+    scores = jnp.where(valid[:, None, :], scores, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("sht,sthd->shd", probs, v)
+
+
 @functools.partial(jax.jit, static_argnames=("impl", "causal"))
 def attention(
     q: jax.Array,

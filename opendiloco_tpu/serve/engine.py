@@ -1,0 +1,208 @@
+"""Jitted inference engine: prefill, batched incremental decode, weight
+hot-swap.
+
+The engine owns its OWN device copy of the weights plus the slot-paged
+ring KV cache, and exposes exactly three device operations to the
+scheduler loop — ``admit`` (prefill a prompt into a free slot),
+``decode_step`` (one token for every live slot), and ``maybe_swap``
+(adopt a newer master snapshot from the outer plane). All three are
+called from a single scheduler thread; the engine is deliberately not
+thread-safe so the jits can donate the cache buffers without a lock.
+
+Hot-swap pulls codec-encoded snapshots (``DiLoCoOptimizer.
+master_snapshot_wire``, the fp16 ``ODTP_STATE_CODEC`` path) and rebinds
+``self.params`` between decode steps. The KV cache is untouched by
+design: cached K/V stays consistent with the weights that produced it,
+which is the standard serving trade for not re-prefilling every live
+request on each outer round — and the staleness knob bounds how far the
+weights may lag (DiLoCo-fresh serving, arXiv 2311.08105).
+"""
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from opendiloco_tpu import obs
+from opendiloco_tpu.diloco.compression import get_codec
+from opendiloco_tpu.models.llama import (
+    LlamaConfig,
+    cache_insert,
+    decode_forward,
+    init_kv_cache,
+    prefill_forward,
+)
+from opendiloco_tpu.serve.kvcache import pick_bucket
+
+
+@jax.jit
+def _fresh_copy(leaves):
+    # fresh f32 buffers: the caller may pass live train-state leaves that
+    # the next train_step donates (same add-zero idiom as the outer plane)
+    return [x.astype(jnp.float32) + jnp.zeros((), jnp.float32) for x in leaves]
+
+
+# snapshot_fn contract: () -> (epoch, blobs, codec_name) with blobs[i] =
+# (payload, meta, shape) per master leaf in params-flatten order — exactly
+# what DiLoCoOptimizer.master_snapshot_wire returns.
+SnapshotFn = Callable[[], tuple]
+
+
+class ServeEngine:
+    def __init__(
+        self,
+        cfg: LlamaConfig,
+        params,
+        *,
+        num_slots: int = 8,
+        max_context: int = 512,
+        prefill_buckets: Sequence[int] = (32, 128, 512),
+        compute_dtype=jnp.bfloat16,
+        epoch: int = 0,
+        snapshot_fn: Optional[SnapshotFn] = None,
+        epoch_fn: Optional[Callable[[], int]] = None,
+        max_stale_rounds: int = 0,
+    ):
+        self.cfg = cfg
+        self.num_slots = int(num_slots)
+        self.max_context = int(max_context)
+        self.compute_dtype = compute_dtype
+        self.prefill_buckets = sorted(
+            min(int(b), self.max_context) for b in prefill_buckets
+        )
+        self.snapshot_fn = snapshot_fn
+        self.epoch_fn = epoch_fn
+        self.max_stale_rounds = int(max_stale_rounds)
+
+        leaves, self._treedef = jax.tree.flatten(params)
+        self._shapes = [tuple(x.shape) for x in leaves]
+        self.params = jax.tree.unflatten(self._treedef, _fresh_copy(leaves))
+        self.weights_epoch = int(epoch)
+        self.swap_count = 0
+        self.swap_seconds = 0.0
+
+        cache = init_kv_cache(cfg, self.num_slots, self.max_context, compute_dtype)
+        self.cache_k, self.cache_v = cache["k"], cache["v"]
+
+        cd = compute_dtype
+
+        def _prefill(p, ids, length):
+            logits, ks, vs = prefill_forward(p, ids, length, cfg, compute_dtype=cd)
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32), logits, ks, vs
+
+        def _insert(ck, cv, ks, vs, slot):
+            return cache_insert(ck, cv, ks, vs, slot)
+
+        def _decode(p, tokens, lens, ck, cv):
+            logits, ck, cv = decode_forward(
+                p, tokens, lens, ck, cv, cfg, compute_dtype=cd
+            )
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32), logits, ck, cv
+
+        # one compile per prompt bucket; insert/decode compile once
+        self._prefill = jax.jit(_prefill)
+        self._insert = jax.jit(_insert, donate_argnums=(0, 1))
+        self._decode = jax.jit(_decode, donate_argnums=(3, 4))
+
+    # -- admission ---------------------------------------------------------
+
+    def admit(self, slot: int, prompt: Sequence[int]) -> tuple[int, np.ndarray]:
+        """Prefill ``prompt`` into ``slot`` and return (first greedy token,
+        last-position logits [V] f32). The prompt must fit a compile
+        bucket (scheduler-enforced via ``prompt_fits``)."""
+        n = len(prompt)
+        bucket = pick_bucket(n, self.prefill_buckets)
+        if bucket is None:
+            raise ValueError(
+                f"prompt length {n} exceeds max bucket "
+                f"{self.prefill_buckets[-1]}"
+            )
+        ids = np.zeros((1, bucket), np.int32)
+        ids[0, :n] = np.asarray(prompt, np.int32)
+        tok, logits, ks, vs = self._prefill(
+            self.params, jnp.asarray(ids), jnp.int32(n)
+        )
+        self.cache_k, self.cache_v = self._insert(
+            self.cache_k, self.cache_v, ks, vs, jnp.int32(slot)
+        )
+        return int(tok[0]), np.asarray(logits[0])
+
+    def prompt_fits(self, n: int) -> bool:
+        return pick_bucket(n, self.prefill_buckets) is not None
+
+    # -- decode ------------------------------------------------------------
+
+    def decode_step(
+        self, tokens: np.ndarray, lens: np.ndarray
+    ) -> tuple[np.ndarray, jax.Array]:
+        """One greedy token per slot. ``tokens``/``lens`` are dense [S]
+        host arrays (inactive slots pass 0s; their ring writes land in
+        masked positions and are overwritten on the slot's next tenancy).
+        Returns (next tokens [S] np.int32, logits [S, V] on device)."""
+        tok, logits, self.cache_k, self.cache_v = self._decode(
+            self.params,
+            jnp.asarray(tokens, jnp.int32),
+            jnp.asarray(lens, jnp.int32),
+            self.cache_k,
+            self.cache_v,
+        )
+        return np.asarray(tok), logits
+
+    # -- weight hot-swap ---------------------------------------------------
+
+    def staleness(self) -> int:
+        """Outer rounds the serving weights lag the trainer's masters."""
+        if self.epoch_fn is None:
+            return 0
+        return max(0, int(self.epoch_fn()) - self.weights_epoch)
+
+    def maybe_swap(self) -> bool:
+        """Adopt the trainer's current master snapshot when staleness
+        exceeds ``max_stale_rounds``. Called between decode steps, so no
+        request is ever mid-forward across a rebind; the KV cache is not
+        touched (pinned by tests/test_serve.py)."""
+        if self.snapshot_fn is None:
+            return False
+        if self.staleness() <= self.max_stale_rounds:
+            return False
+        t0 = time.perf_counter()
+        epoch, blobs, codec_name = self.snapshot_fn()
+        if epoch <= self.weights_epoch:
+            return False  # raced an in-flight round; keep current weights
+        self.install_wire(epoch, blobs, codec_name)
+        dt = time.perf_counter() - t0
+        self.swap_seconds += dt
+        obs.count("serve_weight_swaps")
+        obs.gauge("serve_last_swap_ms", dt * 1e3)
+        return True
+
+    def install_wire(self, epoch: int, blobs, codec_name: str) -> None:
+        """Decode a codec-encoded master snapshot and rebind the weights."""
+        codec = get_codec(codec_name)
+        if len(blobs) != len(self._shapes):
+            raise ValueError(
+                f"snapshot has {len(blobs)} leaves, engine expects "
+                f"{len(self._shapes)}"
+            )
+        leaves = []
+        for (payload, meta, shape), want in zip(blobs, self._shapes):
+            if tuple(shape) != want:
+                raise ValueError(f"snapshot leaf shape {shape} != {want}")
+            size = int(np.prod(shape)) if shape else 1
+            a = np.asarray(
+                codec.decode(payload, (size,), meta), np.float32
+            ).reshape(shape)
+            leaves.append(jax.device_put(a))
+        self.params = jax.tree.unflatten(self._treedef, leaves)
+        self.weights_epoch = int(epoch)
+        self.swap_count += 1
+
+    def install_params(self, epoch: int, params) -> None:
+        """Direct (uncompressed) rebind — tests and static-weight mode."""
+        leaves = jax.tree.leaves(params)
+        self.params = jax.tree.unflatten(self._treedef, _fresh_copy(leaves))
+        self.weights_epoch = int(epoch)
+        self.swap_count += 1

@@ -1,0 +1,58 @@
+"""Slot-paged ring KV cache bookkeeping for the serve plane.
+
+The device arrays live in ``models.llama.init_kv_cache`` ([L, S, T, Nkv,
+Dh]: one fixed ring page per batch slot); this module owns the host-side
+bookkeeping — which slots are free, which compile-size bucket a prompt
+pads to — so the engine's jitted ops see only dense arrays and traced
+scalars.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+
+class SlotAllocator:
+    """Free-list over the cache's S batch slots.
+
+    Continuous batching needs nothing fancier: a finished sequence frees
+    its slot between decode steps and the next queued prompt claims it
+    immediately; the page is reused in place (stale entries are masked
+    until the new tenant's writes reach them — see llama.cache_insert).
+    """
+
+    def __init__(self, num_slots: int):
+        if num_slots < 1:
+            raise ValueError(f"need at least one slot, got {num_slots}")
+        self.num_slots = num_slots
+        # pop() takes from the tail, so keep ascending order reversed:
+        # slot 0 is handed out first (stable slot ids make tests readable)
+        self._free = list(range(num_slots))[::-1]
+
+    def alloc(self) -> Optional[int]:
+        """Claim a slot, or None when the batch is full."""
+        return self._free.pop() if self._free else None
+
+    def free(self, slot: int) -> None:
+        if not 0 <= slot < self.num_slots:
+            raise ValueError(f"slot {slot} out of range")
+        if slot in self._free:
+            raise ValueError(f"slot {slot} double-freed")
+        self._free.append(slot)
+
+    @property
+    def num_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def num_active(self) -> int:
+        return self.num_slots - len(self._free)
+
+
+def pick_bucket(n: int, buckets: Sequence[int]) -> Optional[int]:
+    """Smallest prefill compile bucket that fits an n-token prompt, or
+    None when the prompt exceeds every bucket (the scheduler rejects it
+    rather than compiling an unbounded family of prefill programs)."""
+    for b in sorted(buckets):
+        if n <= b:
+            return b
+    return None

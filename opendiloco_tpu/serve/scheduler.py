@@ -1,0 +1,330 @@
+"""Continuous-batching scheduler: request queue + the decode loop.
+
+One daemon thread owns the engine and runs the classic continuous-
+batching cycle — retire finished sequences (slots free immediately),
+admit queued prompts into free slots (prefill joins them to the running
+batch), take one decode step for every live slot, and between decode
+steps give the engine a chance to hot-swap weights. Requests are queued
+by any thread via :meth:`ContinuousBatcher.submit` and signal completion
+through a per-request event; nothing is ever dropped by the scheduler —
+a request either completes, is rejected at submit time (prompt too long
+/ queue full), or is failed explicitly when the server is torn down
+mid-flight.
+"""
+from __future__ import annotations
+
+import collections
+import dataclasses
+import threading
+import time
+from typing import Optional, Sequence
+
+import numpy as np
+
+from opendiloco_tpu import obs
+from opendiloco_tpu.serve.engine import ServeEngine
+from opendiloco_tpu.serve.kvcache import SlotAllocator
+
+
+@dataclasses.dataclass
+class Request:
+    prompt: list
+    max_new_tokens: int
+    eos_id: Optional[int] = None
+    id: int = 0
+    t_submit: float = 0.0
+    t_first: Optional[float] = None
+    t_done: Optional[float] = None
+    tokens: list = dataclasses.field(default_factory=list)
+    error: Optional[str] = None
+    epoch: Optional[int] = None  # weights epoch that finished the request
+    _done: threading.Event = dataclasses.field(default_factory=threading.Event)
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self._done.wait(timeout)
+
+    def finish(self, error: Optional[str] = None) -> None:
+        self.error = error
+        self.t_done = time.perf_counter()
+        self._done.set()
+
+    @property
+    def latency_s(self) -> Optional[float]:
+        return None if self.t_done is None else self.t_done - self.t_submit
+
+    @property
+    def ttft_s(self) -> Optional[float]:
+        return None if self.t_first is None else self.t_first - self.t_submit
+
+
+@dataclasses.dataclass
+class _Slot:
+    req: Request
+    cache_len: int  # tokens in the ring page (absolute position of next write)
+    last_token: int
+
+
+class ContinuousBatcher:
+    def __init__(
+        self,
+        engine: ServeEngine,
+        *,
+        max_queue: int = 1024,
+        swap_every_steps: int = 16,
+        gauge_every_steps: int = 32,
+    ):
+        self.engine = engine
+        self.max_queue = int(max_queue)
+        self.swap_every_steps = max(1, int(swap_every_steps))
+        self.gauge_every_steps = max(1, int(gauge_every_steps))
+        self.slots = SlotAllocator(engine.num_slots)
+        self._active: dict[int, _Slot] = {}  # slot id -> state
+        self._queue: collections.deque[Request] = collections.deque()
+        self._cond = threading.Condition()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._next_id = 0
+        self.decode_steps = 0
+        # stats (mutated only by the loop thread; read racily for gauges)
+        self.completed = 0
+        self.rejected = 0
+        self.failed = 0
+        self.total_new_tokens = 0
+        self._latencies: collections.deque = collections.deque(maxlen=4096)
+        self._ttfts: collections.deque = collections.deque(maxlen=4096)
+        self.staleness_hist: collections.Counter = collections.Counter()
+        self._rate_mark = (time.perf_counter(), 0)
+        self.loop_error: Optional[str] = None
+
+    # -- client API --------------------------------------------------------
+
+    def submit(
+        self,
+        prompt: Sequence[int],
+        max_new_tokens: int = 16,
+        eos_id: Optional[int] = None,
+    ) -> Request:
+        """Queue a prompt; returns a Request whose ``wait()`` unblocks when
+        generation completes (or it was rejected — check ``error``)."""
+        req = Request(
+            prompt=[int(t) for t in prompt],
+            max_new_tokens=int(max_new_tokens),
+            eos_id=eos_id,
+            t_submit=time.perf_counter(),
+        )
+        if not req.prompt:
+            self.rejected += 1
+            req.finish("empty prompt")
+            return req
+        if not self.engine.prompt_fits(len(req.prompt)):
+            self.rejected += 1
+            req.finish(
+                f"prompt length {len(req.prompt)} exceeds max prefill bucket"
+            )
+            return req
+        if req.max_new_tokens < 1:
+            self.rejected += 1
+            req.finish("max_new_tokens must be >= 1")
+            return req
+        with self._cond:
+            if self._stop.is_set():
+                self.rejected += 1
+                req.finish("server stopped")
+                return req
+            if len(self._queue) >= self.max_queue:
+                self.rejected += 1
+                req.finish("queue full")
+                return req
+            req.id = self._next_id
+            self._next_id += 1
+            self._queue.append(req)
+            self._cond.notify()
+        return req
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "ContinuousBatcher":
+        self._thread = threading.Thread(
+            target=self._run, name="odtp-serve-batcher", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 10.0) -> None:
+        self._stop.set()
+        with self._cond:
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=timeout)
+        # fail whatever is still in flight so no client blocks forever
+        with self._cond:
+            pending = list(self._queue)
+            self._queue.clear()
+        for req in pending:
+            self.failed += 1
+            req.finish("server stopped")
+        for st in self._active.values():
+            self.failed += 1
+            st.req.finish("server stopped")
+        self._active.clear()
+
+    def drain(self, timeout: float = 60.0) -> bool:
+        """Block until queue and batch are empty (bench teardown)."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._cond:
+                if not self._queue and not self._active:
+                    return True
+            time.sleep(0.01)
+        return False
+
+    # -- the decode loop ---------------------------------------------------
+
+    def _run(self) -> None:
+        try:
+            while not self._stop.is_set():
+                admitted = self._admit()
+                stepped = self._decode()
+                if stepped:
+                    self.decode_steps += 1
+                    if self.decode_steps % self.swap_every_steps == 0:
+                        self.engine.maybe_swap()
+                    if self.decode_steps % self.gauge_every_steps == 0:
+                        self._publish_gauges()
+                if not admitted and not stepped:
+                    # idle: still honor the staleness bound, then sleep
+                    self.engine.maybe_swap()
+                    with self._cond:
+                        if not self._queue and not self._stop.is_set():
+                            self._cond.wait(timeout=0.05)
+        except Exception as e:  # noqa: BLE001 — fail loudly, never hang clients
+            self.loop_error = f"{type(e).__name__}: {e}"
+            for slot, st in list(self._active.items()):
+                self._retire(st, error=self.loop_error)
+                self.slots.free(slot)
+            self._active.clear()
+            with self._cond:
+                pending = list(self._queue)
+                self._queue.clear()
+            for req in pending:
+                self.failed += 1
+                req.finish(self.loop_error)
+
+    def _admit(self) -> bool:
+        admitted = False
+        while self.slots.num_free:
+            with self._cond:
+                if not self._queue:
+                    break
+                req = self._queue.popleft()
+            slot = self.slots.alloc()
+            tok, _ = self.engine.admit(slot, req.prompt)
+            req.t_first = time.perf_counter()
+            req.tokens.append(tok)
+            st = _Slot(req=req, cache_len=len(req.prompt), last_token=tok)
+            if self._finished(st):
+                self._retire(st)
+                self.slots.free(slot)
+            else:
+                self._active[slot] = st
+            admitted = True
+        return admitted
+
+    def _decode(self) -> bool:
+        if not self._active:
+            return False
+        S = self.engine.num_slots
+        tokens = np.zeros((S,), np.int32)
+        lens = np.zeros((S,), np.int32)
+        for slot, st in self._active.items():
+            tokens[slot] = st.last_token
+            lens[slot] = st.cache_len
+        next_tokens, _ = self.engine.decode_step(tokens, lens)
+        self.staleness_hist[self.engine.staleness()] += 1
+        obs.count("serve_tokens_generated", len(self._active))
+        done_slots = []
+        for slot, st in self._active.items():
+            tok = int(next_tokens[slot])
+            st.req.tokens.append(tok)
+            st.cache_len += 1
+            st.last_token = tok
+            self.total_new_tokens += 1
+            if self._finished(st):
+                done_slots.append(slot)
+        for slot in done_slots:
+            self.slots.free(slot)
+            self._retire(self._active.pop(slot))
+        return True
+
+    def _finished(self, st: _Slot) -> bool:
+        req = st.req
+        if len(req.tokens) >= req.max_new_tokens:
+            return True
+        return req.eos_id is not None and st.last_token == req.eos_id
+
+    def _retire(self, st: _Slot, error: Optional[str] = None) -> None:
+        req = st.req
+        if req.eos_id is not None and req.tokens and req.tokens[-1] == req.eos_id:
+            req.tokens.pop()  # eos terminates, is not part of the text
+        req.epoch = self.engine.weights_epoch
+        req.finish(error)
+        if error is None:
+            self.completed += 1
+            self._latencies.append(req.latency_s)
+            if req.ttft_s is not None:
+                self._ttfts.append(req.ttft_s)
+            obs.count("serve_requests_completed")
+        else:
+            self.failed += 1
+
+    # -- metrics -----------------------------------------------------------
+
+    def _publish_gauges(self) -> None:
+        lat = np.asarray(self._latencies, np.float64)
+        if lat.size:
+            obs.gauge("serve_p50_ms", float(np.percentile(lat, 50)) * 1e3)
+            obs.gauge("serve_p99_ms", float(np.percentile(lat, 99)) * 1e3)
+        now = time.perf_counter()
+        t0, n0 = self._rate_mark
+        if now > t0:
+            obs.gauge(
+                "serve_tokens_per_s", (self.total_new_tokens - n0) / (now - t0)
+            )
+        self._rate_mark = (now, self.total_new_tokens)
+        obs.gauge(
+            "serve_batch_occupancy", self.slots.num_active / self.slots.num_slots
+        )
+        obs.gauge("serve_snapshot_staleness", self.engine.staleness())
+        with self._cond:
+            obs.gauge("serve_queue_depth", len(self._queue))
+
+    def stats(self) -> dict:
+        """Point-in-time summary for the bench / health endpoint."""
+        lat = np.asarray(self._latencies, np.float64) * 1e3
+        ttft = np.asarray(self._ttfts, np.float64) * 1e3
+
+        def pct(a, q):
+            return float(np.percentile(a, q)) if a.size else None
+
+        return {
+            "completed": self.completed,
+            "rejected": self.rejected,
+            "failed": self.failed,
+            "queued": len(self._queue),
+            "active": self.slots.num_active,
+            "decode_steps": self.decode_steps,
+            "new_tokens": self.total_new_tokens,
+            "latency_ms": {
+                "p50": pct(lat, 50),
+                "p90": pct(lat, 90),
+                "p99": pct(lat, 99),
+                "mean": float(lat.mean()) if lat.size else None,
+            },
+            "ttft_ms": {"p50": pct(ttft, 50), "p99": pct(ttft, 99)},
+            "weight_swaps": self.engine.swap_count,
+            "weights_epoch": self.engine.weights_epoch,
+            "staleness_hist": {
+                str(k): v for k, v in sorted(self.staleness_hist.items())
+            },
+            "loop_error": self.loop_error,
+        }

@@ -1,0 +1,217 @@
+"""Socket front-end for the serve plane: HTTP + JSONL on one port.
+
+Same minimal-socket idiom as ``obs/prom.py`` — a daemon accept loop, one
+handler thread per connection, no framework. Both protocols carry the
+same JSON request shape::
+
+    {"prompt": [1, 2, 3], "max_new_tokens": 16, "eos_id": null}
+
+- HTTP: ``POST /generate`` with that JSON body; ``GET /healthz`` and
+  ``GET /stats`` return scheduler/engine status. Metrics are NOT here —
+  they ride the existing obs Prometheus endpoint (one registry per
+  process, see obs/prom.py).
+- JSONL: any connection whose first bytes are not an HTTP verb is
+  treated as a newline-delimited JSON stream; each line gets a response
+  line (pipelined in order). An optional ``"id"`` field is echoed back.
+
+Port collisions (e.g. serve.port accidentally equal to
+``ODTP_OBS_PROM_PORT``) downgrade to an ephemeral port with a warning
+instead of crashing the training process — the bound port is always
+``ServeServer.port``.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import socket
+import threading
+from typing import Optional
+
+from opendiloco_tpu.serve.scheduler import ContinuousBatcher
+
+log = logging.getLogger(__name__)
+
+_HTTP_VERBS = (b"GET ", b"POST", b"PUT ", b"HEAD", b"DELE", b"OPTI", b"PATC")
+
+
+def bind_with_fallback(host: str, port: int, what: str) -> socket.socket:
+    """Bind (host, port), falling back to an ephemeral port when the
+    requested one is taken — a shared-process serving plane must never
+    take down training over a port clash."""
+    sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    try:
+        sock.bind((host, port))
+    except OSError as e:
+        if port == 0:
+            sock.close()
+            raise
+        log.warning(
+            "%s port %d unavailable (%s); falling back to an ephemeral port",
+            what,
+            port,
+            e,
+        )
+        sock.bind((host, 0))
+    return sock
+
+
+class ServeServer:
+    def __init__(
+        self,
+        batcher: ContinuousBatcher,
+        *,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        request_timeout: float = 300.0,
+    ):
+        self.batcher = batcher
+        self.request_timeout = float(request_timeout)
+        self._sock = bind_with_fallback(host, port, "serve")
+        self._sock.listen(32)
+        self.host = host
+        self.port = self._sock.getsockname()[1]
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._accept_loop, name="odtp-serve-http", daemon=True
+        )
+        self._thread.start()
+
+    # -- accept / dispatch -------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            threading.Thread(
+                target=self._handle, args=(conn,), daemon=True
+            ).start()
+
+    def _handle(self, conn: socket.socket) -> None:
+        try:
+            conn.settimeout(self.request_timeout)
+            head = conn.recv(4096)
+            if not head:
+                return
+            if head[:4].ljust(4) in _HTTP_VERBS or head[:5] == b"PATCH":
+                self._handle_http(conn, head)
+            else:
+                self._handle_jsonl(conn, head)
+        except OSError:
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    # -- one generation ----------------------------------------------------
+
+    def _generate(self, payload: dict) -> dict:
+        req = self.batcher.submit(
+            payload.get("prompt") or [],
+            max_new_tokens=int(payload.get("max_new_tokens", 16)),
+            eos_id=payload.get("eos_id"),
+        )
+        if not req.wait(self.request_timeout):
+            return {"error": "timeout", "id": payload.get("id")}
+        out = {
+            "tokens": req.tokens,
+            "epoch": req.epoch,
+            "latency_ms": None
+            if req.latency_s is None
+            else round(req.latency_s * 1e3, 3),
+        }
+        if req.error is not None:
+            out["error"] = req.error
+        if payload.get("id") is not None:
+            out["id"] = payload["id"]
+        return out
+
+    # -- HTTP --------------------------------------------------------------
+
+    def _handle_http(self, conn: socket.socket, head: bytes) -> None:
+        while b"\r\n\r\n" not in head and len(head) < 65536:
+            chunk = conn.recv(4096)
+            if not chunk:
+                break
+            head += chunk
+        header, _, body = head.partition(b"\r\n\r\n")
+        lines = header.split(b"\r\n")
+        method, path = (lines[0].split(b" ") + [b"", b""])[:2]
+        clen = 0
+        for ln in lines[1:]:
+            if ln.lower().startswith(b"content-length:"):
+                clen = int(ln.split(b":", 1)[1].strip() or 0)
+        while len(body) < clen:
+            chunk = conn.recv(65536)
+            if not chunk:
+                break
+            body += chunk
+
+        if method == b"POST" and path.startswith(b"/generate"):
+            try:
+                payload = json.loads(body.decode() or "{}")
+            except (ValueError, UnicodeDecodeError):
+                self._respond(conn, 400, {"error": "malformed JSON body"})
+                return
+            out = self._generate(payload)
+            self._respond(conn, 400 if "error" in out else 200, out)
+        elif method == b"GET" and path.startswith(b"/healthz"):
+            self._respond(
+                conn,
+                200,
+                {
+                    "ok": self.batcher.loop_error is None,
+                    "weights_epoch": self.batcher.engine.weights_epoch,
+                    "staleness": self.batcher.engine.staleness(),
+                    "free_slots": self.batcher.slots.num_free,
+                },
+            )
+        elif method == b"GET" and path.startswith(b"/stats"):
+            self._respond(conn, 200, self.batcher.stats())
+        else:
+            self._respond(conn, 404, {"error": "unknown route"})
+
+    def _respond(self, conn: socket.socket, status: int, obj: dict) -> None:
+        body = (json.dumps(obj) + "\n").encode()
+        reason = {200: "OK", 400: "Bad Request", 404: "Not Found"}.get(
+            status, "Error"
+        )
+        head = (
+            f"HTTP/1.0 {status} {reason}\r\n"
+            "Content-Type: application/json\r\n"
+            f"Content-Length: {len(body)}\r\n\r\n"
+        ).encode()
+        conn.sendall(head + body)
+
+    # -- JSONL -------------------------------------------------------------
+
+    def _handle_jsonl(self, conn: socket.socket, buf: bytes) -> None:
+        while True:
+            while b"\n" in buf:
+                line, _, buf = buf.partition(b"\n")
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    payload = json.loads(line.decode())
+                except (ValueError, UnicodeDecodeError):
+                    out = {"error": "malformed JSON line"}
+                else:
+                    out = self._generate(payload)
+                conn.sendall((json.dumps(out) + "\n").encode())
+            chunk = conn.recv(65536)
+            if not chunk:
+                return
+            buf += chunk
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        self._thread.join(timeout=2.0)

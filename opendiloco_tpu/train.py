@@ -221,6 +221,28 @@ def train(config: Config, backend: Optional[OuterBackend] = None) -> dict:
         resume=bool(resume),
     )
 
+    # in-process serving plane: inference threads share this process (and
+    # its obs registry) with the inner loop; weights hot-swap from the
+    # DiLoCo master snapshots between decode steps (opendiloco_tpu/serve)
+    serving = None
+    if config.serve is not None and config.serve.enabled:
+        from opendiloco_tpu.serve import build_serving
+
+        serving = build_serving(
+            config.serve,
+            model_cfg,
+            state["params"],
+            diloco_opt,
+            compute_dtype=tc.compute_dtype,
+        )
+        log.info(
+            "serving plane up on %s:%d (%d slots, ctx %d)",
+            config.serve.host,
+            serving.port,
+            config.serve.max_batch,
+            config.serve.max_context,
+        )
+
     eval_iter = None
     if config.eval_interval:
         eval_loader = get_dataloader(
@@ -393,6 +415,10 @@ def train(config: Config, backend: Optional[OuterBackend] = None) -> dict:
         log.error("a DiLoCo worker dropped and fail_rank_drop is set; exiting")
         raise
     finally:
+        if serving is not None:
+            # before the backend goes away: the batcher thread may be
+            # mid-swap pulling a master snapshot through diloco_opt
+            serving.stop()
         if diloco_opt is not None:
             # abnormal exits must not leave an outer round holding the
             # backend open (the comm thread is daemonized, but drop it so

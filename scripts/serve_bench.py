@@ -1,0 +1,276 @@
+#!/usr/bin/env python
+"""Serving-plane benchmark: synthetic load against the continuous-batching
+server while DiLoCo training runs in the SAME process.
+
+The north star serves traffic off the live master weights; this bench
+measures that leg end to end: a tiny Llama trains through
+DiLoCoOptimizer (loopback backend, short inner phases so outer epochs
+land quickly) while client threads drive the serve plane with random
+prompts. Banks SERVE_BENCH.json at the repo root:
+
+    python scripts/serve_bench.py                # full run, banks artifact
+    python scripts/serve_bench.py --selftest     # tiny CI run, /tmp artifact
+
+Recorded: sustained requests/s, p50/p99/mean latency, TTFT, tokens/s,
+batch occupancy, the snapshot-staleness distribution, weight-swap count,
+and the drop count (must be 0 — no request is dropped across a swap).
+The acceptance line (full runs only): at least one hot-swap observed and
+zero dropped/failed requests.
+"""
+import argparse
+import json
+import os
+import socket
+import sys
+import threading
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+_OUT = os.environ.get("ODTP_SERVE_BENCH_OUT") or os.path.join(
+    REPO, "SERVE_BENCH.json"
+)
+
+
+def build_world(args):
+    """Tiny model + trainer + single-peer loopback DiLoCo + serving plane,
+    all in this process (the train.py wiring, minus the data pipeline)."""
+    import jax
+    import jax.numpy as jnp
+
+    from opendiloco_tpu.config import DilocoConfig, ServeConfig
+    from opendiloco_tpu.diloco import DiLoCoOptimizer, LoopbackWorld
+    from opendiloco_tpu.models.llama import LlamaConfig, init_params
+    from opendiloco_tpu.parallel.mesh import build_mesh
+    from opendiloco_tpu.serve import build_serving
+    from opendiloco_tpu.trainer import InnerTrainer, TrainerConfig
+
+    model_cfg = LlamaConfig(
+        vocab_size=512,
+        hidden_size=args.hidden,
+        intermediate_size=args.hidden * 2,
+        num_hidden_layers=args.layers,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        max_position_embeddings=512,
+    )
+    params = init_params(jax.random.PRNGKey(0), model_cfg)
+    tc = TrainerConfig(
+        lr=1e-3, warmup_steps=2, total_steps=100_000,
+        precision="fp32", remat=False,
+    )
+    plan = build_mesh("NO_SHARD", devices=[jax.devices()[0]])
+    trainer = InnerTrainer(model_cfg, tc, plan)
+    state = trainer.init_state(jax.random.key(1), params)
+    dcfg = DilocoConfig(local_steps=args.local_steps, backend="loopback")
+    backend = LoopbackWorld(1).make_backends()[0]
+    opt = DiLoCoOptimizer(trainer, backend, dcfg, state, batch_size=8)
+    scfg = ServeConfig(
+        enabled=True,
+        max_batch=args.slots,
+        max_context=args.max_context,
+        prefill_buckets=[16, 64],
+        swap_every_steps=args.swap_every,
+        max_stale_rounds=0,
+    )
+    plane = build_serving(
+        scfg, model_cfg, state["params"], opt, compute_dtype=jnp.float32
+    )
+    return model_cfg, trainer, state, opt, plane, scfg
+
+
+def run_bench(args) -> dict:
+    model_cfg, trainer, state, opt, plane, scfg = build_world(args)
+    rng = np.random.default_rng(0)
+
+    # -- training thread: inner steps -> outer epochs -> hot-swap source --
+    stop_train = threading.Event()
+    train_steps = [0]
+
+    def train_loop():
+        s = state
+        while not stop_train.is_set():
+            ids = rng.integers(0, model_cfg.vocab_size, (8, 32)).astype(np.int32)
+            batch = trainer.shard_batch(ids, ids.copy(), 1)
+            s, _ = opt.step(s, batch)
+            train_steps[0] += 1
+
+    # -- client threads: closed-loop synthetic load -----------------------
+    stop_clients = threading.Event()
+    client_rng = np.random.default_rng(7)
+    lock = threading.Lock()
+    submitted = [0]
+    errors = []
+
+    def client_loop(cid):
+        r = np.random.default_rng(1000 + cid)
+        while not stop_clients.is_set():
+            n = int(r.integers(3, 15))
+            prompt = r.integers(1, model_cfg.vocab_size, n).tolist()
+            req = plane.batcher.submit(
+                prompt, max_new_tokens=int(r.integers(4, args.max_new + 1))
+            )
+            with lock:
+                submitted[0] += 1
+            if not req.wait(120):
+                errors.append("client request hung")
+                return
+            if req.error is not None:
+                errors.append(req.error)
+
+    # warm the compile caches before timing (prefill buckets + decode)
+    warm = plane.batcher.submit([1, 2, 3], max_new_tokens=2)
+    warm.wait(300)
+    for b in scfg.prefill_buckets:
+        w = plane.batcher.submit(list(range(1, b + 1))[: b], max_new_tokens=2)
+        w.wait(300)
+
+    trainer_thread = threading.Thread(target=train_loop, daemon=True)
+    clients = [
+        threading.Thread(target=client_loop, args=(i,), daemon=True)
+        for i in range(args.clients)
+    ]
+    base_completed = plane.batcher.completed
+    base_tokens = plane.batcher.total_new_tokens
+    t0 = time.perf_counter()
+    trainer_thread.start()
+    for c in clients:
+        c.start()
+    time.sleep(args.duration)
+    stop_clients.set()
+    for c in clients:
+        c.join(timeout=180)
+    plane.batcher.drain(timeout=180)
+    elapsed = time.perf_counter() - t0
+    stop_train.set()
+    trainer_thread.join(timeout=180)
+
+    # -- one front-end round trip over the real socket --------------------
+    http_ok = False
+    try:
+        conn = socket.create_connection(("127.0.0.1", plane.port), timeout=30)
+        conn.sendall(
+            (json.dumps({"prompt": [5, 6, 7], "max_new_tokens": 2}) + "\n").encode()
+        )
+        buf = b""
+        while b"\n" not in buf:
+            chunk = conn.recv(4096)
+            if not chunk:
+                break
+            buf += chunk
+        http_ok = b"tokens" in buf
+        conn.close()
+    except OSError as e:
+        errors.append(f"frontend: {e}")
+
+    stats = plane.batcher.stats()
+    plane.stop()
+
+    completed = stats["completed"] - base_completed
+    new_tokens = stats["new_tokens"] - base_tokens
+    return {
+        "model": {
+            "hidden": model_cfg.hidden_size,
+            "layers": model_cfg.num_hidden_layers,
+            "vocab": model_cfg.vocab_size,
+            "params": int(model_cfg.num_params()),
+        },
+        "load": {
+            "clients": args.clients,
+            "duration_s": round(elapsed, 3),
+            "slots": args.slots,
+            "max_new_tokens": args.max_new,
+            "local_steps": args.local_steps,
+        },
+        "throughput": {
+            "requests_per_s": round(completed / elapsed, 3),
+            "tokens_per_s": round(new_tokens / elapsed, 3),
+            "completed": completed,
+            "submitted": submitted[0],
+            "decode_steps": stats["decode_steps"],
+        },
+        "latency_ms": stats["latency_ms"],
+        "ttft_ms": stats["ttft_ms"],
+        "staleness_hist": stats["staleness_hist"],
+        "swaps": {
+            "count": stats["weight_swaps"],
+            "final_weights_epoch": stats["weights_epoch"],
+            "trainer_epochs": opt.epoch,
+        },
+        "training": {"inner_steps": train_steps[0]},
+        "dropped": stats["failed"],
+        "rejected": stats["rejected"],
+        "frontend_roundtrip_ok": http_ok,
+        "client_errors": errors[:5],
+        "loop_error": stats["loop_error"],
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--selftest", action="store_true",
+                    help="tiny CI run; artifact under $TMPDIR, no acceptance line")
+    ap.add_argument("--duration", type=float, default=45.0,
+                    help="seconds of sustained load")
+    ap.add_argument("--clients", type=int, default=8)
+    ap.add_argument("--slots", type=int, default=8)
+    ap.add_argument("--hidden", type=int, default=128)
+    ap.add_argument("--layers", type=int, default=4)
+    ap.add_argument("--max-new", type=int, default=24)
+    ap.add_argument("--max-context", type=int, default=128)
+    ap.add_argument("--local-steps", type=int, default=10,
+                    help="inner steps per outer epoch (small -> frequent swaps)")
+    ap.add_argument("--swap-every", type=int, default=8)
+    args = ap.parse_args()
+
+    out_path = _OUT
+    if args.selftest:
+        args.duration = min(args.duration, 8.0)
+        args.clients = min(args.clients, 3)
+        args.slots = min(args.slots, 4)
+        args.hidden = min(args.hidden, 64)
+        args.layers = min(args.layers, 2)
+        args.max_new = min(args.max_new, 8)
+        args.local_steps = min(args.local_steps, 5)
+        out_path = os.path.join(
+            os.environ.get("TMPDIR", "/tmp"), "SERVE_BENCH.selftest.json"
+        )
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    result = run_bench(args)
+    doc = {
+        "schema": 1,
+        "selftest": bool(args.selftest),
+        "host": {
+            "node": os.uname().nodename,
+            "cpus": os.cpu_count(),
+        },
+        "updated": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        **result,
+    }
+    with open(out_path, "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+    print(f"wrote {out_path}")
+    print(json.dumps(doc["throughput"], indent=None))
+    print(json.dumps(doc["latency_ms"], indent=None))
+    print("swaps:", json.dumps(doc["swaps"]), "dropped:", doc["dropped"])
+
+    if doc["loop_error"] or doc["client_errors"]:
+        raise SystemExit(f"serve bench errors: {doc['client_errors']} "
+                         f"{doc['loop_error']}")
+    if doc["dropped"] != 0:
+        raise SystemExit(f"{doc['dropped']} requests dropped — acceptance is 0")
+    if not doc["frontend_roundtrip_ok"]:
+        raise SystemExit("socket front-end round trip failed")
+    if not args.selftest and doc["swaps"]["count"] < 1:
+        raise SystemExit(
+            "no weight hot-swap observed during the full run — "
+            "training too slow relative to --duration?"
+        )
+
+
+if __name__ == "__main__":
+    main()
