@@ -350,11 +350,82 @@ double odtp_sqnorm_f32(const float* a, size_t n) {
     return s;
 }
 
+// 4-bit blockwise codec: per-block absmax scale stored as fp16 bits, values
+// quantized to [-7, 7] and packed two-per-byte (element 2i in the low
+// nibble, 2i+1 in the high nibble; an odd tail leaves the last high nibble
+// zero). The scale is clamped into the normal fp16 range and quantization
+// runs against the fp16-ROUNDED scale, so encode and decode agree exactly
+// on the step size the wire carries. `block` must be even (the packer
+// assumes block boundaries are byte boundaries; the final partial block is
+// the only one allowed an odd element count).
+static inline float odtp_b4_scale(float amax) {
+    float s = amax > 0.f ? amax : 1.f;
+    if (s < 6.1035156e-05f) s = 6.1035156e-05f;  // fp16 min normal
+    if (s > 65504.f) s = 65504.f;                // fp16 max finite
+    return f16_to_f32_scalar(f32_to_f16_scalar(s));
+}
+
+void odtp_quantize_blockwise4(const float* src, uint8_t* packed,
+                              uint16_t* scales, size_t n, size_t block) {
+    size_t nblocks = (n + block - 1) / block;
+#pragma omp parallel for schedule(static)
+    for (ptrdiff_t b = 0; b < (ptrdiff_t)nblocks; ++b) {
+        size_t lo = (size_t)b * block, hi = std::min(lo + block, n);
+        float amax = 0.f;
+        for (size_t i = lo; i < hi; ++i) amax = std::max(amax, std::fabs(src[i]));
+        float s = odtp_b4_scale(amax);
+        scales[b] = f32_to_f16_scalar(s);
+        float inv = 7.f / s;
+        for (size_t i = lo; i < hi; i += 2) {
+            float v0 = std::min(7.f, std::max(-7.f, std::nearbyint(src[i] * inv)));
+            uint8_t byte = (uint8_t)((int)v0 + 8);
+            if (i + 1 < hi) {
+                float v1 = std::min(
+                    7.f, std::max(-7.f, std::nearbyint(src[i + 1] * inv)));
+                byte |= (uint8_t)(((int)v1 + 8) << 4);
+            }
+            packed[i / 2] = byte;
+        }
+    }
+}
+
+void odtp_dequantize_blockwise4(const uint8_t* packed, const uint16_t* scales,
+                                float* dst, size_t n, size_t block) {
+    size_t nblocks = (n + block - 1) / block;
+#pragma omp parallel for schedule(static)
+    for (ptrdiff_t b = 0; b < (ptrdiff_t)nblocks; ++b) {
+        size_t lo = (size_t)b * block, hi = std::min(lo + block, n);
+        float s = f16_to_f32_scalar(scales[b]) / 7.f;
+        for (size_t i = lo; i < hi; ++i) {
+            uint8_t byte = packed[i / 2];
+            int q = (int)((i & 1) ? (byte >> 4) : (byte & 0xF)) - 8;
+            dst[i] = (float)q * s;
+        }
+    }
+}
+
+// fused: dst += dequantize4(packed) -- collect step for the 4-bit wire
+void odtp_dequantize_blockwise4_accumulate(const uint8_t* packed,
+                                           const uint16_t* scales, float* dst,
+                                           size_t n, size_t block) {
+    size_t nblocks = (n + block - 1) / block;
+#pragma omp parallel for schedule(static)
+    for (ptrdiff_t b = 0; b < (ptrdiff_t)nblocks; ++b) {
+        size_t lo = (size_t)b * block, hi = std::min(lo + block, n);
+        float s = f16_to_f32_scalar(scales[b]) / 7.f;
+        for (size_t i = lo; i < hi; ++i) {
+            uint8_t byte = packed[i / 2];
+            int q = (int)((i & 1) ? (byte >> 4) : (byte & 0xF)) - 8;
+            dst[i] += (float)q * s;
+        }
+    }
+}
+
 // Bumped once per exported symbol-group addition: 1 = base codecs,
 // 2 = fused decode-accumulate, 3 = absmax + fused scaled-fp16 paths,
 // 4 = chunk-granular encode prescans (minmax + quantize-given),
-// 5 = fused outer SGD + sqnorm.
-int odtp_version() { return 5; }
+// 5 = fused outer SGD + sqnorm, 6 = 4-bit blockwise codec.
+int odtp_version() { return 6; }
 
 }  // extern "C"
 

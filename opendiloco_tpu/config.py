@@ -72,10 +72,21 @@ class DilocoConfig(BaseModel):
     matchmaking_time: float = 60.0
     fail_rank_drop: bool = False  # crash if a peer drops (train_fsdp.py:93)
 
-    # wire compression for the outer all-reduce (utils.py:83-121)
+    # wire compression for the outer all-reduce (utils.py:83-121, plus the
+    # sub-8-bit codecs: blockwise4bit = packed nibbles + fp16 block scales,
+    # topk = sparse top-|x| at ODTP_TOPK_DENSITY)
     compression: Literal[
-        "none", "fp16", "scaled-fp16", "uniform8bit", "quantile8bit", "blockwise8bit"
+        "none", "fp16", "scaled-fp16", "uniform8bit", "quantile8bit",
+        "blockwise8bit", "blockwise4bit", "topk",
     ] = "none"
+
+    # error feedback for lossy compression: each round's encode/decode
+    # residual (quantization or sparsification error) is accumulated
+    # per-leaf and added to the NEXT round's pseudo-gradient before
+    # encoding, so dropped signal is carried instead of lost. Residuals
+    # checkpoint with the optimizer state and survive elastic dropped
+    # rounds. Requires a lossy codec (compression != "none").
+    error_feedback: bool = False
 
     # onboarding (train_fsdp.py:348-349)
     skip_load_from_peers: bool = False
@@ -178,9 +189,24 @@ class DilocoConfig(BaseModel):
         ):
             raise ValueError(
                 "outer_mode='gossip' sends the master weights over the wire "
-                "every epoch; 8-bit codecs are tuned for pseudo-gradient "
+                "every epoch; sub-fp16 codecs are tuned for pseudo-gradient "
                 "magnitudes and would accumulate unbounded master error -- "
                 "use none/fp16/scaled-fp16"
+            )
+        if self.outer_mode == "gossip" and self.error_feedback:
+            raise ValueError(
+                "error_feedback requires pseudo-gradient rounds; "
+                "outer_mode='gossip' averages full masters, so there is no "
+                "residual to carry"
+            )
+        return self
+
+    @model_validator(mode="after")
+    def _error_feedback_constraints(self):
+        if self.error_feedback and self.compression == "none":
+            raise ValueError(
+                "error_feedback carries the codec's encode/decode residual; "
+                "with compression='none' there is none -- pick a lossy codec"
             )
         return self
 

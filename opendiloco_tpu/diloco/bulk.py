@@ -556,6 +556,7 @@ class BulkSender:
         payload,
         *,
         lock_timeout: float = 30.0,
+        align: int = 1,
     ) -> None:
         key = (host, port)
         with self._meta_lock:
@@ -585,7 +586,9 @@ class BulkSender:
                         continue
                 try:
                     if striped:
-                        self._send_striped(key, msg, meta, payload, streams)
+                        self._send_striped(
+                            key, msg, meta, payload, streams, align
+                        )
                         self._scale_stripes(key, ok=True)
                     else:
                         sock = self._get_conns(key, 1)[0]
@@ -631,11 +634,18 @@ class BulkSender:
         return BulkStream(self, key, lock, sock)
 
     def _send_striped(
-        self, key: tuple, msg: str, meta: dict, payload, streams: int
+        self, key: tuple, msg: str, meta: dict, payload, streams: int,
+        align: int = 1,
     ) -> None:
         """Pump ~equal contiguous slices over ``streams`` connections; the
         header (with the stripe table + session id) and slice 0 go on
         connection 0, which also carries the single ack.
+
+        ``align`` (bytes) rounds the stripe step up so every boundary lands
+        on a wire-record multiple of the payload's codec (f32/f16 element
+        width, topk's u32+f32 records; packed-nibble payloads are already
+        byte-granular) — stripe boundaries then never split an encoded
+        record, whatever order the receiver lands them in.
 
         With a link estimate and ODTP_LINK_ADAPT on, the send is *hedged*:
         a stripe still in flight past a deadline derived from the estimated
@@ -646,6 +656,8 @@ class BulkSender:
         conns = self._get_conns(key, streams)
         sid = f"{self._id}-{next(self._session_counter)}"
         step = -(-n // streams)
+        if align > 1:
+            step += (-step) % align
         offs = [min(i * step, n) for i in range(streams + 1)]
         lens = [offs[i + 1] - offs[i] for i in range(streams)]
 

@@ -24,11 +24,15 @@ Design constraints:
 
 from __future__ import annotations
 
+import os
+
 import numpy as np
 
 from opendiloco_tpu import native
 
 _BLOCK = 4096
+_TOPK_DENSITY_ENV = "ODTP_TOPK_DENSITY"
+_TOPK_DEFAULT_DENSITY = 0.03125  # 1/32 kept -> 0.25 B/elem on the wire
 
 
 def chunk_bounds(n: int, chunk_elems: int, align: int = 1) -> list[int]:
@@ -51,6 +55,10 @@ class Codec:
     name: str = "none"
     # chunk offsets must be multiples of this many elements (blockwise8bit)
     chunk_align: int = 1
+    # bulk stripe boundaries round to this many BYTES so a stripe never
+    # splits one encoded wire record (f32 element here; fp16 = 2, u8 = 1,
+    # topk's u32/f32 records = 4; packed nibbles are byte-granular already)
+    wire_align_bytes: int = 4
 
     def chunk_state(self, arr: np.ndarray) -> dict:
         """Tensor-global encode state, computed once per part before the
@@ -98,6 +106,7 @@ class Codec:
 
 class Float16Codec(Codec):
     name = "fp16"
+    wire_align_bytes = 2
 
     def encode(self, arr):
         return native.f32_to_f16_bytes(arr), {}
@@ -117,6 +126,7 @@ class ScaledFloat16Codec(Codec):
     hivemind ScaledFloat16Compression equivalent)."""
 
     name = "scaled-fp16"
+    wire_align_bytes = 2
 
     def encode(self, arr):
         # fused single-pass absmax + divide-and-convert: the old numpy
@@ -159,6 +169,7 @@ class Uniform8BitCodec(Codec):
     collect phases several times slower than the wire)."""
 
     name = "uniform8bit"
+    wire_align_bytes = 1
 
     def encode(self, arr):
         payload, lo, span = native.quantize_uniform8(arr)
@@ -194,6 +205,7 @@ class Quantile8BitCodec(Codec):
     Payload layout: [256 x f32 codebook][n x u8 indices]."""
 
     name = "quantile8bit"
+    wire_align_bytes = 1
 
     def encode(self, arr):
         flat = np.asarray(arr, np.float32).reshape(-1)
@@ -247,6 +259,7 @@ class Blockwise8BitCodec(Codec):
     Payload layout: [nblocks x f32 scales][n x i8]."""
 
     name = "blockwise8bit"
+    wire_align_bytes = 1
     # chunk boundaries on block multiples keep chunk-local blocks (and their
     # scales) identical to the whole-tensor block grid
     chunk_align = _BLOCK
@@ -274,6 +287,143 @@ class Blockwise8BitCodec(Codec):
         native.dequantize_blockwise(q, scales, dst.size, _BLOCK, out=dst)
 
 
+class Blockwise4BitCodec(Codec):
+    """Per-block absmax 4-bit quantization: packed nibbles with one fp16
+    scale per 4096 values (0.504 B/elem, ~2x below the 8-bit codecs).
+    Element 2i rides the low nibble of byte i, element 2i+1 the high
+    nibble; quantization uses the fp16-ROUNDED scale so encode and decode
+    agree exactly. Payload layout: [nblocks x u16 fp16-scales][ceil(n/2) x
+    packed u8]."""
+
+    name = "blockwise4bit"
+    wire_align_bytes = 1
+    # _BLOCK is even, so block-aligned chunk boundaries are also nibble
+    # (byte) boundaries: every non-final chunk packs an even element count
+    chunk_align = _BLOCK
+
+    def encode(self, arr):
+        arr = np.asarray(arr, np.float32).reshape(-1)
+        q, scales = native.quantize_blockwise4(arr, _BLOCK)
+        return scales + q, {"nblocks": (arr.size + _BLOCK - 1) // _BLOCK}
+
+    def _split(self, payload, meta):
+        nb = int(meta["nblocks"])
+        return payload[: nb * 2], payload[nb * 2 :]
+
+    def decode(self, payload, shape, meta):
+        scales, q = self._split(payload, meta)
+        n = int(np.prod(shape))
+        return native.dequantize_blockwise4(q, scales, n, _BLOCK).reshape(shape)
+
+    def decode_accumulate(self, payload, meta, dst):
+        scales, q = self._split(payload, meta)
+        native.dequant4_accumulate(q, scales, dst, _BLOCK)
+
+    def decode_into(self, payload, meta, dst):
+        scales, q = self._split(payload, meta)
+        native.dequantize_blockwise4(q, scales, dst.size, _BLOCK, out=dst)
+
+
+def topk_density() -> float:
+    """Kept fraction for the topk codec, from ``ODTP_TOPK_DENSITY``
+    (read lazily so tests and launch scripts can flip it)."""
+    try:
+        d = float(os.environ.get(_TOPK_DENSITY_ENV, _TOPK_DEFAULT_DENSITY))
+    except ValueError:
+        d = _TOPK_DEFAULT_DENSITY
+    return min(1.0, max(d, 0.0))
+
+
+class TopKCodec(Codec):
+    """Per-tensor top-k magnitude sparsification: keep the k largest-|x|
+    entries (k = max(1, n*density)), ship [k x u32 indices][k x f32
+    values]. At the default 1/32 density that is 0.25 B/elem. Selection is
+    deterministic: ties at the magnitude threshold resolve to the lowest
+    indices, and the index payload is sorted ascending. Dropped mass is the
+    error-feedback residual's job (config ``error_feedback``)."""
+
+    name = "topk"
+
+    def _select(self, flat: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+        n = flat.size
+        if n == 0:
+            return np.zeros(0, np.int64), np.zeros(0, np.float32)
+        k = min(n, max(1, int(n * topk_density())))
+        mag = np.abs(flat)
+        thr = np.partition(mag, n - k)[n - k]
+        idx = np.nonzero(mag > thr)[0]  # provably <= k-1 elements
+        need = k - idx.size
+        if need > 0:
+            idx = np.concatenate([idx, np.nonzero(mag == thr)[0][:need]])
+        idx.sort()
+        return idx, flat[idx]
+
+    def encode(self, arr):
+        flat = np.ascontiguousarray(arr, np.float32).reshape(-1)
+        idx, vals = self._select(flat)
+        return (
+            idx.astype(np.uint32).tobytes() + vals.tobytes(),
+            {"k": int(idx.size)},
+        )
+
+    def chunk_state(self, arr):
+        # top-k is a whole-tensor property: prescan selects globally, then
+        # each chunk ships its slice of the selection (chunk-relative
+        # indices), so the concatenated chunk decodes match the
+        # whole-tensor encode exactly
+        flat = np.ascontiguousarray(arr, np.float32).reshape(-1)
+        idx, vals = self._select(flat)
+        return {"base": flat, "idx": idx, "vals": vals}
+
+    def encode_chunk(self, arr, state):
+        chunk = np.asarray(arr)
+        base = state["base"]
+        off = chunk.ctypes.data - base.ctypes.data
+        if (
+            chunk.dtype != np.float32
+            or not chunk.flags.c_contiguous
+            or off < 0
+            or off % 4
+            or off // 4 + chunk.size > base.size
+        ):
+            raise ValueError(
+                "topk encode_chunk needs a contiguous float32 view into the "
+                "part passed to chunk_state"
+            )
+        lo = off // 4
+        a = np.searchsorted(state["idx"], lo, side="left")
+        b = np.searchsorted(state["idx"], lo + chunk.size, side="left")
+        idx = (state["idx"][a:b] - lo).astype(np.uint32)
+        vals = state["vals"][a:b]
+        return idx.tobytes() + vals.tobytes(), {"k": int(idx.size)}
+
+    def _split(self, payload, meta):
+        k = int(meta["k"])
+        return (
+            np.frombuffer(payload[: k * 4], np.uint32).astype(np.int64),
+            np.frombuffer(payload[k * 4 : k * 8], np.float32),
+        )
+
+    def decode(self, payload, shape, meta):
+        idx, vals = self._split(payload, meta)
+        out = np.zeros(int(np.prod(shape)), np.float32)
+        out[idx] = vals
+        return out.reshape(shape)
+
+    def decode_accumulate(self, payload, meta, dst):
+        if not dst.flags.c_contiguous or dst.dtype != np.float32:
+            native.add_inplace(dst, self.decode(payload, dst.shape, meta))
+            return
+        idx, vals = self._split(payload, meta)
+        # selected indices are unique, so fancy-index += is accumulate-safe
+        dst.reshape(-1)[idx] += vals
+
+    def decode_into(self, payload, meta, dst):
+        idx, vals = self._split(payload, meta)
+        dst[:] = 0.0
+        dst[idx] = vals
+
+
 _CODECS = {
     c.name: c
     for c in [
@@ -283,8 +433,31 @@ _CODECS = {
         Uniform8BitCodec(),
         Quantile8BitCodec(),
         Blockwise8BitCodec(),
+        Blockwise4BitCodec(),
+        TopKCodec(),
     ]
 }
+
+# running per-codec (raw, wire) byte totals; feeds the obs counters and the
+# bench HEALTH line so wire savings are measurable per codec
+_WIRE_TOTALS: dict[str, list[float]] = {}
+
+
+def record_wire(name: str, raw_nbytes: int, wire_nbytes: int) -> None:
+    """Account one encoded payload: per-codec wire/raw byte counters plus a
+    running compression-ratio gauge. No-op-cheap when obs is disabled."""
+    tot = _WIRE_TOTALS.setdefault(name, [0.0, 0.0])
+    tot[0] += raw_nbytes
+    tot[1] += wire_nbytes
+    from opendiloco_tpu import obs  # deferred: obs is an optional plane
+
+    tr = obs.tracer()
+    if tr is None:
+        return
+    tr.count("outer_raw_bytes", raw_nbytes, codec=name)
+    tr.count("outer_wire_bytes", wire_nbytes, codec=name)
+    if tot[1] > 0:
+        tr.gauge("outer_compression_ratio", tot[0] / tot[1], codec=name)
 
 
 def get_codec(name: str) -> Codec:

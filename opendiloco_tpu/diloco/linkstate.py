@@ -468,19 +468,28 @@ def stripes_for(
     return max(1, min(want, max_streams, cap))
 
 
-def chunk_elems_for(bps: float, rtt_s: float, fallback: int) -> int:
+def chunk_elems_for(bps: float, rtt_s: float, fallback: int, align: int = 1) -> int:
     """Pipeline chunk size (f32 elements) for one destination: grown from
     the static default toward one BDP per chunk, capped at 32 MiB of
     payload. Never SMALLER than ``fallback`` (the static chunk knob): BDP
     sizing exists to keep fat links full; shrinking chunks below the
     default only multiplies per-chunk overhead — and on a contended box
     that extra overhead feeds back into a lower goodput estimate, which
-    would shrink the chunk further."""
+    would shrink the chunk further.
+
+    ``align`` rounds the result down to the codec's ``chunk_align``
+    granularity (never below ``align`` itself) so chunk boundaries stay on
+    block/nibble multiples — blockwise codecs need block-grid-aligned
+    chunks and 4-bit packing needs even element counts."""
     if bps <= 0:
-        return fallback
-    bdp = bps * max(rtt_s, 1e-3)
-    nbytes = min(max(bdp, 4.0 * fallback), float(32 << 20))
-    return max(fallback, int(nbytes) // 4)
+        ce = fallback
+    else:
+        bdp = bps * max(rtt_s, 1e-3)
+        nbytes = min(max(bdp, 4.0 * fallback), float(32 << 20))
+        ce = max(fallback, int(nbytes) // 4)
+    if align > 1:
+        ce = max(align, ce - (ce % align))
+    return ce
 
 
 def hedge_deadline_s(nbytes: int, bps: float, rtt_s: float, streams: int) -> float:

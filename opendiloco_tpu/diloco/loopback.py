@@ -27,7 +27,7 @@ from opendiloco_tpu.diloco.backend import (
     OuterBackend,
     PeerProgress,
 )
-from opendiloco_tpu.diloco.compression import Codec, get_codec
+from opendiloco_tpu.diloco.compression import Codec, get_codec, record_wire
 
 
 class LoopbackWorld:
@@ -298,4 +298,5 @@ class LoopbackBackend(OuterBackend):
 
 def _enc(codec: Codec, a: np.ndarray):
     payload, meta = codec.encode(a)
+    record_wire(codec.name, a.size * 4, len(payload))
     return payload, a.shape, meta

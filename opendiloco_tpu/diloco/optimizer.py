@@ -30,6 +30,8 @@ import numpy as np
 from opendiloco_tpu import native, obs
 from opendiloco_tpu.config import DilocoConfig
 from opendiloco_tpu.diloco.backend import OuterBackend, PeerProgress, wait_for_peers
+from opendiloco_tpu.diloco.compression import get_codec
+from opendiloco_tpu.diloco.error_feedback import ErrorFeedback
 from opendiloco_tpu.diloco.outer_device import DeviceOuterPlane
 from opendiloco_tpu.diloco.outer_optimizer import OuterSGD
 from opendiloco_tpu.diloco.streaming import StreamScheduler
@@ -132,6 +134,7 @@ class DiLoCoOptimizer:
                 momentum=cfg.outer_momentum,
                 nesterov=cfg.outer_nesterov,
                 compression=cfg.compression,
+                error_feedback=cfg.error_feedback,
             )
             # the plane owns master + momentum; the host list stays empty
             # (every device-mode path goes through self._plane)
@@ -141,6 +144,22 @@ class DiLoCoOptimizer:
                 np.array(x, dtype=np.float32)
                 for x in self.world.gather_params(flat_dev)
             ]
+        # error feedback (diloco/error_feedback.py): per-leaf residual of
+        # the codec's quantization/sparsification error, folded into the
+        # next round's pseudo-gradient before encoding. Device placement
+        # fuses the residual add into the plane's pseudo-gradient jit and
+        # stores the residuals in HBM; host placement adds in prepare().
+        self._ef: Optional[ErrorFeedback] = None
+        if cfg.error_feedback:
+            self._ef = ErrorFeedback(
+                get_codec(cfg.compression),
+                len(flat_dev),
+                device_setter=(
+                    self._plane.set_ef_residuals
+                    if self._plane is not None
+                    else None
+                ),
+            )
         self.outer_opt = OuterSGD(
             lr=cfg.outer_lr, momentum=cfg.outer_momentum, nesterov=cfg.outer_nesterov
         )
@@ -749,6 +768,13 @@ class DiLoCoOptimizer:
             if self.world.is_messenger or self.cfg.overlap_comm == "eager"
             else None
         )
+        if self._ef is not None and pseudo_grad is not None:
+            # residual folded into the wire pg (and the eager estimate
+            # below, which must match what the swarm averages); the round's
+            # roundtrip error stages pending until the landing commits it.
+            # Eager followers run this too — identical pg from the
+            # replicated master keeps residuals process-symmetric.
+            self._ef.prepare("main", range(len(pseudo_grad)), pseudo_grad)
 
         pending: dict[str, Any] = {
             "master_snap": [m.copy() for m in self.master],
@@ -865,6 +891,11 @@ class DiLoCoOptimizer:
         pg_host, pg_norm, pg_dev = fetch_result[0]
         if tr is not None and pg_norm is not None:
             tr.gauge("pseudo_grad_norm", pg_norm)
+        if self._ef is not None:
+            # the plane's jit already added the residual (full-width D2H:
+            # pg_host is the exact f32 the backend will encode); prepare
+            # only stages the roundtrip error
+            self._ef.prepare("main", range(len(pg_host)), pg_host)
 
         pending: dict[str, Any] = {
             "epoch": self.epoch,
@@ -1051,6 +1082,11 @@ class DiLoCoOptimizer:
             else:
                 avg, group_size = self._overlap_result(pending, block=block)
             self._check_group_size(group_size)
+            if self._ef is not None:
+                # the round's compressed pg was adopted by the swarm: its
+                # roundtrip error becomes the live residual (no-op on
+                # delayed-mode followers, which never prepared)
+                self._ef.commit("main")
 
             t_apply = time.perf_counter() if tr is not None else 0.0
             if "plane_pre" in pending:
@@ -1096,6 +1132,13 @@ class DiLoCoOptimizer:
                     "outer/apply", t_apply, time.perf_counter(),
                     epoch=pending["epoch"], group=group_size,
                 )
+        except BaseException:
+            if self._ef is not None:
+                # dropped round: discard the staged error, keep the
+                # previous residual live (the next pseudo-gradient
+                # re-captures the lost update — nothing double-counts)
+                self._ef.abort("main")
+            raise
         finally:
             with self._serve_lock:
                 self._pending = None
@@ -1162,6 +1205,10 @@ class DiLoCoOptimizer:
             if fut is not None and not fut.cancel():
                 self._abandoned = fut
             self._pending = None
+        if self._ef is not None:
+            # abandoned rounds never commit; the live residual survives
+            # state adoption (it is this worker's own compression debt)
+            self._ef.abort_all()
 
     def flush(self, state: dict) -> dict:
         """Resolve any in-flight outer communication (call before
@@ -1326,13 +1373,27 @@ class DiLoCoOptimizer:
         pseudo_grad, pg_norm, _ = fetch_result[0]
         if tr is not None and pg_norm is not None:
             tr.gauge("pseudo_grad_norm", pg_norm)
+        if self._ef is not None:
+            # residual already added in the plane's jit; stage the error
+            self._ef.prepare(
+                "main",
+                frag if frag is not None else range(len(pseudo_grad)),
+                pseudo_grad,
+            )
 
         t1 = time.monotonic()
         t1p = time.perf_counter() if tr is not None else 0.0
-        averaged, group_size, _ = self._wan_all_reduce(
-            pseudo_grad, timeout=self.cfg.averaging_timeout, epoch=self.epoch
-        )
-        self._check_group_size(group_size)
+        try:
+            averaged, group_size, _ = self._wan_all_reduce(
+                pseudo_grad, timeout=self.cfg.averaging_timeout, epoch=self.epoch
+            )
+            self._check_group_size(group_size)
+        except BaseException:
+            if self._ef is not None:
+                self._ef.abort("main")
+            raise
+        if self._ef is not None:
+            self._ef.commit("main")
         allreduce_s = time.monotonic() - t1
         if tr is not None:
             tr.add_span(
@@ -1501,6 +1562,15 @@ class DiLoCoOptimizer:
             # slot buffer: the blocking path consumes it synchronously,
             # slot 0 only)
             pseudo_grad = self._pseudo_grad_into(device_flat, slot=0)
+        if self._ef is not None:
+            # residual folded into the wire pg in place (config rejects
+            # error_feedback with gossip, so this is always the plain
+            # pseudo-gradient all-reduce below)
+            self._ef.prepare(
+                "main",
+                frag if frag is not None else range(len(pseudo_grad)),
+                pseudo_grad,
+            )
 
         if tr is not None:
             # fused OMP dot (native fallback: np.dot) instead of a serial
@@ -1532,10 +1602,19 @@ class DiLoCoOptimizer:
             # (incl. fail_rank_drop) runs on the live-peer count instead
             self._check_group_size(live_peers)
         else:
-            averaged, group_size, _ = self._wan_all_reduce(
-                pseudo_grad, timeout=self.cfg.averaging_timeout, epoch=self.epoch
-            )
-            self._check_group_size(group_size)
+            try:
+                averaged, group_size, _ = self._wan_all_reduce(
+                    pseudo_grad,
+                    timeout=self.cfg.averaging_timeout,
+                    epoch=self.epoch,
+                )
+                self._check_group_size(group_size)
+            except BaseException:
+                if self._ef is not None:
+                    self._ef.abort("main")
+                raise
+            if self._ef is not None:
+                self._ef.commit("main")
         allreduce_s = time.monotonic() - t1
         if tr is not None:
             tr.add_span(
@@ -1652,7 +1731,7 @@ class DiLoCoOptimizer:
             # host view either placement: checkpoints are
             # placement-portable (ckpt.py serializes numpy trees)
             master, bufs = self._plane.host_state()
-            return {
+            sd = {
                 "master": master,
                 "outer_opt": {
                     "lr": self._plane.lr,
@@ -1664,13 +1743,19 @@ class DiLoCoOptimizer:
                 "local_step": self.local_step,
                 "samples_in_epoch": self.samples_in_epoch,
             }
-        return {
+            if self._ef is not None:
+                sd["ef_residual"] = self._plane.ef_host_state()
+            return sd
+        sd = {
             "master": [m.copy() for m in self.master],
             "outer_opt": self.outer_opt.state_dict(),
             "epoch": self.epoch,
             "local_step": self.local_step,
             "samples_in_epoch": self.samples_in_epoch,
         }
+        if self._ef is not None:
+            sd["ef_residual"] = self._ef.host_residuals()
+        return sd
 
     def load_state_dict(self, sd: dict) -> None:
         if self._plane is not None:
@@ -1685,6 +1770,11 @@ class DiLoCoOptimizer:
                     momentum=opt.get("momentum"),
                     nesterov=opt.get("nesterov"),
                 )
+                if self._ef is not None:
+                    # residuals are placement-portable: host-placement
+                    # checkpoints may carry None entries (leaves that
+                    # never committed), which load as zeros
+                    self._plane.load_ef(sd.get("ef_residual"))
                 # scalar mirror only; the plane owns the momentum bufs
                 self.outer_opt.load_state_dict({**opt, "bufs": None})
                 with self._serve_lock:
@@ -1704,6 +1794,8 @@ class DiLoCoOptimizer:
                 np.asarray(m, np.float32).copy() for m in sd["master"]
             ]
             self.outer_opt.load_state_dict(sd["outer_opt"])
+            if self._ef is not None:
+                self._ef.load(sd.get("ef_residual"))
             self.epoch = int(sd["epoch"])
             self.local_step = int(sd["local_step"])
             # older checkpoints lack samples_in_epoch; reconstruct so a

@@ -80,6 +80,17 @@ def _pg_f32(masters, params, with_norm):
     return pg, (_sqsum(pg) if with_norm else jnp.zeros((), jnp.float32))
 
 
+@functools.partial(jax.jit, static_argnames=("with_norm",))
+def _pg_f32_ef(masters, params, res, with_norm):
+    """Error-feedback pseudo-gradient: the residual add is fused into the
+    same dispatch (pg = master - params + residual). Error feedback forces
+    full-width D2H (see __init__), so no wire-cast variant exists — the
+    host must see the exact f32 values it will encode to measure the true
+    roundtrip error."""
+    pg = [m - p + r for m, p, r in zip(masters, params, res)]
+    return pg, (_sqsum(pg) if with_norm else jnp.zeros((), jnp.float32))
+
+
 @functools.partial(
     jax.jit, static_argnames=("wire_dtype", "with_norm", "keep32")
 )
@@ -213,6 +224,28 @@ def _stream_launch_fused(
     return wire, delta, est_m
 
 
+@functools.partial(jax.jit, static_argnames=("nesterov", "has_mom", "eager"))
+def _stream_launch_fused_ef(
+    masters, bufs, params, res, lr, momentum, *, nesterov, has_mom, eager
+):
+    """``_stream_launch_fused`` with the error-feedback residual add fused
+    in (pg = master - params + residual) and no wire cast (error feedback
+    forces full-width D2H). Same contract: nothing donated, the live plane
+    is NOT rebound, every output is freshly computed."""
+    pg = [m - p + r for m, p, r in zip(masters, params, res)]
+    wire = pg
+    if not eager:
+        boundary = [
+            p.astype(jnp.float32) + jnp.zeros((), jnp.float32) for p in params
+        ]
+        return wire, boundary, []
+    est_m, _, _ = _nesterov_step(
+        masters, bufs, pg, lr, momentum, nesterov, has_mom
+    )
+    delta = [e - p for e, p in zip(est_m, params)]
+    return wire, delta, est_m
+
+
 @functools.partial(jax.jit, donate_argnums=(1,))
 def _overwrite_fused(masters, params):
     # params <- master. The add-zero is load-bearing: a bare passthrough
@@ -283,12 +316,22 @@ class DeviceOuterPlane:
         momentum: float,
         nesterov: bool,
         compression: str = "none",
+        error_feedback: bool = False,
     ):
         self.lr = float(lr)
         self.momentum = float(momentum)
         self.nesterov = bool(nesterov)
+        self.error_feedback = bool(error_feedback)
         wire = device_wire_dtype(compression)
+        if self.error_feedback:
+            # full-width D2H: the host measures the codec roundtrip error
+            # against the exact f32 pseudo-gradient; a device wire cast
+            # (fp16) would hide the cast error from the residual
+            wire = None
         self._wire_dtype = jnp.dtype(wire) if wire is not None else None
+        # per-leaf error-feedback residuals in HBM (zeros-initialized
+        # lazily at the first EF pseudo-gradient; None when EF is off)
+        self.ef_res: Optional[list[jax.Array]] = None
         self.shardings = jax.tree.leaves(trainer.state_shardings["params"])
         if len(self.shardings) != len(list(param_leaves)):
             raise ValueError("param leaves / shardings mismatch")
@@ -319,6 +362,13 @@ class DeviceOuterPlane:
             # zeros for ALL leaves at the first armed step (OuterSGD
             # semantics: untouched fragments keep their momentum frozen)
             self.bufs = [
+                jax.device_put(np.zeros(m.shape, np.float32), s)
+                for m, s in zip(self.masters, self.shardings)
+            ]
+
+    def _ensure_ef(self) -> None:
+        if self.error_feedback and self.ef_res is None:
+            self.ef_res = [
                 jax.device_put(np.zeros(m.shape, np.float32), s)
                 for m, s in zip(self.masters, self.shardings)
             ]
@@ -371,6 +421,11 @@ class DeviceOuterPlane:
                     m, p, wire_dtype=self._wire_dtype,
                     with_norm=with_norm, keep32=keep_device,
                 )
+            elif self.error_feedback:
+                self._ensure_ef()
+                r = self._sel(self.ef_res, frag)
+                pg32, sq = _pg_f32_ef(m, p, r, with_norm=with_norm)
+                wire = pg32
             else:
                 pg32, sq = _pg_f32(m, p, with_norm=with_norm)
                 wire = pg32
@@ -519,11 +574,20 @@ class DeviceOuterPlane:
             b = self._sel(self.bufs, frag)
             p = [param_leaves[i] for i in frag]
             lr, mom = self._scalars()
-            wire, aux, est_m = _stream_launch_fused(
-                m, b, p, lr, mom,
-                wire_dtype=self._wire_dtype, nesterov=self.nesterov,
-                has_mom=self._has_mom, eager=eager,
-            )
+            if self.error_feedback:
+                self._ensure_ef()
+                r = self._sel(self.ef_res, frag)
+                wire, aux, est_m = _stream_launch_fused_ef(
+                    m, b, p, r, lr, mom,
+                    nesterov=self.nesterov, has_mom=self._has_mom,
+                    eager=eager,
+                )
+            else:
+                wire, aux, est_m = _stream_launch_fused(
+                    m, b, p, lr, mom,
+                    wire_dtype=self._wire_dtype, nesterov=self.nesterov,
+                    has_mom=self._has_mom, eager=eager,
+                )
         if eager:
             return wire, aux, est_m
         return wire, None, aux
@@ -587,7 +651,49 @@ class DeviceOuterPlane:
             merged[i] = fresh[j]
         return merged
 
+    def set_ef_residuals(
+        self, idxs: Sequence[int], host_errs: list[np.ndarray]
+    ) -> None:
+        """Commit hook for the ErrorFeedback ledger: adopt the round's
+        roundtrip errors as the live device residuals for ``idxs``."""
+        with self.lock:
+            self._ensure_ef()
+            merged = list(self.ef_res)
+            for i, e in zip(idxs, host_errs):
+                merged[i] = jax.device_put(
+                    np.asarray(e, np.float32), self.shardings[i]
+                )
+            self.ef_res = merged
+
     # -- host boundary (serve / checkpoint / state averaging) --------------
+
+    def ef_host_state(self) -> Optional[list[np.ndarray]]:
+        """Host snapshot of the error-feedback residuals (None before any
+        committed round). Same donation-race discipline as host_state —
+        though nothing ever donates ef_res leaves, the lock keeps the
+        fetch consistent with a concurrent commit."""
+        with self.lock:
+            if self.ef_res is None:
+                return None
+            fetched = jax.device_get(self.ef_res)
+        return [_own(x) for x in fetched]
+
+    def load_ef(self, residuals_np: Optional[Sequence]) -> None:
+        """Adopt checkpointed residuals; None entries (host-placement
+        checkpoints with partially-committed leaves) load as zeros."""
+        with self.lock:
+            if residuals_np is None:
+                self.ef_res = None
+                return
+            self.ef_res = [
+                jax.device_put(
+                    np.zeros(m.shape, np.float32)
+                    if r is None
+                    else np.asarray(r, np.float32),
+                    s,
+                )
+                for r, m, s in zip(residuals_np, self.masters, self.shardings)
+            ]
 
     def host_state(
         self, refs: Optional[tuple] = None

@@ -206,7 +206,7 @@ class StreamScheduler:
             rec["retained"] = retained
             if eager:
                 state = opt._apply_frag_delta(state, frag, delta)
-            fut = self._spawn(k, epoch, wire=wire)
+            fut = self._spawn(k, epoch, wire=wire, ef_rec=rec)
         else:
             # host placement: own the boundary bytes NOW, on the training
             # thread — the next train_step donates these param buffers,
@@ -216,6 +216,12 @@ class StreamScheduler:
                 for x in jax.device_get([leaves[i] for i in frag])
             ]
             pg = [native.sub(opt.master[i], b) for i, b in zip(frag, bh)]
+            if opt._ef is not None:
+                # residual folded in before BOTH the wire send and the
+                # eager estimate below (the estimate must match what the
+                # swarm will average); the fragment's roundtrip error
+                # stages pending until this round lands
+                opt._ef.prepare(rec["round"], frag, pg)
             rec["placement"] = "host"
             oo = opt.outer_opt
             if eager:
@@ -254,6 +260,7 @@ class StreamScheduler:
         *,
         pg: Optional[list] = None,
         wire: Optional[list] = None,
+        ef_rec: Optional[dict] = None,
     ):
         """Open fragment k's all-reduce on a daemon comm thread. Device
         placement hands over the (never-donated) wire jit outputs and the
@@ -275,6 +282,16 @@ class StreamScheduler:
                         x if x.dtype == np.float32 else x.astype(np.float32)
                         for x in fetched
                     ]
+                    if opt._ef is not None and ef_rec is not None:
+                        # device placement: the plane's jit already added
+                        # the residual; stage this fragment's roundtrip
+                        # error here on the comm thread, where the host pg
+                        # first exists (ErrorFeedback's pending map is
+                        # lock-guarded — fragment rounds prepare
+                        # concurrently)
+                        opt._ef.prepare(
+                            ef_rec["round"], ef_rec["frag"], arrays
+                        )
                 avg, n = opt.backend.all_reduce(
                     arrays,
                     timeout=opt.cfg.averaging_timeout,
@@ -310,11 +327,18 @@ class StreamScheduler:
             log.warning(
                 "fragment %d round (epoch %d) dropped: %s", k, rec["epoch"], e
             )
+            if opt._ef is not None:
+                # discard the staged error; the retained residual is
+                # neither lost nor double-counted (the next fragment
+                # pseudo-gradient re-captures the dropped update)
+                opt._ef.abort(rec["round"])
             if tr is not None:
                 tr.count("outer_fragment_rounds_dropped")
                 tr.gauge("outer_inflight_fragments", len(self._inflight))
             return state
         opt._check_group_size(group)
+        if opt._ef is not None:
+            opt._ef.commit(rec["round"])
         frag = rec["frag"]
         if rec["placement"] == "device":
             if rec["eager"]:

@@ -36,7 +36,12 @@ import numpy as np
 from opendiloco_tpu import obs
 from opendiloco_tpu.diloco import chaos, linkstate
 from opendiloco_tpu.diloco.backend import AllReduceError, OuterBackend, PeerProgress
-from opendiloco_tpu.diloco.compression import Codec, chunk_bounds, get_codec
+from opendiloco_tpu.diloco.compression import (
+    Codec,
+    chunk_bounds,
+    get_codec,
+    record_wire,
+)
 from opendiloco_tpu.diloco.wire import (
     STREAM_LIMIT,
     WireError,
@@ -934,10 +939,12 @@ class TcpBackend(OuterBackend):
                             self.links.rtt_to(peer_id) or 0.0,
                         )
                 try:
+                    wire_align = getattr(self.codec, "wire_align_bytes", 1)
                     await self._loop.run_in_executor(
                         None,
                         lambda: self._bulk_sender.send(
-                            host, bulk_port, msg, meta, payload
+                            host, bulk_port, msg, meta, payload,
+                            align=wire_align,
                         ),
                     )
                     if adaptive:
@@ -1453,6 +1460,7 @@ class TcpBackend(OuterBackend):
         # 3. push part j to its owner
         async def push(j):
             payload, cmeta = encode(parts[j])
+            record_wire(codec.name, parts[j].size * 4, len(payload))
             await self._send_part(
                 group[j]["host"],
                 group[j]["port"],
@@ -1734,14 +1742,19 @@ class TcpBackend(OuterBackend):
                 bps = self.links.bps_to(pid)
                 if bps:
                     ce = linkstate.chunk_elems_for(
-                        bps, self.links.rtt_to(pid) or 0.0, chunk_elems
+                        bps, self.links.rtt_to(pid) or 0.0, chunk_elems,
+                        align=align,
                     )
             state = await loop.run_in_executor(None, chunk_state_fn, part)
             grid = chunk_bounds(part.size, ce, align)
             nchunks = len(grid) - 1
 
             def enc(k):
-                return enc_chunk(part[grid[k] : grid[k + 1]], state)
+                payload, cmeta = enc_chunk(part[grid[k] : grid[k + 1]], state)
+                record_wire(
+                    codec.name, (grid[k + 1] - grid[k]) * 4, len(payload)
+                )
+                return payload, cmeta
 
             send, close = self._chunk_sender(group[j], deadline)
             nxt = loop.run_in_executor(None, enc, 0)

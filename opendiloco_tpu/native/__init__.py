@@ -110,6 +110,14 @@ def get_lib() -> Optional[ctypes.CDLL]:
         lib.odtp_sqnorm_f32.restype = ctypes.c_double
     except AttributeError:
         pass
+    try:  # version-6 kernels (4-bit blockwise codec)
+        lib.odtp_quantize_blockwise4.argtypes = [f32p, u8p, u16p, st, st]
+        lib.odtp_dequantize_blockwise4.argtypes = [u8p, u16p, f32p, st, st]
+        lib.odtp_dequantize_blockwise4_accumulate.argtypes = [
+            u8p, u16p, f32p, st, st,
+        ]
+    except AttributeError:
+        pass
     for fn in (lib.odtp_sendall, lib.odtp_recvall):
         fn.argtypes = [ctypes.c_int, ctypes.c_void_p, st]
         fn.restype = ctypes.c_int
@@ -361,6 +369,104 @@ def dequant8_accumulate(payload: bytes, scales_payload: bytes, dst: np.ndarray, 
     scales = np.frombuffer(scales_payload, np.float32)
     lib.odtp_dequantize_blockwise_i8_accumulate(
         _i8p(q), _f32p(scales), _f32p(dst), dst.size, block
+    )
+
+
+def quantize_blockwise4(a: np.ndarray, block: int) -> tuple[bytes, bytes]:
+    """4-bit blockwise quantize -> (packed nibble payload, fp16 scales
+    payload). Element 2i is the low nibble of byte i, element 2i+1 the high
+    nibble; an odd tail leaves the final high nibble 0 (NOT quantized zero,
+    which would be 8). Quantization runs against the fp16-ROUNDED scale so
+    encode and decode use the same value. ``block`` must be even so block
+    boundaries land on byte boundaries."""
+    if block % 2:
+        raise ValueError(f"block must be even for nibble packing, got {block}")
+    lib = get_lib()
+    a = np.ascontiguousarray(a, np.float32).reshape(-1)
+    nblocks = (a.size + block - 1) // block
+    if not _has(lib, "odtp_quantize_blockwise4"):
+        pad = (-a.size) % block
+        padded = np.pad(a, (0, pad)).reshape(-1, block)
+        amax = np.max(np.abs(padded), axis=1) if nblocks else np.zeros(0, np.float32)
+        s = np.where(amax > 0, amax, np.float32(1.0)).astype(np.float32)
+        # clamp into the fp16 normal range, same as the C kernel: an amax
+        # above 65504 would round to f16 inf (NaN payload on decode), one
+        # below the min normal would flush the whole block
+        np.clip(s, np.float32(6.1035156e-05), np.float32(65504.0), out=s)
+        s16 = s.astype(np.float16)
+        inv = np.float32(7.0) / s16.astype(np.float32)
+        q = np.clip(np.round(padded * inv[:, None]), -7, 7)
+        nib = (q.reshape(-1)[: a.size] + 8).astype(np.uint8)
+        if a.size % 2:
+            nib = np.append(nib, np.uint8(0))
+        packed = nib[0::2] | (nib[1::2] << 4)
+        return packed.tobytes(), s16.view(np.uint16).tobytes()
+    packed = np.empty((a.size + 1) // 2, np.uint8)
+    scales = np.empty(nblocks, np.uint16)
+    lib.odtp_quantize_blockwise4(
+        _f32p(a), _u8p(packed), _u16p(scales), a.size, block
+    )
+    return packed.tobytes(), scales.tobytes()
+
+
+def _dequant4_numpy(
+    packed: np.ndarray, scales: np.ndarray, n: int, block: int
+) -> np.ndarray:
+    nib = np.empty(2 * packed.size, np.uint8)
+    nib[0::2] = packed & 0x0F
+    nib[1::2] = packed >> 4
+    q = nib[:n].astype(np.float32) - np.float32(8.0)
+    s = scales[: (n + block - 1) // block].view(np.float16).astype(
+        np.float32
+    ) / np.float32(7.0)
+    qp = np.pad(q, (0, (-n) % block)).reshape(-1, block)
+    return (qp * s[:, None]).reshape(-1)[:n]
+
+
+def dequantize_blockwise4(
+    payload: bytes, scales_payload: bytes, n: int, block: int,
+    out: Optional[np.ndarray] = None,
+) -> np.ndarray:
+    if block % 2:
+        raise ValueError(f"block must be even for nibble packing, got {block}")
+    lib = get_lib()
+    packed = np.frombuffer(payload, np.uint8)
+    scales = np.frombuffer(scales_payload, np.uint16)
+    _check_len(packed.size, (n + 1) // 2, "dequantize_blockwise4")
+    _check_len(scales.size, (n + block - 1) // block, "dequantize_blockwise4 scales")
+    if out is None:
+        out = np.empty(n, np.float32)
+    else:
+        _check_out(out, n)
+    if not _has(lib, "odtp_dequantize_blockwise4"):
+        out[:] = _dequant4_numpy(packed, scales, n, block)
+        return out
+    lib.odtp_dequantize_blockwise4(_u8p(packed), _u16p(scales), _f32p(out), n, block)
+    return out
+
+
+def dequant4_accumulate(
+    payload: bytes, scales_payload: bytes, dst: np.ndarray, block: int
+) -> None:
+    """dst += dequantize_blockwise4(payload) in one fused pass."""
+    lib = get_lib()
+    packed = np.frombuffer(payload, np.uint8)
+    scales = np.frombuffer(scales_payload, np.uint16)
+    _check_len(packed.size, (dst.size + 1) // 2, "dequant4_accumulate")
+    _check_len(
+        scales.size,
+        (dst.size + block - 1) // block,
+        "dequant4_accumulate scales",
+    )
+    if (
+        not _has(lib, "odtp_dequantize_blockwise4_accumulate")
+        or dst.dtype != np.float32
+        or not dst.flags.c_contiguous
+    ):
+        dst += _dequant4_numpy(packed, scales, dst.size, block).reshape(dst.shape)
+        return
+    lib.odtp_dequantize_blockwise4_accumulate(
+        _u8p(packed), _u16p(scales), _f32p(dst), dst.size, block
     )
 
 

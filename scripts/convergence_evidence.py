@@ -49,6 +49,15 @@ ARMS = {
     # streaming eager outer sync path, judged against the blocking
     # diloco curve banked beside it
     "streaming_eager": (2, {"overlap_comm": "eager"}),
+    # sub-8-bit outer compression: the 8-bit blockwise baseline and the
+    # 4-bit blockwise + error-feedback arm it is judged against (the
+    # residual re-injects each round's quantization error, so the curve
+    # must stay within noise of the 8-bit one)
+    "compress_8bit": (0, {"compression": "blockwise8bit"}),
+    "compress_4bit_ef": (
+        0,
+        {"compression": "blockwise4bit", "error_feedback": True},
+    ),
 }
 
 
@@ -128,9 +137,13 @@ def main(arms: str = "all"):
     # --- 2-worker DiLoCo over loopback, threads like the oracle test ----
     def run_diloco_pair(streaming_fragments: int, **cfg_overrides):
         """Returns (per-worker losses, worker-0 final params, wall_s).
-        ``cfg_overrides`` select the outer-mode arm (gossip / overlap-comm);
-        every arm shares the data stream, init, and held-out eval."""
-        world = LoopbackWorld(2)
+        ``cfg_overrides`` select the outer-mode arm (gossip / overlap-comm /
+        compression); every arm shares the data stream, init, and held-out
+        eval. The loopback wire roundtrips the arm's codec, so a
+        compression arm's curve carries the real quantization error."""
+        world = LoopbackWorld(
+            2, compression=cfg_overrides.get("compression", "none")
+        )
         backends = world.make_backends()
         losses = [[], []]
         params = [None, None]

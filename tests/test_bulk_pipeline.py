@@ -24,7 +24,7 @@ _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 ALL_CODECS = [
     "none", "fp16", "scaled-fp16", "uniform8bit", "quantile8bit",
-    "blockwise8bit",
+    "blockwise8bit", "blockwise4bit", "topk",
 ]
 # codecs whose chunk payloads carry no per-chunk side-channel: their
 # concatenated chunk payloads must equal the whole-part payload byte-for-byte

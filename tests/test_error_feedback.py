@@ -1,0 +1,332 @@
+"""Error-feedback residual layer for sub-8-bit outer compression
+(diloco/error_feedback.py + the optimizer/plane/streaming hooks).
+
+The contract: each round's codec roundtrip error is stashed PENDING at
+prepare, adopted as the live residual only at commit, and discarded at
+abort with the PREVIOUS residual retained — a dropped round's update is
+re-captured by the next pseudo-gradient (master - params), so the retained
+residual is neither lost nor double-counted. Residuals survive
+checkpointing across placements and per-fragment streaming.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from opendiloco_tpu.config import DilocoConfig
+from opendiloco_tpu.diloco import DiLoCoOptimizer, LoopbackWorld
+from opendiloco_tpu.diloco.compression import get_codec, record_wire
+from opendiloco_tpu.diloco.error_feedback import ErrorFeedback
+from opendiloco_tpu.diloco.outer_device import DeviceOuterPlane
+
+from test_outer_placement import _wait_inflight, batches, make_trainer
+
+
+def run_ef(
+    tiny_cfg,
+    placement,
+    *,
+    n_steps=6,
+    local_steps=3,
+    frags=0,
+    compression="blockwise4bit",
+):
+    trainer = make_trainer(tiny_cfg)
+    state = trainer.init_state(jax.random.key(7))
+    world = LoopbackWorld(1, compression=compression)
+    (backend,) = world.make_backends()
+    cfg = DilocoConfig(
+        local_steps=local_steps,
+        backend="loopback",
+        outer_placement=placement,
+        compression=compression,
+        error_feedback=True,
+        streaming_fragments=frags,
+    )
+    opt = DiLoCoOptimizer(trainer, backend, cfg, state, batch_size=8)
+    losses = []
+    for ids, labels in batches(0, tiny_cfg.vocab_size, n_steps):
+        b = trainer.shard_batch(ids, labels, accum=1)
+        state, m = opt.step(state, b)
+        losses.append(float(m["loss"]))
+        _wait_inflight(opt)
+    state = opt.flush(state)
+    return losses, state, opt
+
+
+def _residuals(opt):
+    """Host view of the live residuals under either placement."""
+    if opt.placement == "device":
+        return opt._plane.ef_host_state()
+    return opt._ef.host_residuals()
+
+
+# ---------------------------------------------------------------------------
+# ErrorFeedback ledger unit behavior
+# ---------------------------------------------------------------------------
+
+
+def test_ef_prepare_commit_abort():
+    codec = get_codec("blockwise4bit")
+    ef = ErrorFeedback(codec, 2)
+    rng = np.random.default_rng(0)
+    g = [rng.normal(size=(8, 513)).astype(np.float32) for _ in range(2)]
+
+    # round 1: no residual yet — prepare must not touch the pseudo-gradient
+    pgs = [x.copy() for x in g]
+    ef.prepare("main", [0, 1], pgs)
+    for got, want in zip(pgs, g):
+        np.testing.assert_array_equal(got, want)
+    assert ef.residual[0] is None  # nothing adopted until commit
+    ef.commit("main")
+    r1 = [ef.residual[i].copy() for i in range(2)]
+    for r, x in zip(r1, g):
+        assert np.isfinite(r).all() and np.abs(r).max() > 0
+        # 4-bit quantization error is bounded by half a bin per element
+        assert np.abs(r).max() <= np.abs(x).max() / 7.0
+
+    # round 2: prepare folds the committed residual into the pg in place
+    pgs2 = [x.copy() for x in g]
+    ef.prepare("main", [0, 1], pgs2)
+    for got, base, r in zip(pgs2, g, r1):
+        np.testing.assert_array_equal(got, base + r.reshape(base.shape))
+    ef.commit("main")
+    r2 = [ef.residual[i].copy() for i in range(2)]
+
+    # round 3 drops: pending discarded, round-2 residual stays live
+    ef.prepare("main", [0, 1], [x.copy() for x in g])
+    ef.abort("main")
+    assert ef._pending == {}
+    for i in range(2):
+        np.testing.assert_array_equal(ef.residual[i], r2[i])
+    ef.commit("main")  # commit after abort is a no-op (nothing pending)
+    for i in range(2):
+        np.testing.assert_array_equal(ef.residual[i], r2[i])
+
+
+@pytest.mark.parametrize("name", ["blockwise4bit", "topk"])
+def test_ef_mass_conservation(name):
+    """The defining EF invariant: over N rounds with a constant true
+    gradient g, everything that ever hit the wire plus the final residual
+    equals N*g — compression delays signal, it never loses it."""
+    codec = get_codec(name)
+    ef = ErrorFeedback(codec, 1)
+    rng = np.random.default_rng(5)
+    g = rng.normal(size=5000).astype(np.float32)
+    total = np.zeros_like(g)
+    for _ in range(5):
+        pg = g.copy()
+        ef.prepare("main", [0], [pg])
+        err = ef._pending["main"][1][0]
+        total += pg - err.reshape(pg.shape)  # the decoded wire payload
+        ef.commit("main")
+    total += ef.residual[0].reshape(g.shape)
+    np.testing.assert_allclose(total, 5 * g, rtol=1e-4, atol=1e-5)
+
+
+def test_config_rejects_unsupported_ef_combos():
+    from pydantic import ValidationError
+
+    DilocoConfig(
+        local_steps=3,
+        backend="loopback",
+        compression="blockwise4bit",
+        error_feedback=True,
+    )
+    with pytest.raises(ValidationError):
+        # EF without a lossy codec has no error to feed back
+        DilocoConfig(
+            local_steps=3,
+            backend="loopback",
+            compression="none",
+            error_feedback=True,
+        )
+    with pytest.raises(ValidationError):
+        # gossip mixes state, not pseudo-gradients; no per-round wire error
+        DilocoConfig(
+            local_steps=3,
+            backend="loopback",
+            compression="blockwise4bit",
+            error_feedback=True,
+            outer_mode="gossip",
+        )
+
+
+# ---------------------------------------------------------------------------
+# device plane residual storage
+# ---------------------------------------------------------------------------
+
+
+def _make_plane_ef(tiny_cfg, compression):
+    trainer = make_trainer(tiny_cfg)
+    state = trainer.init_state(jax.random.key(11))
+    leaves = jax.tree.leaves(state["params"])
+    plane = DeviceOuterPlane(
+        trainer,
+        leaves,
+        lr=0.7,
+        momentum=0.9,
+        nesterov=True,
+        compression=compression,
+        error_feedback=True,
+    )
+    return plane, leaves
+
+
+def test_plane_ef_forces_full_width_wire(tiny_cfg):
+    """Under EF the D2H must carry the exact f32 pseudo-gradient — a device
+    fp16 pre-cast would hide the cast error from the residual."""
+    plane, _ = _make_plane_ef(tiny_cfg, compression="fp16")
+    assert plane._wire_dtype is None
+
+
+def test_plane_ef_pseudo_grad_includes_residual(tiny_cfg):
+    plane, leaves = _make_plane_ef(tiny_cfg, compression="blockwise4bit")
+    moved = [x - 1e-3 for x in leaves]
+    pg0, _, _ = plane.pseudo_grad(moved)  # residual lazily zeros
+    res = [np.full(m.shape, 1e-2, np.float32) for m in plane.masters]
+    plane.set_ef_residuals(range(len(res)), res)
+    got = plane.ef_host_state()
+    for a, b in zip(got, res):
+        np.testing.assert_array_equal(a, b)
+    pg1, _, _ = plane.pseudo_grad(moved)
+    for a, b, r in zip(pg1, pg0, res):
+        np.testing.assert_allclose(a, b + r, rtol=1e-6, atol=1e-7)
+    # load_ef(None) resets to the lazily-zeroed state
+    plane.load_ef(None)
+    assert plane.ef_res is None
+    pg2, _, _ = plane.pseudo_grad(moved)
+    for a, b in zip(pg2, pg0):
+        np.testing.assert_array_equal(a, b)
+
+
+# ---------------------------------------------------------------------------
+# end-to-end rounds under both placements, blocking and streaming
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "placement,frags",
+    [
+        pytest.param("host", 0, id="host-blocking"),
+        pytest.param("device", 0, id="device-blocking"),
+        pytest.param("host", 3, id="host-streaming"),
+        pytest.param("device", 3, id="device-streaming"),
+    ],
+)
+def test_ef_rounds_populate_residual(tiny_cfg, placement, frags):
+    losses, _, opt = run_ef(tiny_cfg, placement, frags=frags)
+    assert all(np.isfinite(x) for x in losses)
+    assert opt.epoch >= 1
+    res = _residuals(opt)
+    assert res is not None
+    assert any(r is not None and np.abs(r).max() > 0 for r in res)
+    sd = opt.state_dict()
+    assert sd.get("ef_residual") is not None
+
+
+@pytest.mark.parametrize(
+    "src,dst", [("device", "host"), ("host", "device")]
+)
+def test_ef_state_dict_roundtrip_across_placements(tiny_cfg, src, dst):
+    """The residual is part of the checkpoint and restores bit-for-bit
+    under either placement (host-view schema both ways)."""
+    _, _, opt = run_ef(tiny_cfg, src)
+    sd = opt.state_dict()
+    assert sd["ef_residual"] is not None
+    trainer = make_trainer(tiny_cfg)
+    state = trainer.init_state(jax.random.key(9))
+    world = LoopbackWorld(1, compression="blockwise4bit")
+    (backend,) = world.make_backends()
+    opt2 = DiLoCoOptimizer(
+        trainer,
+        backend,
+        DilocoConfig(
+            local_steps=3,
+            backend="loopback",
+            outer_placement=dst,
+            compression="blockwise4bit",
+            error_feedback=True,
+        ),
+        state,
+        8,
+    )
+    opt2.load_state_dict(sd)
+    res2 = _residuals(opt2)
+    assert res2 is not None
+    for a, b in zip(sd["ef_residual"], res2):
+        np.testing.assert_array_equal(np.asarray(a, np.float32), b)
+    # the restored optimizer keeps training (and keeps committing rounds)
+    for ids, labels in batches(5, tiny_cfg.vocab_size, 3):
+        state, m = opt2.step(state, trainer.shard_batch(ids, labels, accum=1))
+        assert np.isfinite(m["loss"])
+    assert opt2.epoch == opt.epoch + 1
+
+
+def test_ef_residual_survives_dropped_round(tiny_cfg):
+    """A wire failure at the outer boundary aborts the pending errors and
+    keeps the last committed residual: the next pseudo-gradient re-captures
+    the dropped update, so nothing is lost or double-counted."""
+    trainer = make_trainer(tiny_cfg)
+    state = trainer.init_state(jax.random.key(7))
+    world = LoopbackWorld(1, compression="blockwise4bit")
+    (backend,) = world.make_backends()
+    cfg = DilocoConfig(
+        local_steps=3,
+        backend="loopback",
+        outer_placement="host",
+        compression="blockwise4bit",
+        error_feedback=True,
+    )
+    opt = DiLoCoOptimizer(trainer, backend, cfg, state, batch_size=8)
+    data = list(batches(0, tiny_cfg.vocab_size, 9))
+    for ids, labels in data[:3]:  # round 1 commits normally
+        state, _ = opt.step(state, trainer.shard_batch(ids, labels, accum=1))
+    assert opt.epoch == 1
+    r1 = [r.copy() for r in opt._ef.residual]
+
+    for ids, labels in data[3:5]:  # mid-phase inner steps, no boundary
+        state, _ = opt.step(state, trainer.shard_batch(ids, labels, accum=1))
+
+    # fail the boundary directly (step() would donate the inner state into
+    # the train_step before the outer exception could hand it back)
+    real = opt._wan_all_reduce
+    opt._wan_all_reduce = lambda *a, **k: (_ for _ in ()).throw(
+        RuntimeError("injected wire failure")
+    )
+    with pytest.raises(RuntimeError, match="injected wire failure"):
+        opt.outer_step(state)
+    assert opt.epoch == 1  # the round was dropped
+    assert opt._ef._pending == {}
+    for a, b in zip(opt._ef.residual, r1):
+        np.testing.assert_array_equal(a, b)
+
+    # wire heals: the very next boundary commits and advances the residual
+    opt._wan_all_reduce = real
+    ids, labels = data[5]
+    state, m = opt.step(state, trainer.shard_batch(ids, labels, accum=1))
+    assert np.isfinite(m["loss"]) and opt.epoch == 2
+    assert any(
+        not np.array_equal(a, b) for a, b in zip(opt._ef.residual, r1)
+    )
+
+
+# ---------------------------------------------------------------------------
+# wire accounting
+# ---------------------------------------------------------------------------
+
+
+def test_record_wire_counters(monkeypatch):
+    monkeypatch.setenv("ODTP_OBS", "test-ef-wire")
+    from opendiloco_tpu.obs import trace
+
+    tr = trace.tracer()
+    assert tr is not None
+    record_wire("blockwise4bit", 4096 * 4, 4096 // 2 + 2)
+    snap = tr.snapshot()
+    labels = (("codec", "blockwise4bit"),)
+    assert snap["counters"][("outer_raw_bytes", labels)] == 4096 * 4
+    assert snap["counters"][("outer_wire_bytes", labels)] == 4096 // 2 + 2
+    ratio = snap["gauges"][("outer_compression_ratio", labels)]
+    assert ratio > 2.0  # sub-8-bit: beats the 8-bit codecs' ~4x

@@ -242,6 +242,23 @@ def test_chunk_elems_for_clamps():
     assert linkstate.chunk_elems_for(1e12, 1.0, 2 << 20) == (32 << 20) // 4
 
 
+def test_chunk_elems_for_codec_align():
+    """Chunk sizes snap DOWN to the codec's chunk_align so pipeline chunk
+    boundaries stay on block grids (blockwise4bit packs nibbles per 4096
+    block; a misaligned boundary would change the block grid and break
+    chunked/whole bit-parity)."""
+    # 1 GB/s x 20 ms = 20 MB -> 5e6 elems; 5e6 % 4096 != 0 -> rounds down
+    ce = linkstate.chunk_elems_for(1e9, 0.02, 2 << 20, align=4096)
+    assert ce == (int(2e7) // 4) - (int(2e7) // 4) % 4096
+    assert ce % 4096 == 0 and ce > 0
+    # align=1 (default) leaves historic values untouched
+    assert linkstate.chunk_elems_for(1e9, 0.02, 2 << 20) == int(2e7) // 4
+    # never rounds below align itself, even when the fallback is tiny
+    assert linkstate.chunk_elems_for(0.0, 0.01, 100, align=4096) == 4096
+    # already-aligned results pass through unchanged
+    assert linkstate.chunk_elems_for(0.0, 0.01, 8192, align=4096) == 8192
+
+
 def test_hedge_deadline(monkeypatch):
     monkeypatch.delenv("ODTP_LINK_HEDGE_FACTOR", raising=False)  # default 3
     d = linkstate.hedge_deadline_s(8 << 20, 100e6, 0.002, 4)

@@ -141,6 +141,54 @@ def test_scaled_f16_native_matches_fallback(arrs):
     np.testing.assert_array_equal(out, dec)
 
 
+@pytest.mark.parametrize("n", [0, 1, 7, 4096, 4097, 8193, 10_000])
+def test_blockwise4_native_matches_fallback(n):
+    """Native 4-bit blockwise quantize/dequant/accumulate are bit-identical
+    to the numpy fallback -- the wire-compatibility contract between peers
+    built with and without libodtp.so (satellite: parity gate)."""
+    rng = np.random.default_rng(11)
+    a = rng.normal(size=n).astype(np.float32) * 3.0
+    if n > 4096:
+        a[4096:4100] *= 1e4  # distinct per-block scales
+    b = rng.normal(size=n).astype(np.float32)
+    payload, scales = native.quantize_blockwise4(a, 4096)
+    dec = native.dequantize_blockwise4(payload, scales, n, 4096)
+    dst = b.copy()
+    native.dequant4_accumulate(payload, scales, dst, 4096)
+
+    nm = _without_native()
+    lib, tried = nm._lib, nm._tried
+    nm._lib, nm._tried = None, True
+    try:
+        payload_ref, scales_ref = native.quantize_blockwise4(a, 4096)
+        dec_ref = native.dequantize_blockwise4(payload_ref, scales_ref, n, 4096)
+        dst_ref = b.copy()
+        native.dequant4_accumulate(payload_ref, scales_ref, dst_ref, 4096)
+    finally:
+        nm._lib, nm._tried = lib, tried
+    if not native.available():
+        pytest.skip("native lib not built")
+    assert payload == payload_ref
+    assert scales == scales_ref
+    np.testing.assert_array_equal(dec, dec_ref)
+    np.testing.assert_array_equal(dst, dst_ref)
+    # decode straight into a destination slice
+    if n:
+        out = np.empty(n + 8, np.float32)[4:-4]
+        native.dequantize_blockwise4(payload, scales, n, 4096, out=out)
+        np.testing.assert_array_equal(out, dec)
+
+
+def test_blockwise4_odd_tail_nibble_zero():
+    """The pad nibble of an odd-length tensor is 0 on the wire, so payloads
+    are reproducible byte-for-byte across encoders."""
+    a = np.full(5, 7.0, np.float32)
+    payload, _ = native.quantize_blockwise4(a, 4096)
+    assert len(payload) == 3
+    # elem 4 -> low nibble of byte 2; high nibble must be the pad 0
+    assert payload[2] >> 4 == 0
+
+
 def test_lut256_native_matches_fallback(arrs):
     a, b = arrs
     rng = np.random.default_rng(3)
