@@ -18,3 +18,9 @@ Layout:
 """
 
 __version__ = "0.1.0"
+
+# Arm the runtime lock-order witness before any package lock is created.
+# ODTP_LOCKCHECK unset (the default) makes this a single dict lookup.
+from opendiloco_tpu.analysis import lockcheck as _lockcheck
+
+_lockcheck.maybe_install()

@@ -1,0 +1,27 @@
+"""odtp-check: the invariant lint + sanitizer plane.
+
+Four static passes over ``opendiloco_tpu/`` + ``scripts/`` keep the
+stack's core invariants machine-checked instead of reviewer-remembered:
+
+    knob_check  -- every ODTP_* env knob read resolves to the declarative
+                   registry (knobs.py); undeclared, dead and
+                   default-mismatched knobs fail the build, and the README
+                   knob table is generated from the registry.
+    donation    -- use-after-donate on jit'd donated buffers, jitted
+                   closures capturing mutable ``self`` state, unhashable
+                   static args.
+    locks       -- the static lock-acquisition order graph across the
+                   threaded planes must stay acyclic (lockcheck.py is the
+                   matching ODTP_LOCKCHECK=1 runtime witness).
+    wire_check  -- encode/decode struct layouts, chunk meta keys, the C++
+                   daemon's frame header and codec wire geometry must all
+                   match the single declaration in diloco/schema.py.
+
+Driver: ``python scripts/odtp_lint.py`` (exit 1 on any finding).
+Suppression: append ``# odtp-lint: disable=<check> -- <why>`` to the
+flagged line; the justification text is mandatory.
+"""
+
+from opendiloco_tpu.analysis.common import Finding, iter_py_files, parse_file
+
+__all__ = ["Finding", "iter_py_files", "parse_file"]

@@ -1,0 +1,184 @@
+"""Shared infrastructure for the static passes: findings, inline
+suppressions, file iteration and a small constant folder.
+
+A finding names the check that fired, the site, and the invariant broken.
+Suppression is per-line and must carry a justification:
+
+    lock = outer.lock  # odtp-lint: disable=lock-order -- release order pinned by test_x
+
+``disable=all`` silences every check on that line. A ``disable=`` with no
+justification text after ``--`` does NOT suppress (the comment is the
+documentation; an empty one documents nothing).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+from typing import Iterable, Iterator, Optional
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*odtp-lint:\s*disable=([A-Za-z0-9_,\-]+)\s*--\s*(\S.*)"
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    check: str  # kebab-case check id, e.g. "undeclared-knob"
+    path: str  # repo-relative when produced by the driver
+    line: int  # 1-indexed; 0 = whole-file/tree finding
+    message: str
+
+    def render(self) -> str:
+        loc = f"{self.path}:{self.line}" if self.line else self.path
+        return f"{loc}: [{self.check}] {self.message}"
+
+
+def iter_py_files(roots: Iterable[str]) -> Iterator[str]:
+    """Every .py file under the given roots (files pass through as-is),
+    sorted for deterministic finding order, __pycache__ skipped."""
+    out = []
+    for root in roots:
+        if os.path.isfile(root):
+            out.append(root)
+            continue
+        for dirpath, dirnames, filenames in os.walk(root):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            out.extend(
+                os.path.join(dirpath, f) for f in filenames if f.endswith(".py")
+            )
+    return iter(sorted(out))
+
+
+def parse_file(path: str) -> tuple[Optional[ast.Module], list[str]]:
+    """(AST, source lines); (None, lines) on syntax errors -- the style
+    gate owns those, the invariant passes just skip the file."""
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
+    lines = src.splitlines()
+    try:
+        return ast.parse(src, filename=path), lines
+    except SyntaxError:
+        return None, lines
+
+
+def suppressed(lines: list[str], lineno: int, check: str) -> bool:
+    """True when the 1-indexed source line carries a justified
+    ``# odtp-lint: disable=`` comment naming this check (or ``all``)."""
+    if not 1 <= lineno <= len(lines):
+        return False
+    m = _SUPPRESS_RE.search(lines[lineno - 1])
+    if m is None:
+        return False
+    named = {c.strip() for c in m.group(1).split(",")}
+    return check in named or "all" in named
+
+
+def filter_suppressed(
+    findings: list[Finding], lines_by_path: dict[str, list[str]]
+) -> list[Finding]:
+    return [
+        f
+        for f in findings
+        if not suppressed(lines_by_path.get(f.path, []), f.line, f.check)
+    ]
+
+
+# -- constant folding ---------------------------------------------------------
+
+_FOLD_CASTS = {"str": str, "int": int, "float": float}
+
+
+def fold_const(node: Optional[ast.AST], env: Optional[dict] = None):
+    """Evaluate a side-effect-free constant expression: literals, module
+    constants (via ``env``), +,-,*,/,//,<<,>>, unary +/-, and str/int/float
+    casts of foldable values. Returns the value, or the _Unfoldable
+    sentinel when the expression isn't statically known."""
+    if node is None:
+        return UNFOLDABLE
+    if isinstance(node, ast.Constant):
+        return node.value
+    if isinstance(node, ast.Name):
+        if env is not None and node.id in env:
+            return env[node.id]
+        return UNFOLDABLE
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, (ast.UAdd, ast.USub)):
+        v = fold_const(node.operand, env)
+        if v is UNFOLDABLE or not isinstance(v, (int, float)):
+            return UNFOLDABLE
+        return -v if isinstance(node.op, ast.USub) else +v
+    if isinstance(node, ast.BinOp):
+        lhs, rhs = fold_const(node.left, env), fold_const(node.right, env)
+        if lhs is UNFOLDABLE or rhs is UNFOLDABLE:
+            return UNFOLDABLE
+        try:
+            if isinstance(node.op, ast.Add):
+                return lhs + rhs
+            if isinstance(node.op, ast.Sub):
+                return lhs - rhs
+            if isinstance(node.op, ast.Mult):
+                return lhs * rhs
+            if isinstance(node.op, ast.Div):
+                return lhs / rhs
+            if isinstance(node.op, ast.FloorDiv):
+                return lhs // rhs
+            if isinstance(node.op, ast.LShift):
+                return lhs << rhs
+            if isinstance(node.op, ast.RShift):
+                return lhs >> rhs
+        except Exception:
+            return UNFOLDABLE
+        return UNFOLDABLE
+    if (
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Name)
+        and node.func.id in _FOLD_CASTS
+        and len(node.args) == 1
+        and not node.keywords
+    ):
+        v = fold_const(node.args[0], env)
+        if v is UNFOLDABLE:
+            return UNFOLDABLE
+        try:
+            return _FOLD_CASTS[node.func.id](v)
+        except Exception:
+            return UNFOLDABLE
+    return UNFOLDABLE
+
+
+class _Unfoldable:
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        return "<unfoldable>"
+
+
+UNFOLDABLE = _Unfoldable()
+
+
+def module_constants(tree: ast.Module) -> dict:
+    """Top-level ``NAME = <foldable>`` bindings (str/int/float), the
+    pattern behind indirect env reads like ``os.environ.get(_ENV)``."""
+    env: dict = {}
+    for stmt in tree.body:
+        if (
+            isinstance(stmt, ast.Assign)
+            and len(stmt.targets) == 1
+            and isinstance(stmt.targets[0], ast.Name)
+        ):
+            v = fold_const(stmt.value, env)
+            if v is not UNFOLDABLE and isinstance(v, (str, int, float)):
+                env[stmt.targets[0].id] = v
+    return env
+
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """'a.b.c' for a Name/Attribute chain, else None."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None

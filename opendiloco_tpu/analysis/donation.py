@@ -1,0 +1,336 @@
+"""AST pass over jit/donation sites.
+
+With ``donate_argnums`` the XLA runtime may reuse the donated buffer for
+the output; the Python array object still exists but its device memory is
+gone. Reading it afterwards returns garbage or raises -- under a 500-step
+inner phase, usually minutes after the actual bug. Three checks:
+
+  use-after-donate      a caller passes ``x`` (a local or ``self.attr``)
+                        at a donated position, then loads the same
+                        expression later in the function without rebinding
+                        it first. The idiomatic safe shape
+                        ``x = f(x, ...)`` rebinds in the same statement.
+  jit-captures-self     a function passed to jax.jit whose body references
+                        ``self`` without taking it as a parameter: the
+                        closure freezes mutable object state at trace time
+                        (and silently stops tracking it afterwards).
+  unhashable-static     a call site passes a list/dict/set literal at a
+                        ``static_argnums``/``static_argnames`` position --
+                        jit requires hashable statics and fails at runtime.
+
+The pass is intra-module and name-based: donating callables are resolved
+by the bare name they are bound to (``_apply_fused``, ``self._insert``),
+which matches how every site in this repo is written.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Iterable, Optional
+
+from opendiloco_tpu.analysis.common import (
+    Finding,
+    dotted,
+    fold_const,
+    iter_py_files,
+    parse_file,
+    suppressed,
+)
+
+
+@dataclasses.dataclass
+class _Jitted:
+    name: str  # bound name, without any self./module prefix
+    donate: tuple[int, ...]
+    static_nums: tuple[int, ...]
+    static_names: tuple[str, ...]
+    line: int
+
+
+def _tuple_of_ints(node: Optional[ast.AST]) -> tuple[int, ...]:
+    v = fold_const(node) if not isinstance(node, (ast.Tuple, ast.List)) else None
+    if isinstance(v, int):
+        return (v,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for e in node.elts:
+            ev = fold_const(e)
+            if isinstance(ev, int):
+                out.append(ev)
+        return tuple(out)
+    return ()
+
+
+def _tuple_of_strs(node: Optional[ast.AST]) -> tuple[str, ...]:
+    v = fold_const(node) if not isinstance(node, (ast.Tuple, ast.List)) else None
+    if isinstance(v, str):
+        return (v,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return tuple(
+            e.value for e in node.elts
+            if isinstance(e, ast.Constant) and isinstance(e.value, str)
+        )
+    return ()
+
+
+def _jit_call_kwargs(call: ast.Call) -> Optional[dict]:
+    """kwargs of a jax.jit(...) or functools.partial(jax.jit, ...) call,
+    else None when the call isn't a jit wrapper."""
+    fn = dotted(call.func)
+    if fn in ("jax.jit", "jit"):
+        return {kw.arg: kw.value for kw in call.keywords if kw.arg}
+    if fn in ("functools.partial", "partial") and call.args:
+        inner = dotted(call.args[0])
+        if inner in ("jax.jit", "jit"):
+            return {kw.arg: kw.value for kw in call.keywords if kw.arg}
+    return None
+
+
+def _jitted_from_call(bound_name: str, call: ast.Call) -> Optional[_Jitted]:
+    kw = _jit_call_kwargs(call)
+    if kw is None:
+        return None
+    return _Jitted(
+        bound_name,
+        _tuple_of_ints(kw.get("donate_argnums")),
+        _tuple_of_ints(kw.get("static_argnums")),
+        _tuple_of_strs(kw.get("static_argnames")),
+        call.lineno,
+    )
+
+
+def _target_key(node: ast.AST) -> Optional[str]:
+    """Canonical tracking key for a donated argument expression: a bare
+    name ('avg') or a self attribute ('self.cache_k')."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return f"self.{node.attr}"
+    return None
+
+
+def _collect_jitted(tree: ast.Module) -> dict[str, _Jitted]:
+    """name -> _Jitted for every decorator / assignment jit site."""
+    out: dict[str, _Jitted] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if isinstance(dec, ast.Call):
+                    j = _jitted_from_call(node.name, dec)
+                    if j is not None:
+                        out[node.name] = j
+        elif isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            j = None
+            kw = _jit_call_kwargs(node.value)
+            if kw is not None:
+                for t in node.targets:
+                    key = _target_key(t)
+                    if key is not None:
+                        j = _jitted_from_call(key.split(".")[-1], node.value)
+                        if j is not None:
+                            out[j.name] = j
+    return out
+
+
+def _jit_wrapped_defs(tree: ast.Module) -> list[tuple[str, int]]:
+    """(wrapped function name, jit site line) for every jax.jit(f, ...) /
+    @partial(jax.jit, ...) application, to check self capture."""
+    sites: list[tuple[str, int]] = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and _jit_call_kwargs(node) is not None:
+            args = node.args
+            if dotted(node.func) in ("functools.partial", "partial"):
+                args = args[1:]
+            for a in args:
+                if isinstance(a, ast.Name):
+                    sites.append((a.id, node.lineno))
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if isinstance(dec, ast.Call) and _jit_call_kwargs(dec) is not None:
+                    sites.append((node.name, dec.lineno))
+    return sites
+
+
+def _funcs(tree: ast.Module):
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def _check_use_after_donate(
+    tree: ast.Module, jitted: dict[str, _Jitted], rel: str, lines: list[str]
+) -> list[Finding]:
+    findings: list[Finding] = []
+    for fn in _funcs(tree):
+        # donated expression key -> line of the donating call
+        dead: dict[str, int] = {}
+
+        class _V(ast.NodeVisitor):
+            def visit_If(self, node: ast.If) -> None:
+                # branches are mutually exclusive: each starts from the
+                # pre-state; afterwards an expr is dead if either branch
+                # donated it (may-analysis)
+                self.visit(node.test)
+                pre = dict(dead)
+                for s in node.body:
+                    self.visit(s)
+                post_body = dict(dead)
+                dead.clear()
+                dead.update(pre)
+                for s in node.orelse:
+                    self.visit(s)
+                dead.update(post_body)
+
+            def visit_FunctionDef(self, node) -> None:
+                # nested defs are their own scope (each gets its own _V
+                # walk from _funcs); only descend into the root function
+                if node is fn:
+                    self.generic_visit(node)
+
+            visit_AsyncFunctionDef = visit_FunctionDef
+
+            def visit_Call(self, call: ast.Call) -> None:
+                self.generic_visit(call)
+                name = dotted(call.func)
+                short = name.split(".")[-1] if name else None
+                j = jitted.get(short or "")
+                if j is None:
+                    return
+                for pos in j.donate:
+                    if pos < len(call.args):
+                        key = _target_key(call.args[pos])
+                        if key is not None:
+                            dead[key] = call.lineno
+
+            def visit_Assign(self, node: ast.Assign) -> None:
+                # RHS first (donating call / loads), then targets revive
+                self.visit(node.value)
+                for t in node.targets:
+                    for el in ast.walk(t):
+                        key = _target_key(el)
+                        if key is not None:
+                            dead.pop(key, None)
+
+            def visit_AugAssign(self, node: ast.AugAssign) -> None:
+                self.visit(node.value)
+                self._load(node.target)
+
+            def visit_Name(self, node: ast.Name) -> None:
+                if isinstance(node.ctx, ast.Load):
+                    self._load(node)
+
+            def visit_Attribute(self, node: ast.Attribute) -> None:
+                if isinstance(node.ctx, ast.Load) and _target_key(node):
+                    self._load(node)
+                else:
+                    self.generic_visit(node)
+
+            def _load(self, node: ast.AST) -> None:
+                key = _target_key(node)
+                if key is None:
+                    return
+                at = dead.get(key)
+                if at is not None and not suppressed(
+                    lines, node.lineno, "use-after-donate"
+                ):
+                    findings.append(
+                        Finding(
+                            "use-after-donate", rel, node.lineno,
+                            f"`{key}` was donated to a jit'd function on "
+                            f"line {at} (its device buffer may be reused "
+                            "for the output) but is read again here -- "
+                            "rebind it from the call's result or drop "
+                            "the donation",
+                        )
+                    )
+                    dead.pop(key, None)  # one finding per donation
+
+        _V().visit(fn)
+    return findings
+
+
+def _check_self_capture(
+    tree: ast.Module, rel: str, lines: list[str]
+) -> list[Finding]:
+    findings: list[Finding] = []
+    defs = {
+        n.name: n
+        for n in ast.walk(tree)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    }
+    for name, line in _jit_wrapped_defs(tree):
+        fn = defs.get(name)
+        if fn is None:
+            continue
+        params = {a.arg for a in fn.args.args + fn.args.kwonlyargs}
+        if "self" in params:
+            continue
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Name) and node.id == "self":
+                if not suppressed(lines, line, "jit-captures-self"):
+                    findings.append(
+                        Finding(
+                            "jit-captures-self", rel, line,
+                            f"jit of `{name}` closes over `self`: object "
+                            "state is frozen into the trace and mutations "
+                            "after compile are silently ignored -- pass "
+                            "the state as an argument",
+                        )
+                    )
+                break
+    return findings
+
+
+def _check_unhashable_static(
+    tree: ast.Module, jitted: dict[str, _Jitted], rel: str, lines: list[str]
+) -> list[Finding]:
+    findings: list[Finding] = []
+    unhashable = (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp, ast.SetComp)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = dotted(node.func)
+        short = name.split(".")[-1] if name else None
+        j = jitted.get(short or "")
+        if j is None:
+            continue
+        flagged: list[tuple[int, str]] = []
+        for pos in j.static_nums:
+            if pos < len(node.args) and isinstance(node.args[pos], unhashable):
+                flagged.append((node.args[pos].lineno, f"position {pos}"))
+        for kw in node.keywords:
+            if kw.arg in j.static_names and isinstance(kw.value, unhashable):
+                flagged.append((kw.value.lineno, f"`{kw.arg}`"))
+        for line, what in flagged:
+            if not suppressed(lines, line, "unhashable-static"):
+                findings.append(
+                    Finding(
+                        "unhashable-static", rel, line,
+                        f"static argument {what} of `{j.name}` is an "
+                        "unhashable literal -- jit static args must be "
+                        "hashable (use a tuple / frozen value)",
+                    )
+                )
+    return findings
+
+
+def check(roots: Iterable[str], relto: Optional[str] = None) -> list[Finding]:
+    import os
+
+    findings: list[Finding] = []
+    for path in iter_py_files(roots):
+        tree, lines = parse_file(path)
+        if tree is None:
+            continue
+        rel = os.path.relpath(path, relto) if relto else path
+        jitted = _collect_jitted(tree)
+        if jitted:
+            findings += _check_use_after_donate(tree, jitted, rel, lines)
+            findings += _check_unhashable_static(tree, jitted, rel, lines)
+        findings += _check_self_capture(tree, rel, lines)
+    return findings

@@ -1,0 +1,222 @@
+"""AST pass: every ``ODTP_*`` env read must resolve to the knob registry.
+
+Read shapes handled:
+  - ``os.environ.get("ODTP_X"[, default])`` / ``os.getenv(...)``
+  - ``os.environ["ODTP_X"]`` (Load context)
+  - indirection through module constants: ``_ENV = "ODTP_X"`` then
+    ``os.environ.get(_ENV)``
+  - indirection through env-helper functions: a function whose body reads
+    ``os.environ.get(<param>, ...)`` becomes a helper; literal calls like
+    ``_env_float("ODTP_X", 0.4)`` count as reads with that default.
+
+Failures:
+  undeclared-knob        read in code, missing from knobs.KNOBS
+  dead-knob              declared, never read under the scanned roots
+  knob-default-mismatch  a read site's foldable literal default disagrees
+                         with the registered default
+
+Writes (``os.environ["ODTP_X"] = ...``) are validated for declaration
+only -- benches set knobs for child processes; they don't carry defaults.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Iterable, Optional
+
+from opendiloco_tpu.analysis.common import (
+    UNFOLDABLE,
+    Finding,
+    dotted,
+    fold_const,
+    iter_py_files,
+    module_constants,
+    parse_file,
+    suppressed,
+)
+from opendiloco_tpu.analysis.knobs import REGISTRY
+
+_ENV_GET = {"os.environ.get", "environ.get", "os.getenv", "getenv"}
+_ENV_SUB = {"os.environ", "environ"}
+
+
+@dataclasses.dataclass
+class _Read:
+    name: str
+    path: str
+    line: int
+    default: object  # folded literal default, UNFOLDABLE, or None (absent)
+    is_write: bool = False
+
+
+def _key_and_default(call: ast.Call, env: dict) -> tuple[object, object]:
+    """(knob name, folded default) of an env .get()/getenv call."""
+    key = fold_const(call.args[0], env) if call.args else UNFOLDABLE
+    default = fold_const(call.args[1], env) if len(call.args) > 1 else None
+    return key, default
+
+
+def _helper_signature(fn: ast.FunctionDef) -> Optional[tuple[int, Optional[int]]]:
+    """(key_param_idx, default_param_idx) when ``fn`` is an env-read helper:
+    its body contains an env get whose key expression is one of its own
+    parameters. The default param is recognized when the helper's fallback
+    expression references another parameter (e.g. ``... or default``)."""
+    params = [a.arg for a in fn.args.args]
+    for node in ast.walk(fn):
+        if not (isinstance(node, ast.Call) and dotted(node.func) in _ENV_GET):
+            continue
+        if not (node.args and isinstance(node.args[0], ast.Name)):
+            continue
+        key = node.args[0].id
+        if key not in params:
+            continue
+        default_idx: Optional[int] = None
+        # fallback via second .get arg, or an enclosing `x or default`
+        cands = list(node.args[1:])
+        for outer in ast.walk(fn):
+            if isinstance(outer, ast.BoolOp) and any(
+                n is node for n in ast.walk(outer)
+            ):
+                cands.extend(outer.values)
+        for c in cands:
+            if isinstance(c, ast.Name) and c.id in params and c.id != key:
+                default_idx = params.index(c.id)
+                break
+        return params.index(key), default_idx
+    return None
+
+
+def _scan_file(path: str) -> tuple[list[_Read], dict[str, tuple[int, Optional[int]]], list[str]]:
+    tree, lines = parse_file(path)
+    if tree is None:
+        return [], {}, lines
+    env = module_constants(tree)
+    reads: list[_Read] = []
+    helpers: dict[str, tuple[int, Optional[int]]] = {}
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef):
+            sig = _helper_signature(node)
+            if sig is not None:
+                helpers[node.name] = sig
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and dotted(node.func) in _ENV_GET:
+            key, default = _key_and_default(node, env)
+            if isinstance(key, str):
+                reads.append(_Read(key, path, node.lineno, default))
+            continue
+        if (
+            isinstance(node, ast.Subscript)
+            and dotted(node.value) in _ENV_SUB
+        ):
+            key = fold_const(node.slice, env)
+            if isinstance(key, str):
+                reads.append(
+                    _Read(
+                        key, path, node.lineno, None,
+                        is_write=isinstance(node.ctx, (ast.Store, ast.Del)),
+                    )
+                )
+            continue
+        # os.environ.setdefault / .pop are writes/erasures, declaration-only
+        if isinstance(node, ast.Call) and dotted(node.func) in (
+            "os.environ.setdefault", "environ.setdefault",
+            "os.environ.pop", "environ.pop",
+        ):
+            key = fold_const(node.args[0], env) if node.args else UNFOLDABLE
+            if isinstance(key, str):
+                reads.append(_Read(key, path, node.lineno, None, is_write=True))
+
+    # second sweep: calls into this module's env helpers with literal keys
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call) and isinstance(node.func, ast.Name)):
+            continue
+        sig = helpers.get(node.func.id)
+        if sig is None:
+            continue
+        key_idx, default_idx = sig
+        if key_idx >= len(node.args):
+            continue
+        key = fold_const(node.args[key_idx], env)
+        if not isinstance(key, str):
+            continue
+        default = (
+            fold_const(node.args[default_idx], env)
+            if default_idx is not None and default_idx < len(node.args)
+            else None
+        )
+        reads.append(_Read(key, path, node.lineno, default))
+
+    return reads, helpers, lines
+
+
+def _defaults_agree(site: object, registered: str) -> bool:
+    if site is None or site is UNFOLDABLE:
+        return True  # no literal default at this site to compare
+    try:
+        return float(site) == float(registered)
+    except (TypeError, ValueError):
+        return str(site) == registered
+
+
+def check(roots: Iterable[str], relto: Optional[str] = None) -> list[Finding]:
+    findings: list[Finding] = []
+    seen_reads: dict[str, list[_Read]] = {}
+    for path in iter_py_files(roots):
+        reads, _, lines = _scan_file(path)
+        rel = _rel(path, relto)
+        for r in reads:
+            if not r.name.startswith("ODTP_"):
+                continue
+            r.path = rel
+            seen_reads.setdefault(r.name, []).append(r)
+            knob = REGISTRY.get(r.name)
+            if knob is None:
+                if not suppressed(lines, r.line, "undeclared-knob"):
+                    findings.append(
+                        Finding(
+                            "undeclared-knob", rel, r.line,
+                            f"{r.name} is read here but not declared in "
+                            "analysis/knobs.py -- add it to the registry "
+                            "(name, type, default, subsystem, doc)",
+                        )
+                    )
+                continue
+            if r.is_write:
+                continue
+            if not _defaults_agree(r.default, knob.default):
+                if not suppressed(lines, r.line, "knob-default-mismatch"):
+                    findings.append(
+                        Finding(
+                            "knob-default-mismatch", rel, r.line,
+                            f"{r.name} falls back to {r.default!r} here but "
+                            f"the registry declares default {knob.default!r}"
+                            " -- two sites disagreeing on a default is a"
+                            " config fork",
+                        )
+                    )
+    for name, knob in REGISTRY.items():
+        sites = seen_reads.get(name, [])
+        if not any(not r.is_write for r in sites):
+            findings.append(
+                Finding(
+                    "dead-knob", "opendiloco_tpu/analysis/knobs.py", 0,
+                    f"{name} is declared but never read under the scanned "
+                    "roots -- delete the registry entry or the feature that "
+                    "was supposed to read it",
+                )
+            )
+    return findings
+
+
+def _rel(path: str, relto: Optional[str]) -> str:
+    if relto is None:
+        return path
+    import os
+
+    try:
+        return os.path.relpath(path, relto)
+    except ValueError:
+        return path

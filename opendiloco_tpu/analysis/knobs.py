@@ -1,0 +1,172 @@
+"""The declarative registry of every ``ODTP_*`` environment knob.
+
+This table is the single authority: the knob_check pass fails the build
+when code reads a knob missing here (undeclared), when a registered knob
+is never read anywhere (dead), or when a read site's literal default
+disagrees with the registered default (mismatch). The README knob table
+is generated from this registry (``scripts/odtp_lint.py --write-knob-table``),
+so docs cannot drift from code either.
+
+``default`` is the exact fallback the code uses when the variable is
+unset; ``""`` means unset-is-off/derived (the ``doc_default`` column says
+what that behaves like). Keep entries sorted by (subsystem, name).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class Knob:
+    name: str
+    type: str  # bool | int | float | str | spec | path
+    default: str  # canonical code default ("" = unset)
+    subsystem: str  # transport | diloco | chaos | obs | serve | model | bench | analysis
+    doc: str  # one line, lands verbatim in the README table
+    doc_default: str = ""  # display override when default="" reads poorly
+
+
+KNOBS: tuple[Knob, ...] = (
+    # -- analysis -------------------------------------------------------------
+    Knob("ODTP_LOCKCHECK", "bool", "", "analysis",
+         "`1` wraps `threading` locks created by this package in the runtime "
+         "lock-order witness: per-thread acquisition order is recorded and any "
+         "cycle in the global order graph raises immediately instead of "
+         "deadlocking. Zero-cost when unset.", doc_default="off"),
+    # -- bench ----------------------------------------------------------------
+    Knob("ODTP_BOUNDARY_BENCH_OUT", "path", "", "bench",
+         "Output path override for `bench_outer.py --boundary` "
+         "(default `BOUNDARY_BENCH.json` in the repo root).",
+         doc_default="repo artifact"),
+    Knob("ODTP_COMPRESS_BENCH_OUT", "path", "", "bench",
+         "Output path override for `bench_outer.py --compress`.",
+         doc_default="repo artifact"),
+    Knob("ODTP_CONV_STEPS", "int", "300", "bench",
+         "Inner steps per arm in `scripts/convergence_evidence.py`."),
+    Knob("ODTP_HETERO_BENCH_OUT", "path", "", "bench",
+         "Output path override for `bench_outer.py --hetero`.",
+         doc_default="repo artifact"),
+    Knob("ODTP_LIVE_TRAIN_STEPS", "int", "1500", "bench",
+         "Step budget for `scripts/live_train.py`."),
+    Knob("ODTP_OUTER_BENCH_OUT", "path", "", "bench",
+         "Output path override for the `bench_outer.py` all-reduce sweep.",
+         doc_default="repo artifact"),
+    Knob("ODTP_SERVE_BENCH_OUT", "path", "", "bench",
+         "Output path override for `scripts/serve_bench.py`.",
+         doc_default="repo artifact"),
+    Knob("ODTP_STREAM_BENCH_OUT", "path", "", "bench",
+         "Output path override for `bench_outer.py --stream`.",
+         doc_default="repo artifact"),
+    # -- chaos ----------------------------------------------------------------
+    Knob("ODTP_CHAOS", "spec", "", "chaos",
+         "Seedable fault-injection spec, e.g. "
+         "`seed=7;drop_conn=0.05;delay_ms=20..200;kill_worker=r3:w5`. "
+         "Unset = plane off, zero cost.", doc_default="off"),
+    Knob("ODTP_RETRY_BASE_S", "float", "0.5", "chaos",
+         "Base of the bounded exponential backoff between outer-round retries."),
+    Knob("ODTP_RETRY_CAP_S", "float", "15", "chaos",
+         "Cap of the outer-round retry backoff, seconds."),
+    Knob("ODTP_ROUND_RETRIES", "int", "3", "chaos",
+         "How many times a failed outer round re-forms before the step "
+         "raises (callers may pass a different programmatic default)."),
+    # -- diloco ---------------------------------------------------------------
+    Knob("ODTP_STATE_CODEC", "str", "", "diloco",
+         "Codec override for onboarding/serve state payloads (`none` "
+         "restores raw fp32; default: configured codec when fp16-family, "
+         "else fp16).", doc_default="derived"),
+    Knob("ODTP_TOPK_DENSITY", "float", "0.03125", "diloco",
+         "Fraction of largest-|x| elements the `topk` codec keeps (1/32 "
+         "default ~= 0.25 B/elem on the wire)."),
+    # -- model ----------------------------------------------------------------
+    Knob("ODTP_SCAN_UNROLL", "int", "", "model",
+         "Overrides the scan-over-layers unroll factor (experiments and "
+         "`scripts/aot_roofline.py`; cost analysis needs the stack unrolled).",
+         doc_default="config"),
+    # -- obs ------------------------------------------------------------------
+    Knob("ODTP_OBS", "bool", "", "obs",
+         "`1` arms the tracing/metrics plane. Unset = zero-cost no-op.",
+         doc_default="off"),
+    Knob("ODTP_OBS_DIR", "path", "", "obs",
+         "Flush a `trace-w<rank>-<pid>.jsonl` event file here at exit.",
+         doc_default="no flush"),
+    Knob("ODTP_OBS_EVENTS_CAP", "int", "65536", "obs",
+         "Event ring limit; overflow increments a `dropped` counter."),
+    Knob("ODTP_OBS_PROM_PORT", "int", "", "obs",
+         "Serve Prometheus 0.0.4 text at `:PORT/metrics`.",
+         doc_default="no endpoint"),
+    Knob("ODTP_ROOFLINE", "path", "", "obs",
+         "Path override for the banked roofline JSON backing MFU gauges.",
+         doc_default="auto-discover"),
+    # -- transport ------------------------------------------------------------
+    Knob("ODTP_BULK_BANDWIDTH_BPS", "float", "0", "transport",
+         "Per-process egress cap in bytes/s (token bucket) emulating a "
+         "constrained WAN link; 0 = unlimited."),
+    Knob("ODTP_BULK_STREAMS", "int", "4", "transport",
+         "Parallel TCP streams a large bulk frame stripes over."),
+    Knob("ODTP_BULK_STRIPE_MIN", "int", "67108864", "transport",
+         "Payload bytes above which a bulk frame stripes (64 MiB)."),
+    Knob("ODTP_BULK_STRIPE_WAIT_S", "float", "300", "transport",
+         "How long a receiver waits for a stripe's session before failing "
+         "the round to the retry path."),
+    Knob("ODTP_BULK_THRESHOLD", "int", "1048576", "transport",
+         "Payload bytes above which a frame rides the threaded bulk plane "
+         "instead of the asyncio RPC path (1 MiB)."),
+    Knob("ODTP_EXPECT_PEERS", "int", "0", "transport",
+         "Rendezvous group-complete fast path: close matchmaking as soon "
+         "as this many peers joined; 0 = wait out the window."),
+    Knob("ODTP_LINK_ADAPT", "bool", "", "transport",
+         "`1` arms bandwidth-aware transport: proportional reduce-scatter "
+         "partitioning, BDP-derived striping, straggler hedging. Off = "
+         "bit-identical uniform path.", doc_default="off"),
+    Knob("ODTP_LINK_ALPHA", "float", "0.4", "transport",
+         "EWMA weight of the per-peer link estimator."),
+    Knob("ODTP_LINK_HEDGE_FACTOR", "float", "3.0", "transport",
+         "A stripe lagging this multiple of its link-derived deadline is "
+         "re-dispatched over an idle connection; 0 disables hedging."),
+    Knob("ODTP_LINK_HYST", "float", "0.25", "transport",
+         "Relative drift before a peer's published link estimate tracks "
+         "the live EWMA (plan anti-flap)."),
+    Knob("ODTP_LINK_MIN_SHARE", "float", "0.25", "transport",
+         "Floor on a worker's reduce-scatter part, as a fraction of the "
+         "uniform 1/n share."),
+    Knob("ODTP_LINK_PROBE_BYTES", "int", "262144", "transport",
+         "Micro-probe payload seeding the link estimator on fresh peers; "
+         "0 disables probing."),
+    Knob("ODTP_PIPELINE", "bool", "1", "transport",
+         "`1` (default) chunk-pipelines the outer all-reduce (codec work "
+         "overlaps the socket); `0` restores the serial path."),
+    Knob("ODTP_PIPELINE_CHUNK_ELEMS", "int", "", "transport",
+         "Pipeline chunk size in raw elements; overrides "
+         "`ODTP_PIPELINE_CHUNK_MB`.", doc_default="derived"),
+    Knob("ODTP_PIPELINE_CHUNK_MB", "float", "8", "transport",
+         "Pipeline chunk size in MB of fp32 elements."),
+    Knob("ODTP_RDV_FAILBACK_S", "float", "60.0", "transport",
+         "How long a worker keeps trying the native rendezvous daemon "
+         "before failing back to worker-hosted rendezvous."),
+    Knob("ODTP_WORKER_RENDEZVOUS", "bool", "1", "transport",
+         "`0` disables the in-process fallback rendezvous server (require "
+         "the external daemon)."),
+)
+
+REGISTRY: dict[str, Knob] = {k.name: k for k in KNOBS}
+
+TABLE_BEGIN = "<!-- odtp-knobs:begin (generated by scripts/odtp_lint.py --write-knob-table; do not edit by hand) -->"
+TABLE_END = "<!-- odtp-knobs:end -->"
+
+
+def render_table() -> str:
+    """The README knob table, grouped by subsystem, markdown."""
+    out = [
+        TABLE_BEGIN,
+        "",
+        "| Knob | Type | Default | Subsystem | What it does |",
+        "|---|---|---|---|---|",
+    ]
+    for k in sorted(KNOBS, key=lambda k: (k.subsystem, k.name)):
+        default = k.doc_default or k.default or "unset"
+        out.append(
+            f"| `{k.name}` | {k.type} | `{default}` | {k.subsystem} | {k.doc} |"
+        )
+    out += ["", TABLE_END]
+    return "\n".join(out)

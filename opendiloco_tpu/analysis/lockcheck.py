@@ -1,0 +1,233 @@
+"""Runtime lock-order witness (``ODTP_LOCKCHECK=1``).
+
+The static pass (analysis/locks.py) proves the *written* acquisition
+graph acyclic; this witness checks the *executed* one. When armed it
+replaces ``threading.Lock``/``RLock``/``Condition`` with factories that
+hand locks created **inside opendiloco_tpu/** a thin recording proxy
+(foreign callers -- stdlib, jax -- keep the raw primitive untouched).
+
+Each proxy is tagged with its creation site (file:line). Per thread, the
+stack of currently-held sites is tracked; on every acquisition an edge
+held-site -> new-site enters a process-global order graph. An edge that
+closes a cycle raises ``LockOrderViolation`` at acquire time -- turning a
+would-be silent deadlock under the chaos soak or the serve scheduler into
+an immediate, attributable failure.
+
+Zero-cost contract (same as ``ODTP_OBS``/``ODTP_CHAOS``): when the env
+var is unset, ``maybe_install()`` is a single dict lookup at import and
+``threading`` is untouched -- no proxy, no indirection, no allocation on
+any lock path.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+_ENV = "ODTP_LOCKCHECK"
+
+_PKG_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_installed = False
+_raw_lock = threading.Lock
+_raw_rlock = threading.RLock
+_raw_condition = threading.Condition
+
+
+class LockOrderViolation(AssertionError):
+    pass
+
+
+class _Order:
+    """Process-global acquisition-order graph over creation sites."""
+
+    def __init__(self) -> None:
+        self.mu = _raw_lock()
+        self.edges: dict[str, set[str]] = {}
+        self.first_seen: dict[tuple[str, str], str] = {}
+        self.tls = threading.local()
+
+    def held(self) -> list:
+        st = getattr(self.tls, "stack", None)
+        if st is None:
+            st = self.tls.stack = []
+        return st
+
+    def _reaches(self, src: str, dst: str) -> bool:
+        seen = set()
+        stack = [src]
+        while stack:
+            v = stack.pop()
+            if v == dst:
+                return True
+            if v in seen:
+                continue
+            seen.add(v)
+            stack.extend(self.edges.get(v, ()))
+        return False
+
+    def note_acquire(self, proxy) -> None:
+        st = self.held()
+        site = proxy._site
+        for held_proxy in st:
+            h = held_proxy._site
+            if h == site:
+                continue  # same creation site (lock maps etc.): no ordering
+            with self.mu:
+                if site in self.edges.get(h, ()):
+                    continue
+                if self._reaches(site, h):
+                    order = " -> ".join(p._site for p in st) + f" -> {site}"
+                    first = self.first_seen.get((site, h), "?")
+                    raise LockOrderViolation(
+                        f"lock-order inversion: acquiring {site} while "
+                        f"holding {h}, but the opposite order was witnessed "
+                        f"at {first}. This thread: {order}. Two threads "
+                        "interleaving these orders deadlock."
+                    )
+                self.edges.setdefault(h, set()).add(site)
+                self.first_seen[(h, site)] = (
+                    f"thread={threading.current_thread().name}"
+                )
+        st.append(proxy)
+
+    def note_release(self, proxy) -> None:
+        st = self.held()
+        # release order need not be LIFO; remove the newest matching entry
+        for i in range(len(st) - 1, -1, -1):
+            if st[i] is proxy:
+                del st[i]
+                return
+
+    def snapshot(self) -> dict[str, set[str]]:
+        with self.mu:
+            return {k: set(v) for k, v in self.edges.items()}
+
+    def reset(self) -> None:
+        with self.mu:
+            self.edges.clear()
+            self.first_seen.clear()
+
+
+order = _Order()
+
+
+class _LockProxy:
+    """Recording wrapper; duck-compatible with the primitive lock
+    (acquire/release/locked/context manager), including use as the lock
+    behind a ``threading.Condition``."""
+
+    _factory = staticmethod(lambda: _raw_lock())
+
+    def __init__(self, site: str):
+        self._inner = self._factory()
+        self._site = site
+        self._count = 0  # recursion depth (RLock); plain Lock stays 0/1
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            if self._count == 0:
+                order.note_acquire(self)
+            self._count += 1
+        return got
+
+    def release(self) -> None:
+        self._count -= 1
+        if self._count == 0:
+            order.note_release(self)
+        self._inner.release()
+
+    def locked(self) -> bool:
+        inner = self._inner
+        return inner.locked() if hasattr(inner, "locked") else self._count > 0
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def __repr__(self) -> str:
+        return f"<{type(self).__name__} {self._site} inner={self._inner!r}>"
+
+
+class _RLockProxy(_LockProxy):
+    _factory = staticmethod(lambda: _raw_rlock())
+
+    # Condition integration: these are looked up via hasattr(); providing
+    # them keeps wait() bookkeeping correct for re-entrant holders
+    def _release_save(self):
+        state = self._inner._release_save()
+        count = self._count
+        self._count = 0
+        order.note_release(self)
+        return (state, count)
+
+    def _acquire_restore(self, saved) -> None:
+        state, count = saved
+        self._inner._acquire_restore(state)
+        order.note_acquire(self)
+        self._count = count
+
+    def _is_owned(self) -> bool:
+        return self._inner._is_owned()
+
+
+def _caller_site(depth: int = 2) -> tuple[str, bool]:
+    import sys
+
+    frame = sys._getframe(depth)
+    path = frame.f_code.co_filename
+    inside = os.path.abspath(path).startswith(_PKG_ROOT + os.sep)
+    short = os.path.relpath(path, os.path.dirname(_PKG_ROOT)) if inside else path
+    return f"{short}:{frame.f_lineno}", inside
+
+
+def _make_lock():
+    site, inside = _caller_site()
+    return _LockProxy(site) if inside else _raw_lock()
+
+
+def _make_rlock():
+    site, inside = _caller_site()
+    return _RLockProxy(site) if inside else _raw_rlock()
+
+
+def _make_condition(lock=None):
+    site, inside = _caller_site()
+    if lock is None and inside:
+        # a bare Condition() owns its lock; witness it under this site
+        lock = _RLockProxy(site)
+    return _raw_condition(lock)
+
+
+def enabled() -> bool:
+    return _installed
+
+
+def maybe_install() -> bool:
+    """Arm the witness iff ODTP_LOCKCHECK is set truthy. Called once from
+    ``opendiloco_tpu.__init__``; locks created before that import (none in
+    this package) would escape witnessing."""
+    global _installed
+    if _installed:
+        return True
+    if os.environ.get(_ENV, "").lower() not in ("1", "true", "on"):
+        return False
+    threading.Lock = _make_lock
+    threading.RLock = _make_rlock
+    threading.Condition = _make_condition
+    _installed = True
+    return True
+
+
+def uninstall() -> None:
+    """Restore the raw primitives (tests only)."""
+    global _installed
+    threading.Lock = _raw_lock
+    threading.RLock = _raw_rlock
+    threading.Condition = _raw_condition
+    order.reset()
+    _installed = False

@@ -1,0 +1,238 @@
+"""Static lock-order extraction + acyclicity check.
+
+Each ``threading.Lock()``/``RLock()``/``Condition()`` bound at class or
+module level is a named lock node (``bulk.BulkServer._lock``). A
+``Condition(existing_lock)`` aliases the lock it wraps -- acquiring the
+condition IS acquiring that lock. Edges:
+
+  - syntactic nesting: ``with A:`` containing ``with B:`` adds A -> B
+  - one level of interprocedural closure: a ``with A:`` body calling a
+    method known to acquire B adds A -> B (methods resolved by bare name
+    across the scanned modules; same-name collisions are unioned, which
+    over-approximates -- safe direction for a deadlock check)
+
+A cycle in the resulting graph is a potential deadlock: two threads
+taking the locks in opposite orders can block forever. The runtime
+witness (analysis/lockcheck.py, ``ODTP_LOCKCHECK=1``) checks the same
+property against actually-executed acquisition orders.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Iterable, Optional
+
+from opendiloco_tpu.analysis.common import (
+    Finding,
+    dotted,
+    iter_py_files,
+    parse_file,
+    suppressed,
+)
+
+_LOCK_CTORS = {
+    "threading.Lock", "threading.RLock", "threading.Condition",
+    "Lock", "RLock", "Condition",
+}
+
+# bare method names too generic to resolve across modules: `d.get(k)` on a
+# dict would otherwise alias _BufferPool.get and fabricate edges. Their
+# real orderings still surface through syntactic `with` nesting.
+_GENERIC_METHODS = frozenset({
+    "get", "pop", "add", "put", "release", "append", "update", "setdefault",
+    "items", "keys", "values", "clear", "set", "wait", "discard", "remove",
+    "acquire", "send", "close", "start", "join", "copy", "extend", "insert",
+})
+
+
+def _lock_ctor(call: ast.AST) -> Optional[str]:
+    if isinstance(call, ast.Call) and dotted(call.func) in _LOCK_CTORS:
+        return dotted(call.func).split(".")[-1]
+    return None
+
+
+class _Module:
+    def __init__(self, path: str, tree: ast.Module, lines: list[str]):
+        self.path = path
+        self.mod = os.path.splitext(os.path.basename(path))[0]
+        self.tree = tree
+        self.lines = lines
+        # expression key ("self._lock" / "_rate_lock") -> canonical lock id
+        self.locks: dict[tuple[Optional[str], str], str] = {}
+        self._collect_locks()
+
+    def _collect_locks(self) -> None:
+        # module-level locks
+        for stmt in self.tree.body:
+            self._maybe_lock(stmt, cls=None)
+        # class-attribute locks assigned in any method (self.x = Lock())
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.ClassDef):
+                for sub in ast.walk(node):
+                    self._maybe_lock(sub, cls=node.name)
+
+    def _maybe_lock(self, stmt: ast.AST, cls: Optional[str]) -> None:
+        if not isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+            return
+        value = stmt.value
+        if value is None:
+            return
+        targets = stmt.targets if isinstance(stmt, ast.Assign) else [stmt.target]
+        ctor = _lock_ctor(value)
+        if ctor is None:
+            return
+        alias: Optional[str] = None
+        if ctor == "Condition" and value.args:
+            # Condition(self.lock): same underlying lock, alias it
+            inner = self._expr_key(value.args[0])
+            if inner is not None:
+                alias = self.locks.get((cls, inner)) or self.locks.get((None, inner))
+        for t in targets:
+            key = self._expr_key(t)
+            if key is None:
+                continue
+            scope = cls if key.startswith("self.") else None
+            lock_id = alias or f"{self.mod}.{cls + '.' if scope else ''}{key.removeprefix('self.')}"
+            self.locks[(scope, key)] = lock_id
+            if scope is not None:
+                # methods of the same class refer to it the same way; also
+                # index classless so nested helpers resolve approximately
+                self.locks.setdefault((None, key), lock_id)
+
+    @staticmethod
+    def _expr_key(node: ast.AST) -> Optional[str]:
+        d = dotted(node)
+        if d is None:
+            return None
+        if d.startswith("self."):
+            return d
+        if "." not in d:
+            return d
+        return None
+
+    def resolve(self, node: ast.AST, cls: Optional[str]) -> Optional[str]:
+        key = self._expr_key(node)
+        if key is None:
+            return None
+        return self.locks.get((cls, key)) or self.locks.get((None, key))
+
+
+def _walk_withs(
+    m: _Module,
+    body: list[ast.stmt],
+    cls: Optional[str],
+    held: tuple[str, ...],
+    edges: dict[tuple[str, str], tuple[str, int]],
+    acquires: Optional[dict[str, set[str]]],
+    calls_under: Optional[dict[str, set[tuple[str, str, int]]]],
+    fn_name: str,
+) -> None:
+    for stmt in body:
+        if isinstance(stmt, ast.With):
+            new_held = held
+            for item in stmt.items:
+                lock = m.resolve(item.context_expr, cls)
+                if lock is not None:
+                    for h in new_held:
+                        if h != lock:
+                            edges.setdefault((h, lock), (m.path, stmt.lineno))
+                    new_held = new_held + (lock,)
+                    if acquires is not None:
+                        acquires.setdefault(fn_name, set()).add(lock)
+            _walk_withs(m, stmt.body, cls, new_held, edges, acquires, calls_under, fn_name)
+            continue
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            _walk_withs(m, stmt.body, cls, (), edges, acquires, calls_under, stmt.name)
+            continue
+        if isinstance(stmt, ast.ClassDef):
+            _walk_withs(m, stmt.body, stmt.name, (), edges, acquires, calls_under, fn_name)
+            continue
+        # record method calls made while holding locks (one-level closure)
+        if held and calls_under is not None:
+            for node in ast.walk(stmt):
+                if isinstance(node, ast.Call):
+                    name = dotted(node.func)
+                    if name is not None:
+                        short = name.split(".")[-1]
+                        if short in _GENERIC_METHODS:
+                            continue
+                        for h in held:
+                            calls_under.setdefault(short, set()).add(
+                                (h, m.path, node.lineno)
+                            )
+        # recurse into nested blocks, with-held state preserved
+        for field in ("body", "orelse", "finalbody"):
+            sub = getattr(stmt, field, None)
+            if sub:
+                _walk_withs(m, sub, cls, held, edges, acquires, calls_under, fn_name)
+        for handler in getattr(stmt, "handlers", []) or []:
+            _walk_withs(m, handler.body, cls, held, edges, acquires, calls_under, fn_name)
+
+
+def _find_cycles(edges: dict[tuple[str, str], tuple[str, int]]) -> list[list[str]]:
+    graph: dict[str, set[str]] = {}
+    for a, b in edges:
+        graph.setdefault(a, set()).add(b)
+        graph.setdefault(b, set())
+    cycles: list[list[str]] = []
+    color: dict[str, int] = {}
+    stack: list[str] = []
+
+    def dfs(v: str) -> None:
+        color[v] = 1
+        stack.append(v)
+        for w in sorted(graph[v]):
+            if color.get(w, 0) == 0:
+                dfs(w)
+            elif color.get(w) == 1:
+                cycles.append(stack[stack.index(w):] + [w])
+        stack.pop()
+        color[v] = 2
+
+    for v in sorted(graph):
+        if color.get(v, 0) == 0:
+            dfs(v)
+    return cycles
+
+
+def check(roots: Iterable[str], relto: Optional[str] = None) -> list[Finding]:
+    modules: list[_Module] = []
+    for path in iter_py_files(roots):
+        tree, lines = parse_file(path)
+        if tree is None:
+            continue
+        modules.append(_Module(path, tree, lines))
+
+    edges: dict[tuple[str, str], tuple[str, int]] = {}
+    acquires: dict[str, set[str]] = {}
+    calls_under: dict[str, set[tuple[str, str, int]]] = {}
+    for m in modules:
+        _walk_withs(m, m.tree.body, None, (), edges, acquires, calls_under, "<module>")
+
+    # one-level interprocedural closure: holding H while calling f, where f
+    # is known to acquire L, orders H before L
+    for fname, sites in calls_under.items():
+        for lock in acquires.get(fname, ()):
+            for held, path, line in sites:
+                if held != lock:
+                    edges.setdefault((held, lock), (path, line))
+
+    findings: list[Finding] = []
+    lines_cache: dict[str, list[str]] = {m.path: m.lines for m in modules}
+    for cycle in _find_cycles(edges):
+        # anchor the finding at the edge closing the cycle
+        a, b = cycle[-2], cycle[-1]
+        path, line = edges.get((a, b), ("", 0))
+        rel = os.path.relpath(path, relto) if (relto and path) else path
+        if path and suppressed(lines_cache.get(path, []), line, "lock-order"):
+            continue
+        findings.append(
+            Finding(
+                "lock-order", rel or "<graph>", line,
+                "lock acquisition cycle " + " -> ".join(cycle)
+                + " -- two threads taking these in opposite orders deadlock; "
+                "break the cycle or pin a global order",
+            )
+        )
+    return findings

@@ -1,0 +1,289 @@
+"""Wire-schema conformance: every encode/decode layout must match
+diloco/schema.py.
+
+Checks:
+  wire-undeclared-struct  a ``struct.Struct``/``pack``/``unpack``/
+                          ``calcsize`` literal format string that is not
+                          one of the schema's declared formats -- a layout
+                          born outside the schema module
+  wire-schema-internal    schema self-consistency (declared header size vs
+                          struct.calcsize, hash algo exists, geometry table
+                          covers every registered codec)
+  wire-chunk-meta         ``wire.chunk_fields`` must stamp exactly the
+                          schema's CHUNK_META_FIELDS and ``wire.chunk_span``
+                          must read only declared keys
+  wire-codec-geometry     codec classes' chunk_align/wire_align_bytes must
+                          match schema.CODEC_WIRE_GEOMETRY (runtime import)
+  wire-daemon-magic       the C++ rendezvous daemon must frame with the
+                          same magic bytes and a 4-byte network-order
+                          header length (textual check over the .cpp)
+
+The magic/header constants are also *imported* by wire.py/bulk.py, so
+Python-side drift is impossible by construction; the pass exists for the
+sites that cannot import (C++), for new code that hardcodes a format, and
+for the schema's own arithmetic.
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import os
+import re
+import struct as _structmod
+from typing import Iterable, Optional
+
+from opendiloco_tpu.analysis.common import (
+    Finding,
+    dotted,
+    iter_py_files,
+    parse_file,
+    suppressed,
+)
+from opendiloco_tpu.diloco import schema
+
+_STRUCT_FNS = {
+    "struct.Struct", "struct.pack", "struct.unpack", "struct.pack_into",
+    "struct.unpack_from", "struct.calcsize",
+}
+
+DECLARED_FORMATS = {schema.FRAME_HDR_FMT, schema.SO_TIMEVAL_FMT}
+
+
+def _check_struct_literals(roots: Iterable[str], relto: Optional[str]) -> list[Finding]:
+    findings: list[Finding] = []
+    for path in iter_py_files(roots):
+        if os.path.abspath(path) == os.path.abspath(schema.__file__):
+            continue
+        tree, lines = parse_file(path)
+        if tree is None:
+            continue
+        rel = os.path.relpath(path, relto) if relto else path
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call) and dotted(node.func) in _STRUCT_FNS):
+                continue
+            if not node.args:
+                continue
+            fmt = node.args[0]
+            if isinstance(fmt, ast.Constant) and isinstance(fmt.value, str):
+                if fmt.value in DECLARED_FORMATS:
+                    continue
+                if suppressed(lines, node.lineno, "wire-undeclared-struct"):
+                    continue
+                findings.append(
+                    Finding(
+                        "wire-undeclared-struct", rel, node.lineno,
+                        f"struct format {fmt.value!r} is not declared in "
+                        "diloco/schema.py -- every wire layout lives there "
+                        "once, encode and decode import it",
+                    )
+                )
+            # Name/Attribute formats referencing schema constants are the
+            # by-construction-safe spelling; nothing to check
+    return findings
+
+
+def _check_schema_internal() -> list[Finding]:
+    findings: list[Finding] = []
+    spath = os.path.relpath(schema.__file__)
+    if _structmod.calcsize(schema.FRAME_HDR_FMT) != schema.FRAME_HDR_SIZE:
+        findings.append(
+            Finding(
+                "wire-schema-internal", spath, 0,
+                f"FRAME_HDR_SIZE={schema.FRAME_HDR_SIZE} but "
+                f"calcsize({schema.FRAME_HDR_FMT!r})="
+                f"{_structmod.calcsize(schema.FRAME_HDR_FMT)}",
+            )
+        )
+    if schema.FRAME_HDR.size != schema.FRAME_HDR_SIZE:
+        findings.append(
+            Finding(
+                "wire-schema-internal", spath, 0,
+                "FRAME_HDR struct disagrees with FRAME_HDR_SIZE",
+            )
+        )
+    if len(schema.MAGIC) != 4:
+        findings.append(
+            Finding("wire-schema-internal", spath, 0,
+                    f"MAGIC must be 4 bytes, got {schema.MAGIC!r}")
+        )
+    try:
+        digest = hashlib.new(schema.PLAN_HASH_ALGO)
+        if schema.PLAN_HASH_HEXLEN > digest.digest_size * 2:
+            findings.append(
+                Finding("wire-schema-internal", spath, 0,
+                        "PLAN_HASH_HEXLEN exceeds the digest length")
+            )
+    except ValueError:
+        findings.append(
+            Finding("wire-schema-internal", spath, 0,
+                    f"unknown PLAN_HASH_ALGO {schema.PLAN_HASH_ALGO!r}")
+        )
+    return findings
+
+
+def _dict_literal_keys(fn: ast.FunctionDef) -> Optional[list[str]]:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Return) and isinstance(node.value, ast.Dict):
+            keys = []
+            for k in node.value.keys:
+                if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                    keys.append(k.value)
+                else:
+                    return None
+            return keys
+    return None
+
+
+def _meta_get_keys(fn: ast.FunctionDef) -> set[str]:
+    """String keys read off ``meta`` via .get()/[] inside the function."""
+    keys: set[str] = set()
+    for node in ast.walk(fn):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "get"
+            and isinstance(node.func.value, ast.Name)
+            and node.func.value.id == "meta"
+            and node.args
+            and isinstance(node.args[0], ast.Constant)
+        ):
+            keys.add(node.args[0].value)
+        if (
+            isinstance(node, ast.Subscript)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "meta"
+            and isinstance(node.slice, ast.Constant)
+        ):
+            keys.add(node.slice.value)
+    return keys
+
+
+def _check_chunk_meta(wire_path: str, relto: Optional[str]) -> list[Finding]:
+    findings: list[Finding] = []
+    tree, _ = parse_file(wire_path)
+    if tree is None:
+        return findings
+    rel = os.path.relpath(wire_path, relto) if relto else wire_path
+    fns = {
+        n.name: n for n in ast.walk(tree)
+        if isinstance(n, ast.FunctionDef)
+    }
+    cf = fns.get("chunk_fields")
+    if cf is not None:
+        keys = _dict_literal_keys(cf)
+        if keys is not None and tuple(keys) != schema.CHUNK_META_FIELDS:
+            findings.append(
+                Finding(
+                    "wire-chunk-meta", rel, cf.lineno,
+                    f"chunk_fields stamps {tuple(keys)} but schema declares "
+                    f"CHUNK_META_FIELDS={schema.CHUNK_META_FIELDS}",
+                )
+            )
+    cs = fns.get("chunk_span")
+    if cs is not None:
+        extra = _meta_get_keys(cs) - set(schema.CHUNK_META_FIELDS)
+        if extra:
+            findings.append(
+                Finding(
+                    "wire-chunk-meta", rel, cs.lineno,
+                    f"chunk_span reads undeclared meta keys {sorted(extra)}"
+                    " -- declare them in schema.CHUNK_META_FIELDS",
+                )
+            )
+    return findings
+
+
+def _check_codec_geometry() -> list[Finding]:
+    findings: list[Finding] = []
+    from opendiloco_tpu.diloco import compression
+
+    spath = "opendiloco_tpu/diloco/schema.py"
+    registered: dict[str, type] = {}
+    for obj in vars(compression).values():
+        if (
+            isinstance(obj, type)
+            and issubclass(obj, compression.Codec)
+            and "name" in vars(obj)
+        ):
+            registered[obj.name] = obj
+    for name, cls in sorted(registered.items()):
+        want = schema.CODEC_WIRE_GEOMETRY.get(name)
+        got = (cls.chunk_align, cls.wire_align_bytes)
+        if want is None:
+            findings.append(
+                Finding(
+                    "wire-codec-geometry", spath, 0,
+                    f"codec {name!r} ships without a CODEC_WIRE_GEOMETRY "
+                    "entry -- declare its (chunk_align, wire_align_bytes)",
+                )
+            )
+        elif got != want:
+            findings.append(
+                Finding(
+                    "wire-codec-geometry", spath, 0,
+                    f"codec {name!r} has (chunk_align, wire_align_bytes)="
+                    f"{got} but schema declares {want}",
+                )
+            )
+    for name in schema.CODEC_WIRE_GEOMETRY:
+        if name not in registered:
+            findings.append(
+                Finding(
+                    "wire-codec-geometry", spath, 0,
+                    f"schema declares geometry for unknown codec {name!r}",
+                )
+            )
+    return findings
+
+
+def _check_daemon(cpp_path: str, relto: Optional[str]) -> list[Finding]:
+    findings: list[Finding] = []
+    if not os.path.exists(cpp_path):
+        return findings
+    rel = os.path.relpath(cpp_path, relto) if relto else cpp_path
+    with open(cpp_path, encoding="utf-8", errors="replace") as f:
+        src = f.read()
+    magic = schema.MAGIC.decode()
+    if f'"{magic}"' not in src:
+        findings.append(
+            Finding(
+                "wire-daemon-magic", rel, 0,
+                f"rendezvous daemon does not frame with magic {magic!r} "
+                "(schema.MAGIC)",
+            )
+        )
+    # header length must travel as a 4-byte network-order u32 (the ">I" of
+    # FRAME_HDR_FMT); htonl/ntohl on a uint32_t is the C++ spelling
+    if not re.search(r"htonl\s*\(\s*\(?\s*uint32_t\s*\)?", src) or "ntohl" not in src:
+        findings.append(
+            Finding(
+                "wire-daemon-magic", rel, 0,
+                "rendezvous daemon must encode/decode the frame header "
+                "length with htonl/ntohl(uint32_t) to match schema "
+                f"FRAME_HDR_FMT={schema.FRAME_HDR_FMT!r}",
+            )
+        )
+    return findings
+
+
+def check(
+    roots: Iterable[str],
+    relto: Optional[str] = None,
+    wire_path: Optional[str] = None,
+    daemon_cpp: Optional[str] = None,
+) -> list[Finding]:
+    findings = _check_struct_literals(roots, relto)
+    findings += _check_schema_internal()
+    findings += _check_codec_geometry()
+    if wire_path is None:
+        wire_path = os.path.join(
+            os.path.dirname(schema.__file__), "wire.py"
+        )
+    findings += _check_chunk_meta(wire_path, relto)
+    if daemon_cpp is None:
+        pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(schema.__file__))))
+        daemon_cpp = os.path.join(pkg_root, "native", "odtp_rendezvousd.cpp")
+    findings += _check_daemon(daemon_cpp, relto)
+    return findings

@@ -41,13 +41,17 @@ import numpy as np
 
 from opendiloco_tpu import native, obs
 from opendiloco_tpu.diloco import chaos, linkstate
-from opendiloco_tpu.diloco.wire import MAGIC, MAX_HEADER, WireError
+from opendiloco_tpu.diloco.schema import (
+    BULK_ACK as _ACK,
+    FRAME_HDR as _HDR,
+    MAGIC,
+    MAX_HEADER,
+    SO_TIMEVAL_FMT,
+)
+from opendiloco_tpu.diloco.wire import WireError
 from opendiloco_tpu.utils.logger import get_text_logger
 
 log = get_text_logger(__name__)
-
-_HDR = struct.Struct(">4sI")
-_ACK = b"\x01"
 def _stripe_wait_s() -> float:
     """Stripe channels must land within the transfer budget; tunable so a
     deployment with a known round budget can fail a lost stripe faster than
@@ -535,7 +539,7 @@ class BulkSender:
         # non-blocking and break the native C recv/send path);
         # bound hangs with kernel-level timeouts instead
         sock.settimeout(None)
-        tv = struct.pack("ll", 300, 0)
+        tv = struct.pack(SO_TIMEVAL_FMT, 300, 0)
         sock.setsockopt(socket.SOL_SOCKET, socket.SO_RCVTIMEO, tv)
         sock.setsockopt(socket.SOL_SOCKET, socket.SO_SNDTIMEO, tv)
         _tune(sock)

@@ -343,6 +343,9 @@ class TopKCodec(Codec):
     error-feedback residual's job (config ``error_feedback``)."""
 
     name = "topk"
+    # one wire record is (u32 index, f32 value) = 8 bytes; stripe
+    # boundaries must not split a record (schema.CODEC_WIRE_GEOMETRY)
+    wire_align_bytes = 8
 
     def _select(self, flat: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
         n = flat.size

@@ -56,6 +56,7 @@ from typing import Any, Optional
 
 import numpy as np
 
+from opendiloco_tpu.diloco.schema import PLAN_HASH_ALGO, PLAN_HASH_HEXLEN
 from opendiloco_tpu.utils.logger import get_text_logger
 
 log = get_text_logger(__name__)
@@ -427,7 +428,7 @@ def plan_hash(bounds) -> str:
     frame meta; receivers compare against their own plan so a divergent
     partition fails the round loudly instead of corrupting the average."""
     raw = ",".join(str(int(b)) for b in bounds).encode()
-    return hashlib.sha1(raw).hexdigest()[:12]
+    return hashlib.new(PLAN_HASH_ALGO, raw).hexdigest()[:PLAN_HASH_HEXLEN]
 
 
 def shares_of(bounds, total_elems: int) -> list[float]:

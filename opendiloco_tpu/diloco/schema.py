@@ -1,0 +1,86 @@
+"""Single source of truth for every wire/frame layout in the stack.
+
+Until now each layout lived twice (or three times): the ODTP frame header
+in wire.py AND bulk.py AND the C++ rendezvous daemon, the chunk meta keys
+in ``chunk_fields`` AND ``chunk_span``, the codec alignment rules spread
+over compression.py subclasses. A one-byte drift between an encode and its
+decode corrupts a multi-GB round silently. This module declares each
+layout once; the runtime imports the constants, and the static conformance
+pass (analysis/wire_check.py) fails the build when any encode/decode site
+-- Python or C++ -- stops matching the declaration.
+
+Nothing here imports numpy/jax: it must stay importable by the lint driver
+in a bare environment.
+"""
+
+from __future__ import annotations
+
+import struct
+
+# -- ODTP control/data frame --------------------------------------------------
+#
+# [4B magic "ODTP"][4B big-endian header_len][header JSON][payload bytes]
+# Shared verbatim by the asyncio control plane (wire.py), the threaded bulk
+# plane (bulk.py) and the C++ rendezvous daemon (native/odtp_rendezvousd.cpp,
+# which the conformance pass greps for the same magic + htonl length).
+
+MAGIC = b"ODTP"
+FRAME_HDR_FMT = ">4sI"
+FRAME_HDR = struct.Struct(FRAME_HDR_FMT)
+FRAME_HDR_SIZE = 8  # must equal struct.calcsize(FRAME_HDR_FMT); pass-checked
+MAX_HEADER = 16 * 1024 * 1024
+
+# single-byte acknowledgement closing every bulk frame exchange
+BULK_ACK = b"\x01"
+
+# SO_RCVTIMEO payload on the bulk sockets: a C struct timeval (two native
+# longs). Platform-endian by design -- it never crosses the wire.
+SO_TIMEVAL_FMT = "ll"
+
+# -- chunk framing (pipelined data plane) -------------------------------------
+#
+# A pipelined part travels as nchunks frames; the encode side stamps exactly
+# these meta keys (wire.chunk_fields) and the decode side reads exactly
+# these (wire.chunk_span + tcp.py routing). The conformance pass checks
+# both functions against this tuple.
+
+CHUNK_META_FIELDS = ("chunk", "nchunks", "coff", "clen")
+
+# multi-tensor payload packing: per-tensor offset/length keys stamped by
+# wire.pack_arrays and popped by wire.unpack_arrays
+PACK_META_FIELDS = ("_off", "_len")
+
+# bulk stripe sub-frame header: session id, stripe index, byte length
+STRIPE_META_FIELDS = ("session", "stripe", "len")
+
+# -- partition-plan fingerprint ----------------------------------------------
+#
+# linkstate.plan_hash stamps every push/result frame under meta["plan"];
+# both sides must derive it identically or parts silently misalign.
+
+PLAN_HASH_ALGO = "sha1"
+PLAN_HASH_HEXLEN = 12
+PLAN_META_KEY = "plan"
+
+# -- codec wire-record geometry ----------------------------------------------
+#
+# chunk_align: chunk element offsets must be multiples of this (blockwise
+# codecs re-derive scales per block; a misaligned chunk re-blocks and stops
+# being bit-identical to the whole-tensor encode).
+# wire_align_bytes: bulk stripe boundaries round to this many bytes so a
+# stripe never splits one encoded wire record.
+#
+# The conformance pass imports compression.py and fails if a codec class
+# drifts from this table (or a new codec ships without declaring itself).
+
+CODEC_WIRE_GEOMETRY: dict[str, tuple[int, int]] = {
+    # name: (chunk_align elems, wire_align bytes)
+    "none": (1, 4),
+    "fp16": (1, 2),
+    "scaled-fp16": (1, 2),
+    "uniform8bit": (1, 1),
+    "quantile8bit": (1, 1),
+    "blockwise8bit": (4096, 1),
+    "blockwise4bit": (4096, 1),
+    "topk": (1, 8),
+}

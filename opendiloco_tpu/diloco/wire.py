@@ -16,12 +16,13 @@ from __future__ import annotations
 
 import asyncio
 import json
-import struct
 from typing import Any, Optional
 
-MAGIC = b"ODTP"
-_HDR = struct.Struct(">4sI")
-MAX_HEADER = 16 * 1024 * 1024
+from opendiloco_tpu.diloco.schema import (  # single layout declaration
+    FRAME_HDR as _HDR,
+    MAGIC,
+    MAX_HEADER,
+)
 # StreamReader buffer: the 64KB default throttles multi-hundred-MB tensor
 # frames to well under 1 GB/s; 16MB keeps the read loop off the hot path
 STREAM_LIMIT = 16 * 1024 * 1024

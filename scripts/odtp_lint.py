@@ -1,0 +1,114 @@
+#!/usr/bin/env python
+"""odtp-check driver: run the invariant passes over the repo tree.
+
+    python scripts/odtp_lint.py                 # all passes, exit 1 on findings
+    python scripts/odtp_lint.py --pass knobs    # one pass (knobs|donation|locks|wire)
+    python scripts/odtp_lint.py --write-knob-table   # regenerate the README table
+    python scripts/odtp_lint.py --check-knob-table   # fail if README table is stale
+    python scripts/odtp_lint.py --json          # machine-readable findings
+
+Scans ``opendiloco_tpu/`` and ``scripts/`` (tests ship their own seeded
+violations as fixtures and are exercised by tests/test_analysis.py).
+Suppress a true-but-accepted finding inline with
+``# odtp-lint: disable=<check> -- <justification>``.
+
+No jax/numpy import is needed for the AST passes; the wire pass imports
+``opendiloco_tpu.diloco.compression`` (numpy only) for codec geometry.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from opendiloco_tpu.analysis import donation, knob_check, knobs, locks, wire_check  # noqa: E402
+
+DEFAULT_ROOTS = ("opendiloco_tpu", "scripts")
+
+PASSES = {
+    "knobs": lambda roots: knob_check.check(roots, relto=REPO),
+    "donation": lambda roots: donation.check(roots, relto=REPO),
+    "locks": lambda roots: locks.check(roots, relto=REPO),
+    "wire": lambda roots: wire_check.check(roots, relto=REPO),
+}
+
+
+def _readme_with_table(readme: str) -> str:
+    begin, end = knobs.TABLE_BEGIN, knobs.TABLE_END
+    table = knobs.render_table()
+    if begin in readme and end in readme:
+        head, rest = readme.split(begin, 1)
+        _, tail = rest.split(end, 1)
+        return head + table + tail
+    raise SystemExit(
+        f"README.md is missing the knob-table markers ({begin} ... {end})"
+    )
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--pass", dest="passes", action="append",
+                    choices=sorted(PASSES), help="run only this pass (repeatable)")
+    ap.add_argument("--root", action="append",
+                    help="scan root(s) instead of opendiloco_tpu/ + scripts/")
+    ap.add_argument("--json", action="store_true", help="JSON findings on stdout")
+    ap.add_argument("--write-knob-table", action="store_true",
+                    help="rewrite the generated knob table in README.md")
+    ap.add_argument("--check-knob-table", action="store_true",
+                    help="fail when the README knob table is stale")
+    args = ap.parse_args(argv)
+
+    readme_path = os.path.join(REPO, "README.md")
+    if args.write_knob_table or args.check_knob_table:
+        with open(readme_path, encoding="utf-8") as f:
+            current = f.read()
+        regenerated = _readme_with_table(current)
+        if args.write_knob_table:
+            if regenerated != current:
+                with open(readme_path, "w", encoding="utf-8") as f:
+                    f.write(regenerated)
+                print("README.md knob table rewritten")
+            else:
+                print("README.md knob table already current")
+            return 0
+        if regenerated != current:
+            print(
+                "README.md knob table is stale -- run "
+                "`python scripts/odtp_lint.py --write-knob-table`",
+                file=sys.stderr,
+            )
+            return 1
+        print("README.md knob table: ok")
+        return 0
+
+    roots = [
+        r if os.path.isabs(r) else os.path.join(REPO, r)
+        for r in (args.root or DEFAULT_ROOTS)
+    ]
+    selected = args.passes or sorted(PASSES)
+    findings = []
+    for name in selected:
+        findings.extend(PASSES[name](roots))
+
+    if args.json:
+        print(json.dumps(
+            [f.__dict__ for f in findings], indent=2, sort_keys=True
+        ))
+    else:
+        for f in findings:
+            print(f.render())
+        n = len(findings)
+        print(
+            f"odtp-lint: {n} finding{'s' if n != 1 else ''} "
+            f"({', '.join(selected)} over {', '.join(args.root or DEFAULT_ROOTS)})"
+        )
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,381 @@
+"""odtp-check: each pass must catch its seeded violation and stay quiet
+on safe shapes; the repo tree itself must lint clean; the runtime lock
+witness must trip on a real inversion and cost nothing when unarmed."""
+
+import os
+import subprocess
+import sys
+import textwrap
+import threading
+
+import pytest
+
+from opendiloco_tpu.analysis import donation, knob_check, lockcheck, locks, wire_check
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LINT = os.path.join(REPO, "scripts", "odtp_lint.py")
+
+
+def _fixture(tmp_path, src, name="fix.py"):
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(src))
+    return str(tmp_path)
+
+
+def _checks(findings):
+    return sorted({f.check for f in findings})
+
+
+# ---------------------------------------------------------------- knobs
+
+def test_undeclared_knob_caught(tmp_path):
+    root = _fixture(tmp_path, """
+        import os
+        x = os.environ.get("ODTP_NOT_A_KNOB", "1")
+    """)
+    found = knob_check.check([root])
+    assert "undeclared-knob" in _checks(found)
+
+
+def test_knob_default_mismatch_caught(tmp_path):
+    # registry declares ODTP_PIPELINE default "1"
+    root = _fixture(tmp_path, """
+        import os
+        x = os.environ.get("ODTP_PIPELINE", "0")
+    """)
+    found = [f for f in knob_check.check([root]) if f.check == "knob-default-mismatch"]
+    assert found and "ODTP_PIPELINE" in found[0].message
+
+
+def test_dead_knob_caught(tmp_path):
+    # a root that reads nothing leaves every registry knob unread
+    root = _fixture(tmp_path, "x = 1\n")
+    dead = [f for f in knob_check.check([root]) if f.check == "dead-knob"]
+    assert any("ODTP_PIPELINE" in f.message for f in dead)
+
+
+def test_module_constant_key_resolves(tmp_path):
+    # the _ENV = "ODTP_CHAOS" indirection used by chaos.py/obs must not
+    # read as undeclared
+    root = _fixture(tmp_path, """
+        import os
+        _ENV = "ODTP_CHAOS"
+        spec = os.environ.get(_ENV, "")
+    """)
+    assert not [f for f in knob_check.check([root]) if f.check == "undeclared-knob"]
+
+
+# ------------------------------------------------------------- donation
+
+_JIT_HEADER = """
+    import functools
+    import jax
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def f(x, y):
+        return x + y
+"""
+
+
+def test_use_after_donate_caught(tmp_path):
+    root = _fixture(tmp_path, _JIT_HEADER + """
+    def caller(a, b):
+        out = f(a, b)
+        return a + out
+    """)
+    found = [f for f in donation.check([root]) if f.check == "use-after-donate"]
+    assert found and "`a`" in found[0].message
+
+
+def test_safe_rebind_clean(tmp_path):
+    root = _fixture(tmp_path, _JIT_HEADER + """
+    def caller(a, b):
+        a = f(a, b)
+        return a
+    """)
+    assert not donation.check([root])
+
+
+def test_branch_donate_is_may_analysis(tmp_path):
+    # donating only in one branch: reading in the *other* branch is fine,
+    # reading after the join is not
+    root = _fixture(tmp_path, _JIT_HEADER + """
+    def exclusive(a, b, flag):
+        if flag:
+            out = f(a, b)
+        else:
+            out = a + b
+        return out
+
+    def after_join(a, b, flag):
+        if flag:
+            out = f(a, b)
+        else:
+            out = b
+        return a + out
+    """)
+    found = [f for f in donation.check([root]) if f.check == "use-after-donate"]
+    assert len(found) == 1
+    assert "after_join" not in found[0].message  # message names the var, not the fn
+    assert found[0].line > 0
+
+
+def test_jit_captures_self_caught(tmp_path):
+    root = _fixture(tmp_path, """
+        import jax
+
+        def _step(x):
+            return self.scale * x
+
+        class Engine:
+            def setup(self):
+                self.step = jax.jit(_step)
+    """)
+    found = donation.check([root])
+    assert "jit-captures-self" in _checks(found)
+
+
+def test_unhashable_static_caught(tmp_path):
+    root = _fixture(tmp_path, """
+        import functools
+        import jax
+
+        @functools.partial(jax.jit, static_argnums=(1,))
+        def g(x, shape):
+            return x
+
+        def call(x):
+            return g(x, [1, 2])
+    """)
+    found = donation.check([root])
+    assert "unhashable-static" in _checks(found)
+
+
+# ---------------------------------------------------------------- locks
+
+def test_lock_inversion_caught(tmp_path):
+    root = _fixture(tmp_path, """
+        import threading
+
+        class S:
+            def __init__(self):
+                self.a = threading.Lock()
+                self.b = threading.Lock()
+
+            def one(self):
+                with self.a:
+                    with self.b:
+                        pass
+
+            def two(self):
+                with self.b:
+                    with self.a:
+                        pass
+    """)
+    found = locks.check([root])
+    assert "lock-order" in _checks(found)
+
+
+def test_lock_single_order_clean(tmp_path):
+    root = _fixture(tmp_path, """
+        import threading
+
+        class S:
+            def __init__(self):
+                self.a = threading.Lock()
+                self.b = threading.Lock()
+
+            def one(self):
+                with self.a:
+                    with self.b:
+                        pass
+
+            def two(self):
+                with self.a:
+                    with self.b:
+                        pass
+    """)
+    assert not locks.check([root])
+
+
+def test_condition_aliases_wrapped_lock(tmp_path):
+    # Condition(self.a) IS self.a: cond->b in one method and b->a in
+    # another is an inversion
+    root = _fixture(tmp_path, """
+        import threading
+
+        class S:
+            def __init__(self):
+                self.a = threading.Lock()
+                self.b = threading.Lock()
+                self.cond = threading.Condition(self.a)
+
+            def one(self):
+                with self.cond:
+                    with self.b:
+                        pass
+
+            def two(self):
+                with self.b:
+                    with self.a:
+                        pass
+    """)
+    found = locks.check([root])
+    assert "lock-order" in _checks(found)
+
+
+# ----------------------------------------------------------------- wire
+
+def test_undeclared_struct_format_caught(tmp_path):
+    root = _fixture(tmp_path, """
+        import struct
+        hdr = struct.pack(">HH", 1, 2)
+    """)
+    found = [f for f in wire_check.check([root]) if f.check == "wire-undeclared-struct"]
+    assert found and ">HH" in found[0].message
+
+
+def test_wire_repo_invariants_clean():
+    # schema internals, codec geometry, chunk meta, daemon magic -- all
+    # checked against the real tree with no fixture in the roots
+    assert not wire_check.check([])
+
+
+# ----------------------------------------------------------- suppression
+
+def test_suppression_requires_justification(tmp_path):
+    root = _fixture(tmp_path, _JIT_HEADER + """
+    def justified(a, b):
+        out = f(a, b)
+        return a + out  # odtp-lint: disable=use-after-donate -- fixture proves suppression
+
+    def bare(a, b):
+        out = f(a, b)
+        return a + out  # odtp-lint: disable=use-after-donate
+    """)
+    found = [f for f in donation.check([root]) if f.check == "use-after-donate"]
+    # the justified site is silenced; the bare disable (no `-- reason`) is not
+    assert len(found) == 1
+
+
+# ------------------------------------------------------------ the driver
+
+def test_repo_tree_lints_clean():
+    proc = subprocess.run(
+        [sys.executable, LINT], cwd=REPO, capture_output=True, text=True
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_readme_knob_table_current():
+    proc = subprocess.run(
+        [sys.executable, LINT, "--check-knob-table"],
+        cwd=REPO, capture_output=True, text=True,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_driver_exits_nonzero_on_fixture(tmp_path):
+    _fixture(tmp_path, """
+        import os
+        x = os.environ.get("ODTP_NOT_A_KNOB", "1")
+    """)
+    proc = subprocess.run(
+        [sys.executable, LINT, "--pass", "knobs", "--root", str(tmp_path)],
+        cwd=REPO, capture_output=True, text=True,
+    )
+    assert proc.returncode == 1
+    assert "undeclared-knob" in proc.stdout
+
+
+# ------------------------------------------------- runtime lock witness
+
+@pytest.fixture
+def fresh_order():
+    lockcheck.order.reset()
+    yield lockcheck.order
+    lockcheck.order.reset()
+
+
+def test_witness_trips_on_inversion(fresh_order):
+    a = lockcheck._LockProxy("fix.py:1")
+    b = lockcheck._LockProxy("fix.py:2")
+    with a:
+        with b:
+            pass
+    with b:
+        with pytest.raises(lockcheck.LockOrderViolation):
+            a.acquire()
+    assert ("fix.py:1", "fix.py:2") in fresh_order.first_seen
+
+
+def test_witness_same_site_no_ordering(fresh_order):
+    # two locks from one creation site (per-peer lock maps): nesting them
+    # both ways is not an inversion
+    a = lockcheck._LockProxy("fix.py:9")
+    b = lockcheck._LockProxy("fix.py:9")
+    with a:
+        with b:
+            pass
+    with b:
+        with a:
+            pass
+
+
+def test_witness_rlock_reentrant(fresh_order):
+    r = lockcheck._RLockProxy("fix.py:3")
+    with r:
+        with r:  # re-entry records no self-edge and keeps depth
+            pass
+        assert r._is_owned()
+    assert not fresh_order.held()
+
+
+def test_witness_condition_wait_notify(fresh_order):
+    # Condition over a proxied RLock exercises the _release_save /
+    # _acquire_restore protocol across threads
+    inner = lockcheck._RLockProxy("fix.py:4")
+    cond = threading.Condition(inner)
+    hits = []
+
+    def waiter():
+        with cond:
+            while not hits:
+                cond.wait(timeout=5)
+            hits.append("woke")
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    with cond:
+        hits.append("go")
+        cond.notify()
+    t.join(timeout=5)
+    assert hits == ["go", "woke"] and not t.is_alive()
+
+
+def test_unarmed_is_untouched():
+    # in the default (env unset) test run threading must be pristine;
+    # under chaos/serve CI the witness is armed and patched instead
+    if lockcheck.enabled():
+        assert threading.Lock is lockcheck._make_lock
+    else:
+        assert threading.Lock is lockcheck._raw_lock
+        assert threading.RLock is lockcheck._raw_rlock
+        assert threading.Condition is lockcheck._raw_condition
+
+
+def test_env_arms_witness_in_subprocess():
+    code = (
+        "import threading, opendiloco_tpu\n"
+        "from opendiloco_tpu.analysis import lockcheck\n"
+        "assert lockcheck.enabled()\n"
+        "assert threading.Lock is lockcheck._make_lock\n"
+        "l = threading.Lock()\n"
+        "assert isinstance(l, lockcheck._LockProxy) is False  # foreign caller\n"
+    )
+    env = dict(os.environ, ODTP_LOCKCHECK="1", JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-c", code], cwd=REPO, env=env,
+        capture_output=True, text=True,
+    )
+    assert proc.returncode == 0, proc.stderr
