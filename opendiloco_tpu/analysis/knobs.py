@@ -44,6 +44,9 @@ KNOBS: tuple[Knob, ...] = (
          doc_default="repo artifact"),
     Knob("ODTP_CONV_STEPS", "int", "300", "bench",
          "Inner steps per arm in `scripts/convergence_evidence.py`."),
+    Knob("ODTP_DECODE_BENCH_OUT", "path", "", "bench",
+         "Output path override for `scripts/serve_bench.py --decode`.",
+         doc_default="repo artifact"),
     Knob("ODTP_HETERO_BENCH_OUT", "path", "", "bench",
          "Output path override for `bench_outer.py --hetero`.",
          doc_default="repo artifact"),
@@ -98,6 +101,16 @@ KNOBS: tuple[Knob, ...] = (
     Knob("ODTP_ROOFLINE", "path", "", "obs",
          "Path override for the banked roofline JSON backing MFU gauges.",
          doc_default="auto-discover"),
+    # -- serve ----------------------------------------------------------------
+    Knob("ODTP_DECODE_WEIGHT_FORMAT", "str", "", "serve",
+         "Replica weight residency override for the serve plane: `w4` keeps "
+         "stacked matmul weights blockwise-4bit packed at rest (dequantized "
+         "per block inside the jit'd decode); `fp32` restores today's layout.",
+         doc_default="config"),
+    Knob("ODTP_SPEC_K", "int", "", "serve",
+         "Self-speculative decode override: draft this many tokens per slot "
+         "per step and verify full-depth (token-exact vs the one-token "
+         "loop); `0` disables.", doc_default="config"),
     # -- transport ------------------------------------------------------------
     Knob("ODTP_BULK_BANDWIDTH_BPS", "float", "0", "transport",
          "Per-process egress cap in bytes/s (token bucket) emulating a "

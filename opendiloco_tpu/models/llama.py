@@ -26,7 +26,11 @@ from typing import Any, Literal, Optional, Union
 import jax
 import jax.numpy as jnp
 
-from opendiloco_tpu.ops.attention import decode_attention, xla_attention
+from opendiloco_tpu.ops.attention import (
+    decode_attention,
+    spec_tail_attention,
+    xla_attention,
+)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -473,6 +477,68 @@ def forward(
 # ---------------------------------------------------------------------------
 
 
+W4_BLOCK = 4096  # matches diloco.compression._BLOCK (pinned by tests)
+
+
+@jax.tree_util.register_pytree_node_class
+class PackedW4:
+    """A matmul weight held blockwise-4-bit-packed at rest (serve
+    ``weight_format=w4``): ``q`` [..., ceil(n/2)] uint8 packed nibbles and
+    ``s`` [..., nblocks] uint16 fp16-bit scales per ``W4_BLOCK`` values —
+    the PR 8 ``blockwise4bit`` codec geometry, applied per layer so the
+    packed leaves keep the leading L axis and ride the decode layer scan.
+    ``shape`` is the per-layer unpacked shape (static aux data, so scan
+    reconstructs the node with it intact)."""
+
+    def __init__(self, q, s, shape):
+        self.q = q
+        self.s = s
+        self.shape = tuple(int(x) for x in shape)
+
+    def tree_flatten(self):
+        return (self.q, self.s), self.shape
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0], children[1], aux)
+
+
+def dequant_w4(q: jax.Array, s: jax.Array, shape: tuple, dtype) -> jax.Array:
+    """Unpack one layer's 4-bit weight inside the jit'd forward.
+
+    Bit-for-bit the ``native._dequant4_numpy`` math at f32: element 2i is
+    the low nibble of byte i, value = (nibble - 8) * fp16(scale) / 7."""
+    n = 1
+    for x in shape:
+        n *= int(x)
+    nib = jnp.stack([q & jnp.uint8(0x0F), q >> 4], axis=-1).reshape(-1)[:n]
+    qv = nib.astype(jnp.float32) - jnp.float32(8.0)
+    sf = jax.lax.bitcast_convert_type(s, jnp.float16).astype(jnp.float32)
+    sf = sf / jnp.float32(7.0)
+    pad = (-n) % W4_BLOCK
+    qp = jnp.pad(qv, (0, pad)).reshape(-1, W4_BLOCK)
+    out = (qp * sf[:, None]).reshape(-1)[:n].reshape(shape)
+    return out.astype(dtype)
+
+
+def _wleaf(w, dtype):
+    """Materialize a weight leaf for a matmul: packed leaves dequantize
+    per-block here, inside the jit (fused dequant+matmul); plain arrays
+    pass through (already cast by ``_cast_serving_params``)."""
+    if isinstance(w, PackedW4):
+        return dequant_w4(w.q, w.s, w.shape, dtype)
+    return w
+
+
+def _cast_serving_params(params, dtype):
+    """The forward-boundary cast, w4-aware: packed uint8/uint16 leaves
+    stay packed (their dequant targets ``dtype`` at the matmul site)."""
+    return jax.tree.map(
+        lambda x: x if x.dtype in (jnp.uint8, jnp.uint16) else x.astype(dtype),
+        params,
+    )
+
+
 def init_kv_cache(
     cfg: LlamaConfig,
     num_slots: int,
@@ -518,22 +584,24 @@ def prefill_forward(
     B, P = input_ids.shape
     Nh, Nkv, Dh = cfg.num_attention_heads, cfg.kv_heads, cfg.head_dim
     positions = jnp.broadcast_to(jnp.arange(P, dtype=jnp.int32), (B, P))
-    cparams = jax.tree.map(lambda x: x.astype(compute_dtype), params)
+    cparams = _cast_serving_params(params, compute_dtype)
     cos, sin = _rope_tables(positions, Dh, cfg.rope_theta)
+    cd = compute_dtype
 
     def block(h, layer):
         x = _rms_norm(h, layer["input_norm"], cfg.rms_norm_eps)
-        q = (x @ layer["q_proj"]).reshape(B, P, Nh, Dh)
-        k = (x @ layer["k_proj"]).reshape(B, P, Nkv, Dh)
-        v = (x @ layer["v_proj"]).reshape(B, P, Nkv, Dh)
+        q = (x @ _wleaf(layer["q_proj"], cd)).reshape(B, P, Nh, Dh)
+        k = (x @ _wleaf(layer["k_proj"], cd)).reshape(B, P, Nkv, Dh)
+        v = (x @ _wleaf(layer["v_proj"], cd)).reshape(B, P, Nkv, Dh)
         q = _rope_apply(q, cos, sin)
         k = _rope_apply(k, cos, sin)
         attn = xla_attention(q, k, v, causal=True)
-        h = h + attn.reshape(B, P, Nh * Dh) @ layer["o_proj"]
+        h = h + attn.reshape(B, P, Nh * Dh) @ _wleaf(layer["o_proj"], cd)
         x = _rms_norm(h, layer["post_attn_norm"], cfg.rms_norm_eps)
         ffn = (
-            jax.nn.silu(x @ layer["gate_proj"]) * (x @ layer["up_proj"])
-        ) @ layer["down_proj"]
+            jax.nn.silu(x @ _wleaf(layer["gate_proj"], cd))
+            * (x @ _wleaf(layer["up_proj"], cd))
+        ) @ _wleaf(layer["down_proj"], cd)
         return h + ffn, (k[0], v[0])
 
     h = jnp.take(cparams["embed_tokens"], input_ids, axis=0)
@@ -595,28 +663,30 @@ def decode_forward(
     S = tokens.shape[0]
     L, _, T, Nkv, Dh = cache_k.shape
     Nh = cfg.num_attention_heads
-    cparams = jax.tree.map(lambda x: x.astype(compute_dtype), params)
+    cparams = _cast_serving_params(params, compute_dtype)
     positions = lens[:, None].astype(jnp.int32)  # [S, 1]
     cos, sin = _rope_tables(positions, Dh, cfg.rope_theta)
     rows = jnp.arange(S)
     write_idx = jnp.mod(lens, T)
+    cd = compute_dtype
 
     def block(h, xs):
         layer, ck, cv = xs  # ck/cv [S, T, Nkv, Dh]
         x = _rms_norm(h, layer["input_norm"], cfg.rms_norm_eps)
-        q = (x @ layer["q_proj"]).reshape(S, 1, Nh, Dh)
-        k = (x @ layer["k_proj"]).reshape(S, 1, Nkv, Dh)
-        v = (x @ layer["v_proj"]).reshape(S, 1, Nkv, Dh)
+        q = (x @ _wleaf(layer["q_proj"], cd)).reshape(S, 1, Nh, Dh)
+        k = (x @ _wleaf(layer["k_proj"], cd)).reshape(S, 1, Nkv, Dh)
+        v = (x @ _wleaf(layer["v_proj"], cd)).reshape(S, 1, Nkv, Dh)
         q = _rope_apply(q, cos, sin)
         k = _rope_apply(k, cos, sin)
         ck = ck.at[rows, write_idx].set(k[:, 0].astype(ck.dtype))
         cv = cv.at[rows, write_idx].set(v[:, 0].astype(cv.dtype))
         attn = decode_attention(q[:, 0], ck, cv, lens)
-        h = h + attn.reshape(S, 1, Nh * Dh) @ layer["o_proj"]
+        h = h + attn.reshape(S, 1, Nh * Dh) @ _wleaf(layer["o_proj"], cd)
         x = _rms_norm(h, layer["post_attn_norm"], cfg.rms_norm_eps)
         ffn = (
-            jax.nn.silu(x @ layer["gate_proj"]) * (x @ layer["up_proj"])
-        ) @ layer["down_proj"]
+            jax.nn.silu(x @ _wleaf(layer["gate_proj"], cd))
+            * (x @ _wleaf(layer["up_proj"], cd))
+        ) @ _wleaf(layer["down_proj"], cd)
         return h + ffn, (ck, cv)
 
     h = jnp.take(cparams["embed_tokens"], tokens, axis=0)[:, None]  # [S, 1, D]
@@ -631,6 +701,223 @@ def decode_forward(
     )
     logits = (h @ head).astype(jnp.float32)
     return logits[:, 0], new_ck, new_cv
+
+
+def verify_forward(
+    params: dict,
+    tail: jax.Array,
+    lens: jax.Array,
+    cache_k: jax.Array,
+    cache_v: jax.Array,
+    cfg: LlamaConfig,
+    *,
+    compute_dtype: jnp.dtype = jnp.bfloat16,
+):
+    """Batched multi-token verify pass for self-speculative decode.
+
+    tail [S, K] int32 are K unverified tokens per slot (the current
+    token followed by the draft's proposals) at absolute positions
+    ``lens + i``; cache_{k,v} [L, S, T, Nkv, Dh] hold the ring pages as
+    of BEFORE the tail. Returns (logits [S, K, V] f32, tail_ks, tail_vs
+    [L, S, K, Nkv, Dh]): one full-depth greedy logit row per tail
+    position, plus the tail's K/V — kept OUT of the ring here so
+    rejected tokens need no rollback; the engine inserts only the
+    accepted prefix via :func:`spec_cache_insert`.
+
+    Also the continued-prefill primitive for shared-prefix KV reuse
+    (S = 1, tail = the suffix tokens, lens = the reused prefix length).
+    """
+    _require_dense(cfg, "verify_forward")
+    S, K = tail.shape
+    L, _, T, Nkv, Dh = cache_k.shape
+    Nh = cfg.num_attention_heads
+    cparams = _cast_serving_params(params, compute_dtype)
+    positions = lens[:, None] + jnp.arange(K, dtype=jnp.int32)[None]  # [S, K]
+    cos, sin = _rope_tables(positions, Dh, cfg.rope_theta)
+    cd = compute_dtype
+
+    def block(h, xs):
+        layer, ck, cv = xs  # ck/cv [S, T, Nkv, Dh]
+        x = _rms_norm(h, layer["input_norm"], cfg.rms_norm_eps)
+        q = (x @ _wleaf(layer["q_proj"], cd)).reshape(S, K, Nh, Dh)
+        k = (x @ _wleaf(layer["k_proj"], cd)).reshape(S, K, Nkv, Dh)
+        v = (x @ _wleaf(layer["v_proj"], cd)).reshape(S, K, Nkv, Dh)
+        q = _rope_apply(q, cos, sin)
+        k = _rope_apply(k, cos, sin)
+        attn = spec_tail_attention(q, ck, cv, k, v, lens)
+        h = h + attn.reshape(S, K, Nh * Dh) @ _wleaf(layer["o_proj"], cd)
+        x = _rms_norm(h, layer["post_attn_norm"], cfg.rms_norm_eps)
+        ffn = (
+            jax.nn.silu(x @ _wleaf(layer["gate_proj"], cd))
+            * (x @ _wleaf(layer["up_proj"], cd))
+        ) @ _wleaf(layer["down_proj"], cd)
+        return h + ffn, (k, v)
+
+    h = jnp.take(cparams["embed_tokens"], tail, axis=0)  # [S, K, D]
+    h, (tail_ks, tail_vs) = jax.lax.scan(
+        block, h, (cparams["layers"], cache_k, cache_v)
+    )
+    h = _rms_norm(h, cparams["final_norm"], cfg.rms_norm_eps)
+    head = (
+        cparams["embed_tokens"].T
+        if cfg.tie_word_embeddings
+        else cparams["lm_head"]
+    )
+    logits = (h @ head).astype(jnp.float32)
+    return logits, tail_ks, tail_vs
+
+
+def draft_propose(
+    params: dict,
+    tokens: jax.Array,
+    lens: jax.Array,
+    cache_k: jax.Array,
+    cache_v: jax.Array,
+    cfg: LlamaConfig,
+    *,
+    k_steps: int,
+    draft_layers: int,
+    compute_dtype: jnp.dtype = jnp.bfloat16,
+):
+    """Self-speculative draft: propose ``k_steps`` greedy tokens per slot
+    from the first ``draft_layers`` of the SAME weights (final norm and
+    lm head shared with the full stack).
+
+    The truncated stack's K/V for the proposed tail lives in registers
+    (a [Ld, S, k, Nkv, Dh] buffer threaded between token steps), never
+    the ring — the draft is a heuristic and dirties nothing; exactness
+    is the verify pass's job. Returns proposals [S, k_steps] int32.
+    """
+    _require_dense(cfg, "draft_propose")
+    S = tokens.shape[0]
+    L, _, T, Nkv, Dh = cache_k.shape
+    Nh = cfg.num_attention_heads
+    Ld = int(draft_layers)
+    if not 1 <= Ld <= L:
+        raise ValueError(f"draft_layers {Ld} outside [1, {L}]")
+    cparams = _cast_serving_params(params, compute_dtype)
+    dlayers = jax.tree.map(lambda x: x[:Ld], cparams["layers"])
+    dck, dcv = cache_k[:Ld], cache_v[:Ld]
+    cd = compute_dtype
+    head = (
+        cparams["embed_tokens"].T
+        if cfg.tie_word_embeddings
+        else cparams["lm_head"]
+    )
+
+    tkb = jnp.zeros((Ld, S, k_steps, Nkv, Dh), cd)
+    tvb = jnp.zeros((Ld, S, k_steps, Nkv, Dh), cd)
+    cur = tokens
+    proposals = []
+    for i in range(k_steps):
+        positions = (lens + jnp.int32(i))[:, None]  # [S, 1]
+        cos, sin = _rope_tables(positions, Dh, cfg.rope_theta)
+
+        def block(h, xs, i=i, cos=cos, sin=sin):
+            layer, ck, cv, tk, tv = xs
+            x = _rms_norm(h, layer["input_norm"], cfg.rms_norm_eps)
+            q = (x @ _wleaf(layer["q_proj"], cd)).reshape(S, 1, Nh, Dh)
+            k = (x @ _wleaf(layer["k_proj"], cd)).reshape(S, 1, Nkv, Dh)
+            v = (x @ _wleaf(layer["v_proj"], cd)).reshape(S, 1, Nkv, Dh)
+            q = _rope_apply(q, cos, sin)
+            k = _rope_apply(k, cos, sin)
+            tk = tk.at[:, i].set(k[:, 0])
+            tv = tv.at[:, i].set(v[:, 0])
+            attn = spec_tail_attention(q, ck, cv, tk, tv, lens, q_start=i)
+            h = h + attn.reshape(S, 1, Nh * Dh) @ _wleaf(layer["o_proj"], cd)
+            x = _rms_norm(h, layer["post_attn_norm"], cfg.rms_norm_eps)
+            ffn = (
+                jax.nn.silu(x @ _wleaf(layer["gate_proj"], cd))
+                * (x @ _wleaf(layer["up_proj"], cd))
+            ) @ _wleaf(layer["down_proj"], cd)
+            return h + ffn, (tk, tv)
+
+        h = jnp.take(cparams["embed_tokens"], cur, axis=0)[:, None]  # [S, 1, D]
+        h, (tkb, tvb) = jax.lax.scan(block, h, (dlayers, dck, dcv, tkb, tvb))
+        h = _rms_norm(h, cparams["final_norm"], cfg.rms_norm_eps)
+        logits = (h @ head).astype(jnp.float32)
+        cur = jnp.argmax(logits[:, 0], axis=-1).astype(jnp.int32)
+        proposals.append(cur)
+    return jnp.stack(proposals, axis=1)  # [S, k_steps]
+
+
+def spec_cache_insert(
+    cache_k: jax.Array,
+    cache_v: jax.Array,
+    tail_ks: jax.Array,
+    tail_vs: jax.Array,
+    lens: jax.Array,
+    accept: jax.Array,
+) -> tuple[jax.Array, jax.Array]:
+    """Positioned ring insert of the ACCEPTED tail prefix: per slot,
+    tail tokens i <= accept[s] land at ring index ``(lens + i) % T``;
+    rejected positions write their current cache value back (the
+    no-copy rollback — the ring simply never learns about them).
+    Requires K <= T so a tail never collides with itself."""
+    L, S, T, Nkv, Dh = cache_k.shape
+    K = tail_ks.shape[2]
+    if K > T:
+        raise ValueError(f"tail width {K} exceeds ring context {T}")
+    rows = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[:, None], (S, K))
+    pos = jnp.mod(lens[:, None] + jnp.arange(K, dtype=jnp.int32)[None], T)
+    keep = (jnp.arange(K, dtype=jnp.int32)[None] <= accept[:, None])[
+        None, :, :, None, None
+    ]
+    old_k = cache_k[:, rows, pos]  # [L, S, K, Nkv, Dh]
+    old_v = cache_v[:, rows, pos]
+    new_k = jnp.where(keep, tail_ks.astype(cache_k.dtype), old_k)
+    new_v = jnp.where(keep, tail_vs.astype(cache_v.dtype), old_v)
+    ck = cache_k.at[:, rows, pos].set(new_k)
+    cv = cache_v.at[:, rows, pos].set(new_v)
+    return ck, cv
+
+
+def prefix_copy(
+    cache_k: jax.Array,
+    cache_v: jax.Array,
+    src: jax.Array,
+    dst: jax.Array,
+    plen: jax.Array,
+) -> tuple[jax.Array, jax.Array]:
+    """Ring-copy the first ``plen`` cache rows of slot ``src`` into slot
+    ``dst`` (shared-prefix KV reuse). Rows >= plen keep dst's previous
+    bytes — stale and masked, same as any slot reuse."""
+    T = cache_k.shape[2]
+    keep = (jnp.arange(T) < plen)[:, None, None]
+    src_k = jnp.take(cache_k, src, axis=1)
+    src_v = jnp.take(cache_v, src, axis=1)
+    dst_k = jnp.take(cache_k, dst, axis=1)
+    dst_v = jnp.take(cache_v, dst, axis=1)
+    ck = cache_k.at[:, dst].set(jnp.where(keep, src_k, dst_k))
+    cv = cache_v.at[:, dst].set(jnp.where(keep, src_v, dst_v))
+    return ck, cv
+
+
+def suffix_insert(
+    cache_k: jax.Array,
+    cache_v: jax.Array,
+    ks: jax.Array,
+    vs: jax.Array,
+    slot: jax.Array,
+    start: jax.Array,
+    count: jax.Array,
+) -> tuple[jax.Array, jax.Array]:
+    """Write a continued prefill's suffix K/V [L, P', Nkv, Dh] into
+    ``slot`` at rows [start, start + count) — the positioned counterpart
+    of :func:`cache_insert` (a prompt always fits its page, so no ring
+    wrap here; padding rows beyond ``count`` are dropped)."""
+    L, S, T, Nkv, Dh = cache_k.shape
+    P = ks.shape[1]
+    page_k = jnp.take(cache_k, slot, axis=1)  # [L, T, Nkv, Dh]
+    page_v = jnp.take(cache_v, slot, axis=1)
+    disp = jnp.arange(T, dtype=jnp.int32) - jnp.asarray(start, jnp.int32)
+    valid = ((disp >= 0) & (disp < count))[:, None, None]
+    gidx = jnp.clip(disp, 0, P - 1)
+    page_k = jnp.where(valid, ks[:, gidx].astype(cache_k.dtype), page_k)
+    page_v = jnp.where(valid, vs[:, gidx].astype(cache_v.dtype), page_v)
+    ck = cache_k.at[:, slot].set(page_k)
+    cv = cache_v.at[:, slot].set(page_v)
+    return ck, cv
 
 
 def causal_lm_loss(

@@ -97,6 +97,92 @@ def decode_attention(
     return jnp.einsum("sht,sthd->shd", probs, v)
 
 
+def spec_tail_attention(
+    q: jax.Array,
+    cache_k: jax.Array,
+    cache_v: jax.Array,
+    tail_k: jax.Array,
+    tail_v: jax.Array,
+    lens: jax.Array,
+    *,
+    q_start: int = 0,
+) -> jax.Array:
+    """Multi-token tail attention over a ring KV cache plus in-register
+    tail K/V — the verify/draft primitive for speculative decode.
+
+    q [S, Kq, H, D] are unverified tail tokens per slot at absolute
+    positions ``lens + q_start + i``; cache_{k,v} [S, T, Kh, D] hold the
+    ring pages as of BEFORE the tail (positions <= lens - 1); tail_{k,v}
+    [S, K, Kh, D] are the tail's own K/V, kept out of the ring until
+    acceptance. ``q_start`` offsets the queries within the tail (the
+    draft proposes one token at a time against a growing tail buffer;
+    the verify pass runs the whole tail at q_start=0).
+
+    The masking reproduces the sequential one-token loop exactly,
+    including ring wrap: tail query i attends tail tokens <= i plus the
+    ring entries the sequential path would still hold at its step — a
+    ring slot is dropped for query i when the write of tail token j <= i
+    would have overwritten it (that is, when ``(lens + j) % T`` lands on
+    it with ``lens + j >= T``), which is precisely the sliding-window
+    eviction the per-step ring write performs. Softmax terms for masked
+    entries are exact zeros, so extra masked slots never perturb the
+    live reductions (same invariant the prefill bucket-padding relies
+    on).
+    """
+    s, t, nkv, d = cache_k.shape
+    kq = q.shape[1]
+    kt = tail_k.shape[1]
+    h = q.shape[2]
+    ck = _repeat_kv_slots(cache_k, h)
+    cv = _repeat_kv_slots(cache_v, h)
+    tk = _repeat_kv_slots(tail_k, h)
+    tv = _repeat_kv_slots(tail_v, h)
+    scale = d**-0.5
+
+    # ring scores [S, H, Kq, T]
+    ring_scores = jnp.einsum(
+        "sqhd,sthd->shqt", q, ck, preferred_element_type=jnp.float32
+    ) * scale
+    idx = jax.lax.broadcasted_iota(jnp.int32, (s, t), 1)
+    lens_ = lens[:, None].astype(jnp.int32)
+    base = (idx < lens_) | (lens_ >= t)  # live pre-tail entries
+    # disp = the i whose tail ring write lands on this slot ((lens+i) % T)
+    disp = jnp.mod(idx - lens_, t)
+    j = q_start + jnp.arange(kq, dtype=jnp.int32)[None, :, None]  # [1, Kq, 1]
+    evicted = (disp[:, None, :] <= j) & (
+        (lens_[:, None, :] + disp[:, None, :]) >= t
+    )
+    ring_valid = base[:, None, :] & ~evicted  # [S, Kq, T]
+    neg = jnp.finfo(jnp.float32).min
+    ring_scores = jnp.where(ring_valid[:, None], ring_scores, neg)
+
+    # tail scores [S, H, Kq, Kt], causal within the tail
+    tail_scores = jnp.einsum(
+        "sqhd,skhd->shqk", q, tk, preferred_element_type=jnp.float32
+    ) * scale
+    qi = q_start + jax.lax.broadcasted_iota(jnp.int32, (kq, kt), 0)
+    ki = jax.lax.broadcasted_iota(jnp.int32, (kq, kt), 1)
+    tail_scores = jnp.where((ki <= qi)[None, None], tail_scores, neg)
+
+    scores = jnp.concatenate([ring_scores, tail_scores], axis=-1)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    out = jnp.einsum("shqt,sthd->sqhd", probs[..., :t], cv)
+    out = out + jnp.einsum("shqk,skhd->sqhd", probs[..., t:], tv)
+    return out
+
+
+def _repeat_kv_slots(k: jax.Array, num_q_heads: int) -> jax.Array:
+    """GQA broadcast for slot-major [S, T, Kh, D] cache layouts."""
+    s, t, nkv, d = k.shape
+    if nkv == num_q_heads:
+        return k
+    assert num_q_heads % nkv == 0, (num_q_heads, nkv)
+    rep = num_q_heads // nkv
+    return jnp.broadcast_to(k[:, :, :, None, :], (s, t, nkv, rep, d)).reshape(
+        s, t, num_q_heads, d
+    )
+
+
 @functools.partial(jax.jit, static_argnames=("impl", "causal"))
 def attention(
     q: jax.Array,

@@ -17,6 +17,7 @@ one obs registry / Prometheus endpoint).
 from __future__ import annotations
 
 import dataclasses
+import os
 from typing import Optional
 
 import jax.numpy as jnp
@@ -80,6 +81,11 @@ def build_serving(
         snapshot_fn = diloco_opt.master_snapshot_wire
         epoch_fn = lambda: diloco_opt.epoch
         epoch = diloco_opt.epoch
+    # fast-decode knob overrides (experiments without a config edit)
+    env_k = os.environ.get("ODTP_SPEC_K")
+    spec_k = int(env_k) if env_k else serve_cfg.spec_decode_k
+    env_wf = os.environ.get("ODTP_DECODE_WEIGHT_FORMAT")
+    weight_format = env_wf if env_wf else serve_cfg.weight_format
     engine = ServeEngine(
         model_cfg,
         params,
@@ -91,11 +97,15 @@ def build_serving(
         snapshot_fn=snapshot_fn,
         epoch_fn=epoch_fn,
         max_stale_rounds=serve_cfg.max_stale_rounds,
+        spec_k=spec_k,
+        draft_layers=serve_cfg.draft_layers,
+        weight_format=weight_format,
     )
     batcher = ContinuousBatcher(
         engine,
         max_queue=serve_cfg.max_queue,
         swap_every_steps=serve_cfg.swap_every_steps,
+        prefix_cache=serve_cfg.prefix_cache,
     ).start()
     server = None
     if start_server:

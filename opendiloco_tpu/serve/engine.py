@@ -2,12 +2,34 @@
 hot-swap.
 
 The engine owns its OWN device copy of the weights plus the slot-paged
-ring KV cache, and exposes exactly three device operations to the
-scheduler loop — ``admit`` (prefill a prompt into a free slot),
-``decode_step`` (one token for every live slot), and ``maybe_swap``
-(adopt a newer master snapshot from the outer plane). All three are
-called from a single scheduler thread; the engine is deliberately not
-thread-safe so the jits can donate the cache buffers without a lock.
+ring KV cache, and exposes a handful of device operations to the
+scheduler loop — ``admit`` (prefill a prompt into a free slot, optionally
+continuing from a reused prefix), ``decode_step`` (one token for every
+live slot), ``spec_step`` (self-speculative draft + verify, several
+tokens per live slot), and ``maybe_swap`` (adopt a newer master snapshot
+from the outer plane). All are called from a single scheduler thread;
+the engine is deliberately not thread-safe so the jits can donate the
+cache buffers without a lock.
+
+Fast-decode legs (each individually off by default, and off-path
+bit-identical to the plain engine):
+
+- ``spec_k > 0``: self-speculative decode. A draft over the first
+  ``draft_layers`` of the SAME weights proposes k greedy tokens per slot;
+  one batched full-depth verify pass accepts the longest agreeing prefix
+  plus the corrected token (Leviathan et al., arXiv 2211.17192 — greedy
+  case). Outputs are token-identical to the one-token loop by
+  construction: every emitted token is the full model's greedy argmax
+  given exactly the tokens before it.
+- ``weight_format="w4"``: the stacked decoder matmul weights stay
+  blockwise-4bit packed at rest (PR 8 codec geometry, per layer) and
+  dequantize per block inside the jit'd forwards; norms, embeddings and
+  the lm head stay fp32. ~4x fewer weight bytes touched per decode step,
+  and ``install_wire`` of a blockwise4bit snapshot re-slices the wire
+  payload directly into the resident layout when block and layer grids
+  align (no dequant/requantize round trip).
+- prefix reuse (scheduler-driven): ``admit(..., prefix_src, prefix_len)``
+  ring-copies a live slot's prefix K/V and prefills only the suffix.
 
 Hot-swap pulls codec-encoded snapshots (``DiLoCoOptimizer.
 master_snapshot_wire``, the fp16 ``ODTP_STATE_CODEC`` path) and rebinds
@@ -27,15 +49,25 @@ import jax.numpy as jnp
 import numpy as np
 
 from opendiloco_tpu import obs
-from opendiloco_tpu.diloco.compression import get_codec
+from opendiloco_tpu.diloco.compression import (
+    get_codec,
+    pack_blockwise4_stacked,
+    split_blockwise4_stacked,
+)
 from opendiloco_tpu.models.llama import (
     LlamaConfig,
+    PackedW4,
     cache_insert,
     decode_forward,
+    draft_propose,
     init_kv_cache,
     prefill_forward,
+    prefix_copy,
+    spec_cache_insert,
+    suffix_insert,
+    verify_forward,
 )
-from opendiloco_tpu.serve.kvcache import pick_bucket
+from opendiloco_tpu.serve.kvcache import accept_counts, pick_bucket
 
 
 @jax.jit
@@ -49,6 +81,8 @@ def _fresh_copy(leaves):
 # (payload, meta, shape) per master leaf in params-flatten order — exactly
 # what DiLoCoOptimizer.master_snapshot_wire returns.
 SnapshotFn = Callable[[], tuple]
+
+_STAGES = ("prefill", "draft", "verify", "insert", "decode", "swap")
 
 
 class ServeEngine:
@@ -65,6 +99,9 @@ class ServeEngine:
         snapshot_fn: Optional[SnapshotFn] = None,
         epoch_fn: Optional[Callable[[], int]] = None,
         max_stale_rounds: int = 0,
+        spec_k: int = 0,
+        draft_layers: int = 0,
+        weight_format: str = "fp32",
     ):
         self.cfg = cfg
         self.num_slots = int(num_slots)
@@ -77,12 +114,50 @@ class ServeEngine:
         self.epoch_fn = epoch_fn
         self.max_stale_rounds = int(max_stale_rounds)
 
+        self.weight_format = str(weight_format)
+        if self.weight_format not in ("fp32", "w4"):
+            raise ValueError(f"unknown weight_format {weight_format!r}")
+        self.spec_k = int(spec_k)
+        if self.spec_k < 0:
+            raise ValueError(f"spec_k must be >= 0, got {spec_k}")
+        if self.spec_k:
+            L = cfg.num_hidden_layers
+            ld = int(draft_layers) or max(1, L // 2)
+            if not 1 <= ld < L:
+                raise ValueError(
+                    f"draft_layers {ld} outside [1, {L}) for spec decode"
+                )
+            if self.spec_k + 1 > self.max_context:
+                raise ValueError(
+                    f"spec_k {self.spec_k} + 1 exceeds max_context "
+                    f"{self.max_context}"
+                )
+            self.draft_layers = ld
+        else:
+            self.draft_layers = 0
+        # widest unverified tail a slot may carry: current token + k drafts.
+        # The scheduler uses it to bound ring headroom for prefix reuse.
+        self.tail_width = self.spec_k + 1
+
         leaves, self._treedef = jax.tree.flatten(params)
+        kp, _ = jax.tree_util.tree_flatten_with_path(params)
+        self._paths = [
+            tuple(getattr(k, "key", str(k)) for k in path) for path, _ in kp
+        ]
         self._shapes = [tuple(x.shape) for x in leaves]
-        self.params = jax.tree.unflatten(self._treedef, _fresh_copy(leaves))
+        # w4-packable set: the stacked decoder matmuls ([L, in, out] leaves
+        # under "layers"); norms ([L, D]), embeddings and lm head stay fp32
+        self._packable = [
+            p[0] == "layers" and len(s) == 3
+            for p, s in zip(self._paths, self._shapes)
+        ]
+        self.params = self._assemble(leaves)
         self.weights_epoch = int(epoch)
         self.swap_count = 0
         self.swap_seconds = 0.0
+        # wall-clock per decode stage (loop-thread only, mirrored to obs
+        # spans when a tracer is armed; the bench reads this directly)
+        self.stage_seconds = {k: 0.0 for k in _STAGES}
 
         cache = init_kv_cache(cfg, self.num_slots, self.max_context, compute_dtype)
         self.cache_k, self.cache_v = cache["k"], cache["v"]
@@ -107,12 +182,96 @@ class ServeEngine:
         self._insert = jax.jit(_insert, donate_argnums=(0, 1))
         self._decode = jax.jit(_decode, donate_argnums=(3, 4))
 
+        # speculative-decode jits (compiled only when spec_step runs)
+        kk, ld = self.spec_k, self.draft_layers
+
+        def _draft(p, tokens, lens, ck, cv):
+            return draft_propose(
+                p, tokens, lens, ck, cv, cfg,
+                k_steps=kk, draft_layers=ld, compute_dtype=cd,
+            )
+
+        def _verify(p, tail, lens, ck, cv):
+            logits, tks, tvs = verify_forward(
+                p, tail, lens, ck, cv, cfg, compute_dtype=cd
+            )
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32), tks, tvs
+
+        def _spec_insert(ck, cv, tks, tvs, lens, accept):
+            return spec_cache_insert(ck, cv, tks, tvs, lens, accept)
+
+        self._draft = jax.jit(_draft)
+        self._verify = jax.jit(_verify)
+        self._spec_insert = jax.jit(_spec_insert, donate_argnums=(0, 1))
+        # host hook: tests swap in adversarial proposers; returns [S, k] np
+        self.propose_fn: Callable[[np.ndarray, np.ndarray], np.ndarray] = (
+            self._propose_draft
+        )
+
+        # shared-prefix reuse jits (compiled only when the batcher asks)
+        def _pcopy(ck, cv, src, dst, plen):
+            return prefix_copy(ck, cv, src, dst, plen)
+
+        def _suffix(p, ck, cv, slot, tail, plen):
+            # continued prefill = the verify primitive over the one slot's
+            # gathered page: tail tokens at positions plen..plen+B-1
+            page_k = jnp.take(ck, slot, axis=1)[:, None]  # [L, 1, T, Kh, Dh]
+            page_v = jnp.take(cv, slot, axis=1)[:, None]
+            logits, tks, tvs = verify_forward(
+                p, tail, plen[None], page_k, page_v, cfg, compute_dtype=cd
+            )
+            return logits[0], tks[:, 0], tvs[:, 0]
+
+        def _suffix_ins(ck, cv, ks, vs, slot, start, count):
+            return suffix_insert(ck, cv, ks, vs, slot, start, count)
+
+        self._prefix_copy = jax.jit(_pcopy, donate_argnums=(0, 1))
+        self._suffix = jax.jit(_suffix)
+        self._suffix_insert = jax.jit(_suffix_ins, donate_argnums=(0, 1))
+
+    # -- weight residency ---------------------------------------------------
+
+    def _assemble(self, leaves):
+        """Rebuild the params tree from flat leaves (original flatten
+        order). ``weight_format=w4`` packs the stacked matmul leaves into
+        :class:`PackedW4` nodes (or adopts pre-packed ones from the
+        install_wire fast path); everything else lands as f32 buffers."""
+        if self.weight_format != "w4":
+            return jax.tree.unflatten(self._treedef, _fresh_copy(leaves))
+        out = []
+        for leaf, packable, shape in zip(leaves, self._packable, self._shapes):
+            if isinstance(leaf, PackedW4):
+                out.append(leaf)
+            elif packable:
+                q, s = pack_blockwise4_stacked(
+                    np.asarray(jax.device_get(leaf), np.float32)
+                )
+                out.append(
+                    PackedW4(jnp.asarray(q), jnp.asarray(s), tuple(shape[1:]))
+                )
+            else:
+                out.append(jnp.asarray(jax.device_get(leaf), jnp.float32))
+        return jax.tree.unflatten(self._treedef, out)
+
     # -- admission ---------------------------------------------------------
 
-    def admit(self, slot: int, prompt: Sequence[int]) -> tuple[int, np.ndarray]:
+    def admit(
+        self,
+        slot: int,
+        prompt: Sequence[int],
+        *,
+        prefix_src: Optional[int] = None,
+        prefix_len: int = 0,
+    ) -> tuple[int, np.ndarray]:
         """Prefill ``prompt`` into ``slot`` and return (first greedy token,
         last-position logits [V] f32). The prompt must fit a compile
-        bucket (scheduler-enforced via ``prompt_fits``)."""
+        bucket (scheduler-enforced via ``prompt_fits``).
+
+        With ``prefix_src``/``prefix_len`` the first ``prefix_len`` tokens
+        are NOT recomputed: their K/V rows are ring-copied from the live
+        source slot (bitwise what a cold prefill writes — causal attention
+        makes prefix K/V independent of anything after it) and only the
+        suffix runs through the model."""
         n = len(prompt)
         bucket = pick_bucket(n, self.prefill_buckets)
         if bucket is None:
@@ -120,15 +279,48 @@ class ServeEngine:
                 f"prompt length {n} exceeds max bucket "
                 f"{self.prefill_buckets[-1]}"
             )
-        ids = np.zeros((1, bucket), np.int32)
-        ids[0, :n] = np.asarray(prompt, np.int32)
-        tok, logits, ks, vs = self._prefill(
-            self.params, jnp.asarray(ids), jnp.int32(n)
+        t0 = time.perf_counter()
+        if prefix_src is not None and 0 < prefix_len < n:
+            tok, logits = self._admit_suffix(slot, prompt, prefix_src, prefix_len)
+        else:
+            ids = np.zeros((1, bucket), np.int32)
+            ids[0, :n] = np.asarray(prompt, np.int32)
+            tokd, logitsd, ks, vs = self._prefill(
+                self.params, jnp.asarray(ids), jnp.int32(n)
+            )
+            self.cache_k, self.cache_v = self._insert(
+                self.cache_k, self.cache_v, ks, vs, jnp.int32(slot)
+            )
+            tok, logits = int(tokd[0]), np.asarray(logitsd[0])
+        dt = time.perf_counter() - t0
+        self.stage_seconds["prefill"] += dt
+        tr = obs.tracer()
+        if tr is not None:
+            tr.add_span("serve_prefill", t0, t0 + dt, tokens=n)
+        return tok, logits
+
+    def _admit_suffix(
+        self, slot: int, prompt: Sequence[int], src: int, plen: int
+    ) -> tuple[int, np.ndarray]:
+        suffix = np.asarray(prompt[plen:], np.int32)
+        ns = int(suffix.size)
+        sb = pick_bucket(ns, self.prefill_buckets)
+        tail = np.zeros((1, sb), np.int32)
+        tail[0, :ns] = suffix
+        self.cache_k, self.cache_v = self._prefix_copy(
+            self.cache_k, self.cache_v,
+            jnp.int32(src), jnp.int32(slot), jnp.int32(plen),
         )
-        self.cache_k, self.cache_v = self._insert(
-            self.cache_k, self.cache_v, ks, vs, jnp.int32(slot)
+        logits, tks, tvs = self._suffix(
+            self.params, self.cache_k, self.cache_v,
+            jnp.int32(slot), jnp.asarray(tail), jnp.int32(plen),
         )
-        return int(tok[0]), np.asarray(logits[0])
+        self.cache_k, self.cache_v = self._suffix_insert(
+            self.cache_k, self.cache_v, tks, tvs,
+            jnp.int32(slot), jnp.int32(plen), jnp.int32(ns),
+        )
+        row = np.asarray(logits[ns - 1])
+        return int(row.argmax()), row
 
     def prompt_fits(self, n: int) -> bool:
         return pick_bucket(n, self.prefill_buckets) is not None
@@ -142,6 +334,7 @@ class ServeEngine:
         host arrays (inactive slots pass 0s; their ring writes land in
         masked positions and are overwritten on the slot's next tenancy).
         Returns (next tokens [S] np.int32, logits [S, V] on device)."""
+        t0 = time.perf_counter()
         tok, logits, self.cache_k, self.cache_v = self._decode(
             self.params,
             jnp.asarray(tokens, jnp.int32),
@@ -149,7 +342,63 @@ class ServeEngine:
             self.cache_k,
             self.cache_v,
         )
-        return np.asarray(tok), logits
+        tok = np.asarray(tok)
+        self.stage_seconds["decode"] += time.perf_counter() - t0
+        return tok, logits
+
+    def _propose_draft(self, tokens: np.ndarray, lens: np.ndarray) -> np.ndarray:
+        return np.asarray(
+            self._draft(
+                self.params,
+                jnp.asarray(tokens, jnp.int32),
+                jnp.asarray(lens, jnp.int32),
+                self.cache_k,
+                self.cache_v,
+            )
+        )
+
+    def spec_step(
+        self, tokens: np.ndarray, lens: np.ndarray
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """One self-speculative round over all S slots: draft k proposals,
+        verify the [current, d_1..d_k] tail full-depth, keep the longest
+        agreeing prefix. Returns (g [S, k+1] np.int32, m [S] np.int32):
+        slot s emits ``g[s, :m[s]+1]`` — its next m[s]+1 greedy tokens,
+        token-identical to m[s]+1 plain decode_steps — and its cache now
+        holds the tail rows 0..m[s] (rejected proposals were never
+        inserted; that IS the rollback)."""
+        if not self.spec_k:
+            raise RuntimeError("spec_step requires spec_k > 0")
+        t0 = time.perf_counter()
+        props = np.asarray(self.propose_fn(tokens, lens), np.int32)  # [S, k]
+        t1 = time.perf_counter()
+        tail = np.concatenate(
+            [np.asarray(tokens, np.int32)[:, None], props], axis=1
+        )
+        g, tks, tvs = self._verify(
+            self.params,
+            jnp.asarray(tail),
+            jnp.asarray(lens, jnp.int32),
+            self.cache_k,
+            self.cache_v,
+        )
+        g = np.asarray(g)  # [S, k+1]
+        t2 = time.perf_counter()
+        m = accept_counts(props, g)
+        self.cache_k, self.cache_v = self._spec_insert(
+            self.cache_k, self.cache_v, tks, tvs,
+            jnp.asarray(lens, jnp.int32), jnp.asarray(m),
+        )
+        t3 = time.perf_counter()
+        self.stage_seconds["draft"] += t1 - t0
+        self.stage_seconds["verify"] += t2 - t1
+        self.stage_seconds["insert"] += t3 - t2
+        tr = obs.tracer()
+        if tr is not None:
+            tr.add_span("serve_draft", t0, t1, k=self.spec_k)
+            tr.add_span("serve_verify", t1, t2)
+            tr.add_span("serve_spec_insert", t2, t3)
+        return g, m
 
     # -- weight hot-swap ---------------------------------------------------
 
@@ -175,34 +424,55 @@ class ServeEngine:
         self.install_wire(epoch, blobs, codec_name)
         dt = time.perf_counter() - t0
         self.swap_seconds += dt
+        self.stage_seconds["swap"] += dt
         obs.count("serve_weight_swaps")
         obs.gauge("serve_last_swap_ms", dt * 1e3)
         return True
 
     def install_wire(self, epoch: int, blobs, codec_name: str) -> None:
-        """Decode a codec-encoded master snapshot and rebind the weights."""
+        """Decode a codec-encoded master snapshot and rebind the weights.
+
+        With ``weight_format=w4`` and a ``blockwise4bit`` snapshot the
+        packed leaves are re-sliced straight from the wire payload when
+        the codec's whole-leaf block grid lands on layer boundaries —
+        cheaper than decoding, AND exact where a dequantize/requantize
+        round trip is not bit-stable."""
         codec = get_codec(codec_name)
         if len(blobs) != len(self._shapes):
             raise ValueError(
                 f"snapshot has {len(blobs)} leaves, engine expects "
                 f"{len(self._shapes)}"
             )
+        fast = self.weight_format == "w4" and codec_name == "blockwise4bit"
         leaves = []
-        for (payload, meta, shape), want in zip(blobs, self._shapes):
+        for (payload, meta, shape), want, packable in zip(
+            blobs, self._shapes, self._packable
+        ):
             if tuple(shape) != want:
                 raise ValueError(f"snapshot leaf shape {shape} != {want}")
             size = int(np.prod(shape)) if shape else 1
+            if fast and packable:
+                res = split_blockwise4_stacked(
+                    payload, meta, int(shape[0]), size // int(shape[0])
+                )
+                if res is not None:
+                    q, s = res
+                    leaves.append(
+                        PackedW4(
+                            jnp.asarray(q), jnp.asarray(s), tuple(shape[1:])
+                        )
+                    )
+                    continue
             a = np.asarray(
                 codec.decode(payload, (size,), meta), np.float32
             ).reshape(shape)
-            leaves.append(jax.device_put(a))
-        self.params = jax.tree.unflatten(self._treedef, leaves)
+            leaves.append(a)
+        self.params = self._assemble(leaves)
         self.weights_epoch = int(epoch)
         self.swap_count += 1
 
     def install_params(self, epoch: int, params) -> None:
         """Direct (uncompressed) rebind — tests and static-weight mode."""
-        leaves = jax.tree.leaves(params)
-        self.params = jax.tree.unflatten(self._treedef, _fresh_copy(leaves))
+        self.params = self._assemble(jax.tree.leaves(params))
         self.weights_epoch = int(epoch)
         self.swap_count += 1

@@ -23,7 +23,12 @@ import numpy as np
 
 from opendiloco_tpu import obs
 from opendiloco_tpu.serve.engine import ServeEngine
-from opendiloco_tpu.serve.kvcache import SlotAllocator
+from opendiloco_tpu.serve.kvcache import SlotAllocator, common_prefix_len
+
+# a reused prefix must be worth the copy: below this many shared tokens
+# the batcher prefills cold (the suffix pass would cover ~the whole
+# prompt anyway)
+MIN_PREFIX_TOKENS = 4
 
 
 @dataclasses.dataclass
@@ -72,11 +77,14 @@ class ContinuousBatcher:
         max_queue: int = 1024,
         swap_every_steps: int = 16,
         gauge_every_steps: int = 32,
+        prefix_cache: bool = False,
     ):
         self.engine = engine
         self.max_queue = int(max_queue)
         self.swap_every_steps = max(1, int(swap_every_steps))
         self.gauge_every_steps = max(1, int(gauge_every_steps))
+        self.prefix_cache = bool(prefix_cache)
+        self.spec_decode = engine.spec_k > 0
         self.slots = SlotAllocator(engine.num_slots)
         self._active: dict[int, _Slot] = {}  # slot id -> state
         self._queue: collections.deque[Request] = collections.deque()
@@ -95,6 +103,12 @@ class ContinuousBatcher:
         self.staleness_hist: collections.Counter = collections.Counter()
         self._rate_mark = (time.perf_counter(), 0)
         self.loop_error: Optional[str] = None
+        # speculative-decode accounting (loop thread only)
+        self.spec_proposed = 0
+        self.spec_accepted = 0
+        # shared-prefix reuse accounting
+        self.prefix_hits = 0
+        self.prefix_tokens_saved = 0
 
     # -- client API --------------------------------------------------------
 
@@ -210,6 +224,29 @@ class ContinuousBatcher:
                 self.failed += 1
                 req.finish(self.loop_error)
 
+    def _find_prefix(self, prompt: list) -> tuple[Optional[int], int]:
+        """Longest usable shared prompt prefix among the live slots.
+
+        A source qualifies while its ring has not wrapped (rows < plen
+        still hold the prefix K/V) — ``tail_width`` of headroom keeps the
+        next spec tail from wrapping before the copy lands. The reused
+        length is capped one short of the prompt so the suffix pass always
+        has at least the final token to run (its logits seed decode)."""
+        best_src, best = None, 0
+        for slot, st in self._active.items():
+            if (
+                st.cache_len + self.engine.tail_width
+                > self.engine.max_context
+            ):
+                continue
+            p = common_prefix_len(prompt, st.req.prompt)
+            p = min(p, len(prompt) - 1)
+            if p > best:
+                best_src, best = slot, p
+        if best >= MIN_PREFIX_TOKENS:
+            return best_src, best
+        return None, 0
+
     def _admit(self) -> bool:
         admitted = False
         while self.slots.num_free:
@@ -218,7 +255,21 @@ class ContinuousBatcher:
                     break
                 req = self._queue.popleft()
             slot = self.slots.alloc()
-            tok, _ = self.engine.admit(slot, req.prompt)
+            src, plen = (
+                self._find_prefix(req.prompt)
+                if self.prefix_cache
+                else (None, 0)
+            )
+            if src is not None:
+                tok, _ = self.engine.admit(
+                    slot, req.prompt, prefix_src=src, prefix_len=plen
+                )
+                self.prefix_hits += 1
+                self.prefix_tokens_saved += plen
+                obs.count("serve_prefix_hits")
+                obs.count("serve_prefix_tokens_saved", plen)
+            else:
+                tok, _ = self.engine.admit(slot, req.prompt)
             req.t_first = time.perf_counter()
             req.tokens.append(tok)
             st = _Slot(req=req, cache_len=len(req.prompt), last_token=tok)
@@ -233,6 +284,8 @@ class ContinuousBatcher:
     def _decode(self) -> bool:
         if not self._active:
             return False
+        if self.spec_decode:
+            return self._decode_spec()
         S = self.engine.num_slots
         tokens = np.zeros((S,), np.int32)
         lens = np.zeros((S,), np.int32)
@@ -251,6 +304,43 @@ class ContinuousBatcher:
             self.total_new_tokens += 1
             if self._finished(st):
                 done_slots.append(slot)
+        for slot in done_slots:
+            self.slots.free(slot)
+            self._retire(self._active.pop(slot))
+        return True
+
+    def _decode_spec(self) -> bool:
+        """One speculative round: every live slot consumes its accepted
+        prefix + the corrected token, so a single engine call advances a
+        slot by 1..k+1 tokens — token-for-token what k+1 plain decode
+        steps would have produced (engine.spec_step docstring)."""
+        S = self.engine.num_slots
+        tokens = np.zeros((S,), np.int32)
+        lens = np.zeros((S,), np.int32)
+        for slot, st in self._active.items():
+            tokens[slot] = st.last_token
+            lens[slot] = st.cache_len
+        g, m = self.engine.spec_step(tokens, lens)
+        self.staleness_hist[self.engine.staleness()] += 1
+        proposed = self.engine.spec_k * len(self._active)
+        accepted = sum(int(m[slot]) for slot in self._active)
+        self.spec_proposed += proposed
+        self.spec_accepted += accepted
+        obs.count("serve_spec_proposed", proposed)
+        obs.count("serve_spec_accepted", accepted)
+        done_slots = []
+        emitted = 0
+        for slot, st in self._active.items():
+            for tok in g[slot, : int(m[slot]) + 1].tolist():
+                st.req.tokens.append(int(tok))
+                st.cache_len += 1
+                st.last_token = int(tok)
+                self.total_new_tokens += 1
+                emitted += 1
+                if self._finished(st):
+                    done_slots.append(slot)
+                    break
+        obs.count("serve_tokens_generated", emitted)
         for slot in done_slots:
             self.slots.free(slot)
             self._retire(self._active.pop(slot))
@@ -295,6 +385,10 @@ class ContinuousBatcher:
             "serve_batch_occupancy", self.slots.num_active / self.slots.num_slots
         )
         obs.gauge("serve_snapshot_staleness", self.engine.staleness())
+        if self.spec_proposed:
+            obs.gauge(
+                "serve_spec_acceptance", self.spec_accepted / self.spec_proposed
+            )
         with self._cond:
             obs.gauge("serve_queue_depth", len(self._queue))
 
@@ -323,8 +417,27 @@ class ContinuousBatcher:
             "ttft_ms": {"p50": pct(ttft, 50), "p99": pct(ttft, 99)},
             "weight_swaps": self.engine.swap_count,
             "weights_epoch": self.engine.weights_epoch,
+            # int keys in numeric order: json.dump(sort_keys=True) sorts
+            # dict items BEFORE stringifying, so the artifact reads
+            # 0, 1, 2, ... 10 instead of the lexicographic "0", "1", "10"
             "staleness_hist": {
-                str(k): v for k, v in sorted(self.staleness_hist.items())
+                int(k): v for k, v in sorted(self.staleness_hist.items())
+            },
+            "stages_s": {
+                k: round(v, 6) for k, v in self.engine.stage_seconds.items()
+            },
+            "spec": {
+                "proposed": self.spec_proposed,
+                "accepted": self.spec_accepted,
+                "acceptance_rate": (
+                    self.spec_accepted / self.spec_proposed
+                    if self.spec_proposed
+                    else None
+                ),
+            },
+            "prefix": {
+                "hits": self.prefix_hits,
+                "tokens_saved": self.prefix_tokens_saved,
             },
             "loop_error": self.loop_error,
         }

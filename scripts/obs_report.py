@@ -216,6 +216,18 @@ def fragment_breakdown(events: list[dict]) -> dict[tuple[int, int], dict]:
     return out
 
 
+def serve_breakdown(events: list[dict]) -> dict[str, float]:
+    """One worker's serve-plane decode-stage seconds, summed per span
+    name (``serve_prefill`` / ``serve_draft`` / ``serve_verify`` /
+    ``serve_spec_insert``). Empty when the worker never served."""
+    out: dict[str, float] = {}
+    for ev in events:
+        name = ev.get("name") or ""
+        if ev.get("ph") == "X" and name.startswith("serve_"):
+            out[name] = out.get(name, 0.0) + ev.get("dur", 0) / 1e6
+    return out
+
+
 def merge_report(trace_dir: str) -> tuple[dict, dict]:
     """Merge every worker trace in ``trace_dir`` by round id. Returns
     (report body, merged Chrome trace)."""
@@ -327,11 +339,33 @@ def merge_report(trace_dir: str) -> tuple[dict, dict]:
         for k, v in (meta.get("counters") or {}).items():
             counters[k] = counters.get(k, 0.0) + v
 
+    # serve-plane surface (train+serve workers): per-worker decode-stage
+    # span totals plus the speculative-decode acceptance the counters imply
+    serve_stages: dict[str, dict[str, float]] = {}
+    for wid, events, _meta in workers:
+        b = serve_breakdown(events)
+        if b:
+            serve_stages[str(wid)] = {
+                k: round(v, 6) for k, v in sorted(b.items())
+            }
+    serve_counters = {
+        k: counters[k] for k in sorted(counters) if k.startswith("serve_")
+    }
+    serve: dict = {}
+    if serve_stages or serve_counters:
+        serve = {"stages_s": serve_stages, "counters": serve_counters}
+        proposed = serve_counters.get("serve_spec_proposed", 0)
+        if proposed:
+            serve["spec_acceptance"] = round(
+                serve_counters.get("serve_spec_accepted", 0) / proposed, 4
+            )
+
     body = {
         "workers_traced": len(workers),
         "trace_files": [os.path.basename(p) for p in paths],
         "per_round": rounds,
         **({"per_fragment": fragments} if fragments else {}),
+        **({"serve": serve} if serve else {}),
         "counters_total": {k: counters[k] for k in sorted(counters)},
     }
     return body, export.chrome_trace(workers)

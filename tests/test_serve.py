@@ -12,6 +12,16 @@ Oracles:
   ODTP_STATE_CODEC override honored, epoch-consistent tags
 - one obs registry serves trainer AND server gauges; port collisions
   downgrade to ephemeral instead of killing the process
+
+Fast-decode oracles (PR 11):
+- self-speculative decode is token-bit-exact vs the one-token loop —
+  across prefill buckets, across ring wrap, and under an adversarial
+  draft that is ALWAYS wrong (acceptance floors at the verify token)
+- w4-resident weights change bytes at rest, not behavior: logits track
+  the fp32-resident engine to quantization tolerance, and the packed
+  bits are identical whether the native kernel or the numpy fallback
+  produced them
+- prefix reuse writes the SAME prefix K/V bytes a cold prefill writes
 """
 import json
 import socket
@@ -475,6 +485,315 @@ def test_http_and_jsonl_frontend(tiny_cfg):
     finally:
         srv.stop()
         batcher.stop()
+
+
+# ---------------------------------------------------------------------------
+# fast decode, leg a: self-speculative parity (PR 11 tentpole)
+# ---------------------------------------------------------------------------
+
+
+def spec_generate(engine, prompt, n, slot=0):
+    """Drive the spec engine directly: admit + spec rounds, one slot.
+    Returns the first n greedy tokens."""
+    tok, _ = engine.admit(slot, prompt)
+    toks = [tok]
+    S = engine.num_slots
+    lens = np.zeros((S,), np.int32)
+    cur = np.zeros((S,), np.int32)
+    lens[slot], cur[slot] = len(prompt), tok
+    while len(toks) < n:
+        g, m = engine.spec_step(cur, lens)
+        take = int(m[slot]) + 1
+        toks.extend(int(t) for t in g[slot, :take])
+        lens[slot] += take
+        cur[slot] = toks[-1]
+    return toks[:n]
+
+
+@pytest.mark.parametrize("buckets", [(8,), (32,)])
+def test_spec_decode_token_parity(tiny_cfg, buckets):
+    """Spec decode emits the exact token stream of the plain loop, for
+    every draft width, regardless of prefill bucket padding."""
+    plain, _ = make_engine(tiny_cfg, prefill_buckets=buckets)
+    ref = greedy_generate(plain, [5, 1, 4, 1, 5], 20)[0]
+    for k in (1, 3):
+        spec, _ = make_engine(tiny_cfg, prefill_buckets=buckets, spec_k=k)
+        assert spec_generate(spec, [5, 1, 4, 1, 5], 20) == ref
+
+
+def test_spec_decode_parity_across_ring_wrap(tiny_cfg):
+    """Parity holds while the ring wraps (3 + 24 tokens on a 16-wide
+    page): draft/verify tail K/V never touches the ring before
+    acceptance, and the tail-aware eviction mask reproduces the sliding
+    window the one-token loop sees."""
+    plain, _ = make_engine(tiny_cfg, max_context=16, prefill_buckets=(8,))
+    ref = greedy_generate(plain, [1, 2, 3], 24)[0]
+    spec, _ = make_engine(
+        tiny_cfg, max_context=16, prefill_buckets=(8,), spec_k=3
+    )
+    assert spec_generate(spec, [1, 2, 3], 24) == ref
+
+
+def test_spec_zero_acceptance_adversarial(tiny_cfg):
+    """A draft that is ALWAYS wrong: every proposal disagrees with the
+    full model's greedy choice, so every round accepts zero drafts and
+    emits exactly the verify pass's corrected token. Output stays
+    token-identical — a bad draft can cost throughput, never change the
+    stream (rejected tokens never enter the ring)."""
+    prompt, n = [2, 4, 6], 12
+    plain, _ = make_engine(tiny_cfg)
+    ref = greedy_generate(plain, prompt, n)[0]
+
+    spec, _ = make_engine(tiny_cfg, spec_k=2)
+    V = tiny_cfg.vocab_size
+    count = {"emitted": 1}  # admit already produced ref[0]
+
+    def adversary(tokens, lens):
+        # ref[emitted] is the true greedy next token; propose anything else
+        wrong = (ref[count["emitted"]] + 1) % V
+        return np.full((spec.num_slots, spec.spec_k), wrong, np.int32)
+
+    spec.propose_fn = adversary
+    tok, _ = spec.admit(0, prompt)
+    assert tok == ref[0]
+    toks = [tok]
+    lens = np.zeros((spec.num_slots,), np.int32)
+    cur = np.zeros((spec.num_slots,), np.int32)
+    lens[0], cur[0] = len(prompt), tok
+    while len(toks) < n:
+        g, m = spec.spec_step(cur, lens)
+        assert int(m[0]) == 0  # nothing agreed; verify floor
+        toks.append(int(g[0, 0]))
+        count["emitted"] += 1
+        lens[0] += 1
+        cur[0] = toks[-1]
+    assert toks == ref
+
+
+def test_spec_batcher_matches_isolated(tiny_cfg):
+    """Continuous batching + spec decode: staggered requests sharing two
+    slots still match the same requests decoded alone and plain."""
+    rng = np.random.default_rng(11)
+    prompts = [rng.integers(1, 256, int(n)).tolist() for n in (3, 7, 5, 12)]
+    lengths = [6, 9, 4, 7]
+    engine, params = make_engine(tiny_cfg, num_slots=2, spec_k=3)
+    batcher = ContinuousBatcher(engine).start()
+    try:
+        reqs = []
+        for p, n in zip(prompts, lengths):
+            reqs.append(batcher.submit(p, max_new_tokens=n))
+            time.sleep(0.01)
+        for r in reqs:
+            assert r.wait(60) and r.error is None
+    finally:
+        batcher.stop()
+    for req, p, n in zip(reqs, prompts, lengths):
+        solo = ServeEngine(
+            tiny_cfg, params, num_slots=1, max_context=64,
+            prefill_buckets=(8, 16, 32), compute_dtype=jnp.float32,
+        )
+        assert req.tokens == greedy_generate(solo, p, n)[0]
+    assert batcher.spec_proposed > 0
+    assert 0 <= batcher.spec_accepted <= batcher.spec_proposed
+    assert batcher.failed == 0
+
+
+# ---------------------------------------------------------------------------
+# fast decode, leg b: 4-bit-resident replica weights (PR 11 tentpole)
+# ---------------------------------------------------------------------------
+
+
+def _packed_leaves(engine):
+    from opendiloco_tpu.models.llama import PackedW4
+
+    return [
+        x
+        for x in jax.tree.leaves(
+            engine.params, is_leaf=lambda x: isinstance(x, PackedW4)
+        )
+        if isinstance(x, PackedW4)
+    ]
+
+
+def test_w4_resident_logits_track_fp32(tiny_cfg):
+    """w4 residency is a storage change, not a model change: the stacked
+    matmul leaves really are packed (uint8 nibbles + uint16 scales), and
+    the in-jit per-block dequant reproduces an fp32-resident engine
+    running the SAME quantized values — identical tokens, logits equal
+    to reduction-order noise. (How far quant(W) drifts from W is the
+    codec's accuracy contract, pinned by the PR 8 compression tests.)"""
+    from opendiloco_tpu.models.llama import dequant_w4
+
+    w4, params = make_engine(tiny_cfg, weight_format="w4")
+
+    packed = _packed_leaves(w4)
+    assert packed  # the residency actually engaged
+    assert all(
+        p.q.dtype == jnp.uint8 and p.s.dtype == jnp.uint16 for p in packed
+    )
+    # norms ([L, D]) / embeddings / lm head stayed f32
+    assert any(
+        not hasattr(x, "q") and x.dtype == jnp.float32
+        for x in jax.tree.leaves(w4.params)
+    )
+
+    # fp32 engine over the explicitly-dequantized weights = the oracle
+    ref_params = jax.tree.map(
+        lambda x: (
+            np.stack([
+                np.asarray(dequant_w4(x.q[i], x.s[i], x.shape, jnp.float32))
+                for i in range(x.q.shape[0])
+            ])
+            if hasattr(x, "q")
+            else x
+        ),
+        w4.params,
+        is_leaf=lambda x: hasattr(x, "q"),
+    )
+    plain, _ = make_engine(tiny_cfg)
+    plain.install_params(0, ref_params)
+
+    ref_toks, ref_logits = greedy_generate(plain, [3, 1, 4, 1], 6)
+    toks, logits = greedy_generate(w4, [3, 1, 4, 1], 6)
+    assert toks == ref_toks
+    np.testing.assert_allclose(logits, ref_logits, atol=2e-5, rtol=2e-4)
+
+
+def test_w4_pack_native_and_numpy_fallback_agree(tiny_cfg, monkeypatch):
+    """The packed-at-rest bits are the codec's bits: quantizing through
+    the native kernel and through the numpy fallback yields identical
+    payloads, so a w4 engine is reproducible across hosts with and
+    without the built library."""
+    from opendiloco_tpu import native
+
+    w4_native, params = make_engine(tiny_cfg, weight_format="w4")
+    monkeypatch.setattr(native, "get_lib", lambda: None)
+    w4_np = ServeEngine(
+        tiny_cfg, params, num_slots=4, max_context=64,
+        prefill_buckets=(8, 16, 32), compute_dtype=jnp.float32,
+        weight_format="w4",
+    )
+    pn, pf = _packed_leaves(w4_native), _packed_leaves(w4_np)
+    assert pn and len(pn) == len(pf)
+    for a, b in zip(pn, pf):
+        np.testing.assert_array_equal(np.asarray(a.q), np.asarray(b.q))
+        np.testing.assert_array_equal(np.asarray(a.s), np.asarray(b.s))
+    # same bits at rest -> same tokens out
+    assert (
+        greedy_generate(w4_np, [7, 6, 5], 5)[0]
+        == greedy_generate(w4_native, [7, 6, 5], 5)[0]
+    )
+
+
+def test_install_wire_w4_fast_path(tiny_cfg):
+    """A blockwise4bit snapshot installs into a w4 engine without a
+    dequant/requantize round trip where the codec's whole-leaf block
+    grid lands on layer boundaries: the resident packed leaves dequant
+    to EXACTLY the codec's own reconstruction."""
+    from opendiloco_tpu.diloco.compression import get_codec
+    from opendiloco_tpu.models.llama import W4_BLOCK, dequant_w4
+
+    engine, _ = make_engine(tiny_cfg, weight_format="w4")
+    _, params2 = make_engine(tiny_cfg, seed=77)
+    blobs = _wire_blobs(params2, "blockwise4bit")
+    engine.install_wire(1, blobs, "blockwise4bit")
+    assert engine.weights_epoch == 1
+
+    codec = get_codec("blockwise4bit")
+    leaves = jax.tree.leaves(
+        engine.params, is_leaf=lambda x: hasattr(x, "q")
+    )
+    aligned = 0
+    for leaf, (payload, meta, shape) in zip(leaves, blobs):
+        if not hasattr(leaf, "q"):
+            continue
+        size = int(np.prod(shape))
+        per_layer = size // shape[0]
+        want = codec.decode(payload, (size,), meta).reshape(shape)
+        got = np.stack([
+            np.asarray(dequant_w4(leaf.q[i], leaf.s[i], leaf.shape, jnp.float32))
+            for i in range(shape[0])
+        ])
+        if per_layer % W4_BLOCK == 0:
+            aligned += 1
+            np.testing.assert_array_equal(got, want)  # re-sliced, bit-exact
+        else:
+            # fallback repack: one extra quantization of grid values
+            np.testing.assert_allclose(got, want, atol=2e-2, rtol=0)
+    assert aligned  # the fast path actually ran on this geometry
+    toks, logits = greedy_generate(engine, [1, 2, 3], 4)
+    assert np.isfinite(logits).all()
+
+
+# ---------------------------------------------------------------------------
+# fast decode, leg c: shared-prefix KV reuse (PR 11 tentpole)
+# ---------------------------------------------------------------------------
+
+
+def test_prefix_reuse_kv_bytes_identical(tiny_cfg):
+    """Reusing a live slot's prefix writes the SAME K/V bytes a cold
+    prefill writes (causal attention makes prefix rows independent of
+    the suffix), the suffix rows agree to float tolerance, and the
+    generated stream is token-identical to a cold admit."""
+    engine, params = make_engine(tiny_cfg)
+    sysp = [9, 8, 7, 6, 5, 4]
+    p2 = sysp + [20, 21, 22]
+    plen, n_new = len(sysp), 8
+
+    cold = ServeEngine(
+        tiny_cfg, params, num_slots=4, max_context=64,
+        prefill_buckets=(8, 16, 32), compute_dtype=jnp.float32,
+    )
+    cold_toks, _ = greedy_generate(cold, p2, n_new, slot=1)
+
+    engine.admit(0, sysp + [30, 31])  # the live source slot
+    tok, _ = engine.admit(1, p2, prefix_src=0, prefix_len=plen)
+    assert tok == cold_toks[0]
+    for warm, ref in (
+        (engine.cache_k, cold.cache_k), (engine.cache_v, cold.cache_v)
+    ):
+        warm, ref = np.asarray(warm), np.asarray(ref)
+        np.testing.assert_array_equal(warm[:, 1, :plen], ref[:, 1, :plen])
+        np.testing.assert_allclose(
+            warm[:, 1, plen : len(p2)], ref[:, 1, plen : len(p2)],
+            atol=2e-6, rtol=2e-5,
+        )
+
+    toks = [tok]  # and the continuation matches token-for-token
+    lens = np.zeros((engine.num_slots,), np.int32)
+    cur = np.zeros((engine.num_slots,), np.int32)
+    lens[1], cur[1] = len(p2), tok
+    for _ in range(n_new - 1):
+        nxt, _ = engine.decode_step(cur, lens)
+        toks.append(int(nxt[1]))
+        lens[1] += 1
+        cur[1] = toks[-1]
+    assert toks == cold_toks
+
+
+def test_prefix_batcher_hits_and_parity(tiny_cfg):
+    """The batcher detects a shared system prompt across queued
+    requests, reuses the live slot's prefix K/V, and the second request
+    still gets its isolated-greedy tokens."""
+    engine, params = make_engine(tiny_cfg)
+    batcher = ContinuousBatcher(engine, prefix_cache=True).start()
+    sysp = list(range(1, 9))
+    p1, p2 = sysp + [30, 31], sysp + [40]
+    try:
+        r1 = batcher.submit(p1, max_new_tokens=12)
+        r2 = batcher.submit(p2, max_new_tokens=4)
+        assert r1.wait(60) and r1.error is None
+        assert r2.wait(60) and r2.error is None
+    finally:
+        batcher.stop()
+    for req, p, n in ((r1, p1, 12), (r2, p2, 4)):
+        solo = ServeEngine(
+            tiny_cfg, params, num_slots=1, max_context=64,
+            prefill_buckets=(8, 16, 32), compute_dtype=jnp.float32,
+        )
+        assert req.tokens == greedy_generate(solo, p, n)[0]
+    assert batcher.prefix_hits >= 1
+    assert batcher.prefix_tokens_saved >= len(sysp)
 
 
 def test_build_serving_with_diloco_swaps_live(tiny_cfg):
