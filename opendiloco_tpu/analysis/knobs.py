@@ -50,6 +50,9 @@ KNOBS: tuple[Knob, ...] = (
     Knob("ODTP_HETERO_BENCH_OUT", "path", "", "bench",
          "Output path override for `bench_outer.py --hetero`.",
          doc_default="repo artifact"),
+    Knob("ODTP_HIER_BENCH_OUT", "path", "", "bench",
+         "Output path override for `bench_outer.py --hier`.",
+         doc_default="repo artifact"),
     Knob("ODTP_LIVE_TRAIN_STEPS", "int", "1500", "bench",
          "Step budget for `scripts/live_train.py`."),
     Knob("ODTP_OUTER_BENCH_OUT", "path", "", "bench",
@@ -128,6 +131,16 @@ KNOBS: tuple[Knob, ...] = (
     Knob("ODTP_EXPECT_PEERS", "int", "0", "transport",
          "Rendezvous group-complete fast path: close matchmaking as soon "
          "as this many peers joined; 0 = wait out the window."),
+    Knob("ODTP_HIER", "bool", "", "transport",
+         "`1` arms the two-level hierarchical outer round: the planner "
+         "clusters peers into sites, elects one aggregator per site, and "
+         "only aggregators touch the WAN. Off = flat butterfly.",
+         doc_default="off"),
+    Knob("ODTP_HIER_AGG", "spec", "", "transport",
+         "`|`-separated fnmatch globs over peer ids naming PREFERRED "
+         "aggregators (e.g. the site-uplink hosts); sites with no live "
+         "match fall back to capacity/peer-id election.",
+         doc_default="elected"),
     Knob("ODTP_LINK_ADAPT", "bool", "", "transport",
          "`1` arms bandwidth-aware transport: proportional reduce-scatter "
          "partitioning, BDP-derived striping, straggler hedging. Off = "
@@ -157,6 +170,14 @@ KNOBS: tuple[Knob, ...] = (
     Knob("ODTP_RDV_FAILBACK_S", "float", "60.0", "transport",
          "How long a worker keeps trying the native rendezvous daemon "
          "before failing back to worker-hosted rendezvous."),
+    Knob("ODTP_SITE_RATIO", "float", "4.0", "transport",
+         "Auto-clustering threshold: peers whose pairwise link capacity is "
+         "within this factor of the group's fattest link share a site."),
+    Knob("ODTP_SITES", "spec", "", "transport",
+         "Explicit site assignment: `;`-separated sites, each a "
+         "`|`-separated list of fnmatch globs over peer ids (e.g. "
+         "`rack-a-*;rack-b-*`). Unset = cluster from the gossiped link "
+         "matrix.", doc_default="auto-cluster"),
     Knob("ODTP_WORKER_RENDEZVOUS", "bool", "1", "transport",
          "`0` disables the in-process fallback rendezvous server (require "
          "the external daemon)."),

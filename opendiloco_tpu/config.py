@@ -322,6 +322,10 @@ class Config(BaseModel):
     dataset_name_or_paths: str = "allenai/c4"
     dataset_streaming: bool = True
     fake_data: bool = False
+    # "random" = uniform tokens (entropy-floor loss, plumbing only);
+    # "ramp" = learnable consecutive-token ramps (convergence-oracle
+    # stream) so loss-descent assertions on fake data are meaningful
+    fake_data_mode: str = "random"
     tokenizer_name: str = "mistralai/Mistral-7B-v0.1"
     seq_length: int = 1024
     num_workers: int = 1  # host dataloading threads

@@ -72,7 +72,7 @@ class _ProducerError:
 
 
 class FakeTokenizedDataset:
-    """Deterministic infinite stream of random token sequences
+    """Deterministic infinite stream of synthetic token sequences
     (reference: utils.py:155-167).
 
     Counter-based: sample ``i`` of a seed is a pure function of ``(seed,
@@ -80,7 +80,13 @@ class FakeTokenizedDataset:
     shared stream (process ``p`` of ``n`` yields samples ``p, p+n, ...``)
     so the assembled global batch holds the same sample set regardless of
     the process topology — which is what makes single-host vs multihost
-    loss trajectories comparable in tests."""
+    loss trajectories comparable in tests.
+
+    ``mode="random"`` yields uniform random tokens: loss sits at the
+    entropy floor ``ln(vocab)`` from step 0, so it exercises the plumbing
+    but cannot descend. ``mode="ramp"`` yields consecutive-token ramps
+    from a random start (the convergence-oracle stream) — fully
+    learnable, so loss-descent gates on fake data are meaningful."""
 
     def __init__(
         self,
@@ -89,20 +95,31 @@ class FakeTokenizedDataset:
         seed: int = 0,
         start: int = 0,
         stride: int = 1,
+        mode: str = "random",
     ):
         assert vocab_size > 3, "vocab_size must be greater than 3"
+        assert mode in ("random", "ramp"), f"unknown fake-data mode {mode!r}"
         self.seq_length = seq_length
         self.vocab_size = vocab_size
         self.seed = seed
         self.start = start
         self.stride = stride
+        self.mode = mode
         self.samples_seen = 0  # local count; global index = start + i*stride
 
     def __iter__(self) -> Iterator[dict[str, np.ndarray]]:
         while True:
             idx = self.start + self.samples_seen * self.stride
             rng = np.random.default_rng((self.seed, idx))
-            ids = rng.integers(3, self.vocab_size, self.seq_length).astype(np.int32)
+            if self.mode == "ramp":
+                first = rng.integers(0, self.vocab_size)
+                ids = (
+                    (first + np.arange(self.seq_length)) % self.vocab_size
+                ).astype(np.int32)
+            else:
+                ids = rng.integers(
+                    3, self.vocab_size, self.seq_length
+                ).astype(np.int32)
             self.samples_seen += 1
             yield {"input_ids": ids, "labels": ids.copy()}
 
@@ -301,6 +318,7 @@ class DataLoader:
 def get_dataloader(
     *,
     fake_data: bool,
+    fake_data_mode: str = "random",
     dataset_name_or_paths: str,
     tokenizer_name: str,
     seq_length: int,
@@ -326,6 +344,7 @@ def get_dataloader(
             seed=seed + world_rank + offset,
             start=jax.process_index(),
             stride=jax.process_count(),
+            mode=fake_data_mode,
         )
     elif streaming:
         import jax

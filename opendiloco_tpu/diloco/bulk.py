@@ -187,6 +187,27 @@ def egress_bucket() -> Optional[_TokenBucket]:
         return _rate_bucket
 
 
+_wan_bucket: Optional[_TokenBucket] = None
+_wan_bps: float = -1.0
+
+
+def wan_bucket() -> Optional[_TokenBucket]:
+    """The process-wide WAN-uplink bucket, armed only by the chaos plane's
+    ``wan_bps``. Separate from :func:`egress_bucket` by design: frames to
+    WAN-classified destinations (``chaos wan_peers`` globs, consulted by
+    the tcp layer) drain BOTH buckets — a worker's NIC and its site's
+    shared uplink are distinct constraints, and the hierarchical bench
+    relies on intra-site traffic paying only the first."""
+    global _wan_bucket, _wan_bps
+    cp = chaos.plane()
+    bps = cp.wan_bps() if cp is not None else 0.0
+    with _rate_lock:
+        if bps != _wan_bps:
+            _wan_bps = bps
+            _wan_bucket = _TokenBucket(bps) if bps > 0 else None
+        return _wan_bucket
+
+
 _THROTTLE_CHUNK = 1 << 20
 
 

@@ -35,6 +35,14 @@ Grammar — ``;``-separated ``key=value`` items:
                         LOWER cap binds). This is how a bench emulates a
                         bandwidth-skewed galaxy: give one worker's process
                         a chaos spec with a lower cap than its peers.
+- ``wan_bps=N``         cap egress to WAN-classified destinations at N
+                        bytes/second (separate token bucket, additive with
+                        ``egress_bps``: the NIC cap and the site-uplink cap
+                        both apply). Destinations are classified by
+                        ``wan_peers``.
+- ``wan_peers=G|G``     ``|``-separated fnmatch globs over destination peer
+                        ids; a match means frames to that peer cross the
+                        emulated WAN. Required for ``wan_bps`` to bite.
 
 Design constraints:
 
@@ -52,6 +60,7 @@ Design constraints:
 
 from __future__ import annotations
 
+import fnmatch
 import os
 import random
 import threading
@@ -118,6 +127,8 @@ def parse_spec(spec: str) -> dict:
         "straggle_ms": (0.0, 0.0),
         "straggle_worker": None,
         "egress_bps": 0.0,
+        "wan_bps": 0.0,
+        "wan_peers": [],
     }
     for item in filter(None, (s.strip() for s in spec.split(";"))):
         if "=" not in item:
@@ -149,10 +160,14 @@ def _parse_item(p: dict, k: str, v: str) -> None:
         p["blackout_s"] = float(v)
     elif k == "straggle_worker":
         p["straggle_worker"] = int(v.lstrip("wW"))
-    elif k == "egress_bps":
-        p["egress_bps"] = float(v)
-        if p["egress_bps"] < 0:
-            raise ChaosSpecError(f"egress_bps={v} must be >= 0")
+    elif k in ("egress_bps", "wan_bps"):
+        p[k] = float(v)
+        if p[k] < 0:
+            raise ChaosSpecError(f"{k}={v} must be >= 0")
+    elif k == "wan_peers":
+        p["wan_peers"] = [g for g in (s.strip() for s in v.split("|")) if g]
+        if not p["wan_peers"]:
+            raise ChaosSpecError("wan_peers needs at least one glob")
     else:
         raise ChaosSpecError(f"unknown chaos spec key {k!r}")
 
@@ -247,6 +262,20 @@ class ChaosPlane:
         (lower of this and ODTP_BULK_BANDWIDTH_BPS binds) — so every
         payload path that honors the env cap honors the chaos cap too."""
         return float(self.params["egress_bps"])
+
+    def wan_bps(self) -> float:
+        """Emulated WAN-uplink cap (0 = none). Consumed by
+        bulk.wan_bucket(); frames to ``is_wan_peer`` destinations drain it
+        IN ADDITION to the egress bucket — a site's NIC and its shared
+        uplink are separate constraints and both must bind."""
+        return float(self.params["wan_bps"])
+
+    def is_wan_peer(self, peer_id: str) -> bool:
+        """Does a frame to this destination cross the emulated WAN?
+        fnmatch against the wan_peers globs; no globs means no WAN
+        classification (wan_bps never bites)."""
+        globs = self.params["wan_peers"]
+        return any(fnmatch.fnmatch(peer_id, g) for g in globs)
 
     # -- schedules -----------------------------------------------------------
 

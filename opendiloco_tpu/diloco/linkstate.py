@@ -13,11 +13,12 @@ measure->react loop on telemetry the planes already produce:
   python and native rendezvous daemons store and replay VERBATIM — so a
   ``join_group`` reply already hands every member an identical snapshot of
   the galaxy's link matrix, with zero daemon changes.
-- :func:`plan_bounds` turns that shared snapshot into butterfly part
-  bounds proportional to measured capacity (min-share floor, per-round
-  re-planning); determinism comes from planning *only* from the shared
-  group snapshot, and :func:`plan_hash` rides every push/result frame so a
-  divergent plan fails loudly instead of corrupting the reduce.
+- :func:`planner.plan_bounds` (diloco/planner.py — re-exported here) turns
+  that shared snapshot into butterfly part bounds proportional to measured
+  capacity (min-share floor, per-round re-planning); determinism comes
+  from planning *only* from the shared group snapshot, and
+  :func:`planner.plan_hash` rides every push/result frame so a divergent
+  plan fails loudly instead of corrupting the reduce.
 - :func:`stripes_for` / :func:`chunk_elems_for` derive per-link stripe
   counts and pipeline chunk sizes from bandwidth x RTT (BDP) instead of
   the global ``ODTP_BULK_STREAMS`` / ``ODTP_PIPELINE_CHUNK_MB`` knobs;
@@ -47,16 +48,11 @@ Stability knobs (read per call so tests and benches can flip them):
 
 from __future__ import annotations
 
-import hashlib
 import math
 import os
-import statistics
 import threading
 from typing import Any, Optional
 
-import numpy as np
-
-from opendiloco_tpu.diloco.schema import PLAN_HASH_ALGO, PLAN_HASH_HEXLEN
 from opendiloco_tpu.utils.logger import get_text_logger
 
 log = get_text_logger(__name__)
@@ -286,15 +282,7 @@ class LinkEstimator:
         return out
 
 
-# -- deterministic proportional planning --------------------------------------
-#
-# Planning inputs come EXCLUSIVELY from the join_group reply: the rendezvous
-# materializes one group list (each member's registration + progress, links
-# vector included) at round close and hands the identical copy to every
-# member, so identical pure-function planning yields identical bounds on
-# every worker. plan_hash() in the frame meta turns any residual divergence
-# (version skew, daemon mutation) into a loud AllReduceError instead of a
-# silently mis-partitioned reduce.
+# -- link-vector access (planner input) ---------------------------------------
 
 
 def _member_links(member: dict) -> Optional[dict]:
@@ -307,138 +295,23 @@ def _member_links(member: dict) -> Optional[dict]:
     return peers if isinstance(peers, dict) else {}
 
 
-def group_capacities(group: list[dict]) -> Optional[list[float]]:
-    """Per-member capacity estimate (bytes/s) from the shared snapshot.
+# The partition-planning functions (group_capacities, plan_shares,
+# plan_bounds, plan_hash, shares_of) moved to diloco/planner.py — the one
+# module every transport plans through. Re-exported lazily below so
+# existing callers (and the published linkstate API) keep working without
+# a circular import at module load.
 
-    None = plan uniform: any member not speaking the link protocol (adapt
-    off, older version) vetoes adaptivity for the whole group — a mixed
-    swarm must agree on bounds, and uniform is the only plan every member
-    can compute.
-
-    capacity_j = min(egress_j, ingress_j) where egress_j is the median of
-    j's own published goodputs toward its peers and ingress_j the median of
-    what the other members measured sending TO j — the binding direction
-    governs (an egress-capped straggler looks fast from outside; a
-    congested ingress looks fine to its own sends).
-    """
-    links: list[dict] = []
-    for member in group:
-        vec = _member_links(member)
-        if vec is None:
-            return None
-        links.append(vec)
-    caps: list[float] = []
-    for j, member in enumerate(group):
-        pid = member.get("peer_id")
-        egress = [
-            float(ent.get("bps", 0) or 0)
-            for ent in links[j].values()
-            if isinstance(ent, dict)
-        ]
-        ingress = [
-            float(ent.get("bps", 0) or 0)
-            for i, vec in enumerate(links)
-            if i != j
-            for key, ent in vec.items()
-            if key == pid and isinstance(ent, dict)
-        ]
-        egress = [b for b in egress if b > 0 and math.isfinite(b)]
-        ingress = [b for b in ingress if b > 0 and math.isfinite(b)]
-        sides = []
-        if egress:
-            sides.append(statistics.median(egress))
-        if ingress:
-            sides.append(statistics.median(ingress))
-        caps.append(min(sides) if sides else 0.0)
-    known = [c for c in caps if c > 0.0]
-    if not known:
-        return None  # nobody has measured anything yet: uniform
-    # unknown links assume the median known capacity — neutral, so a fresh
-    # joiner is neither starved nor trusted with an outsized part
-    fill = statistics.median(known)
-    return [c if c > 0.0 else fill for c in caps]
+_PLANNER_EXPORTS = (
+    "group_capacities", "plan_shares", "plan_bounds", "plan_hash", "shares_of",
+)
 
 
-def plan_shares(caps: list[float], floor: Optional[float] = None) -> list[float]:
-    """Capacity-proportional shares with a min-share floor.
+def __getattr__(name: str):
+    if name in _PLANNER_EXPORTS:
+        from opendiloco_tpu.diloco import planner
 
-    ``floor`` is a fraction of the uniform share 1/n (default
-    ``ODTP_LINK_MIN_SHARE``). Shares below the floor are pinned to it and
-    the remainder redistributes proportionally over the unpinned peers;
-    the loop terminates in <= n passes (each pass pins >= 1 new peer).
-    """
-    n = len(caps)
-    if n < 2:
-        return [1.0] * n
-    lo = (floor if floor is not None else min_share()) / n
-    total = sum(caps)
-    if total <= 0.0:
-        return [1.0 / n] * n
-    shares = [c / total for c in caps]
-    pinned: set[int] = set()
-    for _ in range(n):
-        low = [
-            i for i in range(n) if i not in pinned and shares[i] < lo - 1e-12
-        ]
-        if not low:
-            break
-        pinned.update(low)
-        if len(pinned) >= n:
-            return [1.0 / n] * n
-        budget = 1.0 - lo * len(pinned)
-        free_total = sum(caps[i] for i in range(n) if i not in pinned)
-        if budget <= 0.0 or free_total <= 0.0:
-            return [1.0 / n] * n
-        shares = [
-            lo if i in pinned else caps[i] / free_total * budget
-            for i in range(n)
-        ]
-    return shares
-
-
-def plan_bounds(
-    total_elems: int, group: list[dict], *, quantum: int = 1024
-) -> Optional[np.ndarray]:
-    """Butterfly part bounds for this round, or None for the uniform plan.
-
-    Bounds are quantized to ``quantum`` elements (tidier codec chunk grids;
-    the final bound always lands exactly on ``total_elems``). Tiny buffers
-    (barrier probes, gossip pairs) always plan uniform: there is nothing to
-    rebalance and control rounds should stay bit-stable.
-    """
-    n = len(group)
-    if n < 2 or total_elems < n * quantum * 4:
-        return None
-    caps = group_capacities(group)
-    if caps is None:
-        return None
-    shares = plan_shares(caps)
-    bounds = np.zeros(n + 1, np.int64)
-    acc = 0.0
-    for j in range(n):
-        acc += shares[j]
-        b = int(round(acc * total_elems / quantum)) * quantum
-        bounds[j + 1] = min(max(b, int(bounds[j])), total_elems)
-    bounds[n] = total_elems
-    return bounds
-
-
-def plan_hash(bounds) -> str:
-    """Stable fingerprint of a bounds vector, carried in every push/result
-    frame meta; receivers compare against their own plan so a divergent
-    partition fails the round loudly instead of corrupting the average."""
-    raw = ",".join(str(int(b)) for b in bounds).encode()
-    return hashlib.new(PLAN_HASH_ALGO, raw).hexdigest()[:PLAN_HASH_HEXLEN]
-
-
-def shares_of(bounds, total_elems: int) -> list[float]:
-    """Bounds back to rounded shares (health ledger / HEALTH lines)."""
-    if total_elems <= 0:
-        return []
-    return [
-        round(float(int(bounds[j + 1]) - int(bounds[j])) / total_elems, 4)
-        for j in range(len(bounds) - 1)
-    ]
+        return getattr(planner, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 
 # -- BDP-derived transport parameters -----------------------------------------

@@ -29,6 +29,7 @@ import numpy as np
 
 from opendiloco_tpu import native, obs
 from opendiloco_tpu.config import DilocoConfig
+from opendiloco_tpu.diloco import planner
 from opendiloco_tpu.diloco.backend import OuterBackend, PeerProgress, wait_for_peers
 from opendiloco_tpu.diloco.compression import get_codec
 from opendiloco_tpu.diloco.error_feedback import ErrorFeedback
@@ -175,37 +176,11 @@ class DiLoCoOptimizer:
         if cfg.streaming_fragments > 1:
             leaf_sizes = [int(x.size) for x in flat_dev]
             n_frag = min(cfg.streaming_fragments, len(leaf_sizes))
-            total = sum(leaf_sizes)
-            target = total / n_frag
-            frags: list[list[int]] = []
-            cur: list[int] = []
-            acc = 0
-            for i, sz in enumerate(leaf_sizes):
-                cur.append(i)
-                acc += sz
-                remaining = len(leaf_sizes) - i - 1
-                still_needed = n_frag - len(frags) - 1  # after closing cur
-                # close when the fragment is full OR the leaves left are
-                # only just enough to give every remaining fragment one --
-                # EXACTLY n_frag non-empty fragments, best-effort balance
-                # even when a huge leaf sits at the tail
-                if still_needed > 0 and (
-                    acc >= target or remaining == still_needed
-                ):
-                    frags.append(cur)
-                    cur, acc = [], 0
-            frags.append(cur)
             # cross-peer-critical: every peer must derive the SAME n_frag
-            # non-empty fragments or the fragment all-reduces desync. A bare
-            # assert would vanish under `python -O`, so raise explicitly.
-            if len(frags) != n_frag or not all(frags):
-                raise ValueError(
-                    f"streaming-fragment partition produced "
-                    f"{sum(1 for f in frags if f)} non-empty of {len(frags)} "
-                    f"fragments, need exactly {n_frag} from "
-                    f"{len(leaf_sizes)} leaves"
-                )
-            self._fragments = frags
+            # non-empty fragments or the fragment all-reduces desync; the
+            # planner raises explicitly when it cannot (a bare assert
+            # would vanish under `python -O`)
+            self._fragments = planner.fragment_partition(leaf_sizes, n_frag)
         self.epoch = 0  # completed outer steps
         self.local_step = 0  # inner steps within current epoch
         self.samples_in_epoch = 0
@@ -1184,6 +1159,14 @@ class DiLoCoOptimizer:
             out["link_plan"] = health["link_plan"]
         if health.get("link_shares"):
             out["link_shares"] = list(health["link_shares"])
+        # hierarchical-round fields: which aggregators this round's plan
+        # elected. The chaos soak asserts aggregator re-election after a
+        # SIGKILL straight from these rows.
+        if health.get("hier"):
+            out["hier_plan"] = health["hier"].get("plan")
+            out["hier_aggregators"] = list(
+                health["hier"].get("aggregators", [])
+            )
         return out
 
     def _check_group_size(self, group_size: int) -> None:

@@ -30,6 +30,28 @@ FRAME_HDR = struct.Struct(FRAME_HDR_FMT)
 FRAME_HDR_SIZE = 8  # must equal struct.calcsize(FRAME_HDR_FMT); pass-checked
 MAX_HEADER = 16 * 1024 * 1024
 
+# Logical frame-meta version. v1: flat butterfly push/result frames. v2:
+# adds the two-level hierarchical round — stage-suffixed round keys (see
+# HIER_STAGES), aggregator-handoff frames, and a plan hash that covers the
+# full topology. v2 frames are only emitted inside hierarchical rounds
+# (meta["v"] = WIRE_VERSION, checked on receive); flat rounds stay
+# byte-identical to v1, so a mixed swarm that never arms ODTP_HIER
+# interoperates unchanged. The version is folded into the hierarchical
+# plan-hash preimage, so hier frames from a future v3 fail the plan check
+# even before the explicit version compare.
+
+WIRE_VERSION = 2
+WIRE_VERSION_META_KEY = "v"
+
+# The hierarchical round's stages, in wire order. Each stage's frames ride
+# the same push/result machinery under a stage-suffixed round key
+# ("<round_key>/<stage>"), so mailbox routing needs no new fields:
+#   intra    intra-site reduce-scatter (raw f32 partial sums, codec none)
+#   handoff  members ship their site-summed slice to the site aggregator
+#   wan      aggregators-only butterfly (configured codec + error feedback)
+#   bcast    aggregator broadcasts the averaged flat buffer to its site
+HIER_STAGES = ("intra", "handoff", "wan", "bcast")
+
 # single-byte acknowledgement closing every bulk frame exchange
 BULK_ACK = b"\x01"
 

@@ -24,7 +24,6 @@ from __future__ import annotations
 
 import asyncio
 import contextvars
-import hashlib
 import os
 import threading
 import time
@@ -34,7 +33,7 @@ from typing import Any, Callable, Optional
 import numpy as np
 
 from opendiloco_tpu import obs
-from opendiloco_tpu.diloco import chaos, linkstate
+from opendiloco_tpu.diloco import chaos, linkstate, planner
 from opendiloco_tpu.diloco.backend import AllReduceError, OuterBackend, PeerProgress
 from opendiloco_tpu.diloco.compression import (
     Codec,
@@ -42,6 +41,7 @@ from opendiloco_tpu.diloco.compression import (
     get_codec,
     record_wire,
 )
+from opendiloco_tpu.diloco.schema import WIRE_VERSION, WIRE_VERSION_META_KEY
 from opendiloco_tpu.diloco.wire import (
     STREAM_LIMIT,
     WireError,
@@ -301,6 +301,10 @@ class TcpBackend(OuterBackend):
         # it, the first worker to fail over registers alone, the daemon sees
         # a one-peer swarm, and matchmaking closes rounds as solo groups
         self._peers_view: dict[str, dict] = {}
+        # peer_id -> site index from the latest round plan, for WAN/intra
+        # byte accounting. None (no topology view) counts every frame as
+        # WAN — the honest reading for a flat swarm of unknown shape.
+        self._round_site_of: Optional[dict[str, int]] = None
         # mailbox: (round, kind, sender_or_part) -> (meta, payload)
         self._mailbox: dict[tuple, tuple[dict, bytes]] = {}
         self._mailbox_cv: Optional[asyncio.Condition] = None
@@ -893,6 +897,36 @@ class TcpBackend(OuterBackend):
                 return None  # transient: don't cache failure
         return self._bulk_ports[key]
 
+    def _is_wan_peer(self, peer_id: Optional[str]) -> bool:
+        """Does a frame to/from this peer cross the WAN, for byte
+        accounting? With a topology view (planner site map), a different
+        site means WAN; without one every link conservatively counts as
+        WAN — a flat swarm of unknown shape can't claim intra-site bytes."""
+        site_of = self._round_site_of
+        if not site_of or not peer_id:
+            return True
+        mine = site_of.get(self._peer_id)
+        theirs = site_of.get(peer_id)
+        if mine is None or theirs is None:
+            return True
+        return mine != theirs
+
+    async def _wan_throttle(self, peer_id: Optional[str], nbytes: int) -> None:
+        """Chaos-plane WAN shaping: frames to wan_peers-classified
+        destinations drain the per-process WAN token bucket (emulating a
+        shared site uplink) before dispatch on either data plane. A no-op
+        unless the chaos spec arms both wan_bps and wan_peers."""
+        if not peer_id or not nbytes:
+            return
+        cp = chaos.plane()
+        if cp is None or not cp.is_wan_peer(peer_id):
+            return
+        from opendiloco_tpu.diloco.bulk import wan_bucket
+
+        bucket = wan_bucket()
+        if bucket is not None:
+            await self._loop.run_in_executor(None, bucket.acquire, nbytes)
+
     async def _send_part(
         self, host: str, port: int, msg: str, meta: dict, payload, *,
         timeout: float, peer_id: Optional[str] = None,
@@ -915,6 +949,8 @@ class TcpBackend(OuterBackend):
             tr = obs.tracer()
             if tr is not None:
                 tr.count("wire_tx_bytes", nbytes)
+                if self._is_wan_peer(peer_id):
+                    tr.count("wire_tx_bytes_wan", nbytes)
 
     async def _send_part_inner(
         self, host: str, port: int, msg: str, meta: dict, payload, *,
@@ -928,6 +964,10 @@ class TcpBackend(OuterBackend):
         nbytes = payload.nbytes if hasattr(payload, "nbytes") else len(payload)
         adaptive = peer_id is not None and self._adaptive()
         t_send = time.monotonic() if adaptive else 0.0
+        # WAN shaping drains BEFORE plane selection so bulk and RPC frames
+        # pay the same emulated cross-site toll (the egress bucket below
+        # stays the per-worker NIC cap; this one is the site uplink)
+        await self._wan_throttle(peer_id, nbytes)
         if self._bulk_sender is not None and nbytes >= self._bulk_threshold:
             bulk_port = await self._bulk_port_of(host, port)
             if bulk_port:
@@ -1022,6 +1062,8 @@ class TcpBackend(OuterBackend):
                 payload.nbytes if hasattr(payload, "nbytes") else len(payload)
             )
             tr.count("wire_rx_bytes", nbytes)
+            if self._is_wan_peer(meta.get("from")):
+                tr.count("wire_rx_bytes_wan", nbytes)
         return meta, payload
 
     async def _wait_mailbox_inner(
@@ -1173,6 +1215,9 @@ class TcpBackend(OuterBackend):
             if attempt:
                 tr.count("outer_round_retries", attempt)
             tr.gauge("outer_group_size", n)
+            if extra and "hier" in extra:
+                tr.count("outer_rounds_hier")
+                tr.gauge("hier_sites", len(extra["hier"].get("sites", [])))
             if extra and "link_shares" in extra:
                 tr.count("outer_rounds_adaptive")
                 own = self.links.publish().get("peers", {})
@@ -1332,10 +1377,7 @@ class TcpBackend(OuterBackend):
             return [a.copy() for a in arrays], 1
         # fingerprint the membership: retried rounds (same join_key) must not
         # consume stale mailbox traffic from a differently-shaped group
-        fp = hashlib.sha1(
-            ",".join(p["peer_id"] for p in group).encode()
-        ).hexdigest()[:8]
-        round_key = f"{join_key}:{fp}"
+        round_key = f"{join_key}:{planner.group_fingerprint(group)}"
 
         timings["matchmake_s"] = time.monotonic() - t_mm
         if tr is not None:
@@ -1369,18 +1411,13 @@ class TcpBackend(OuterBackend):
             flat = self._checkout_buf(sum(f.size for f in flats))
             scratch.append(flat)
             np.concatenate(flats, out=flat)
-        bounds = linkstate.plan_bounds(flat.size, group) if adaptive else None
-        plan_meta: dict = {}
-        health_extra: Optional[dict] = None
-        if bounds is None:
-            bounds = np.linspace(0, flat.size, n + 1).astype(np.int64)
-        if adaptive:
-            plan_meta = {"plan": linkstate.plan_hash(bounds)}
-            health_extra = {
-                "link_plan": plan_meta["plan"],
-                "link_shares": linkstate.shares_of(bounds, flat.size),
-            }
-        parts = [flat[bounds[j] : bounds[j + 1]] for j in range(n)]
+        # planning (flat bounds, site clustering, aggregator election) is
+        # pure and snapshot-only — every member derives the identical plan
+        rp = planner.plan_round(group, int(flat.size), adaptive=adaptive)
+        bounds = rp.bounds
+        plan_meta = rp.plan_meta
+        health_extra: Optional[dict] = dict(rp.health) or None
+        self._round_site_of = rp.site_of
         timings["flatten_s"] = time.monotonic() - t_ph
         if tr is not None:
             tr.add_span(
@@ -1389,21 +1426,43 @@ class TcpBackend(OuterBackend):
                 time.perf_counter(),
                 round=join_key,
             )
+        wan_tx0 = (
+            tr.counters().get(("wire_tx_bytes_wan", ()), 0.0)
+            if tr is not None else 0.0
+        )
 
-        # 3-5. exchange: chunk-pipelined by default (encode chunk k+1 while
-        # chunk k is on the wire, decode-accumulate as chunks land), serial
-        # whole-part path behind ODTP_PIPELINE=0. Both produce bit-identical
-        # flat_avg buffers (the parity test in tests/test_bulk_pipeline.py
-        # holds the pipelined path to the serial result).
-        exchange = (
-            self._exchange_pipelined
-            if _pipeline_enabled()
-            else self._exchange_serial
-        )
-        flat_avg = await exchange(
-            group, my_idx, n, parts, bounds, flat.size, round_key, deadline,
-            scratch, timings, plan_meta,
-        )
+        # 3-5. exchange. Hierarchical (two-level) when the planner produced
+        # a multi-site plan; otherwise chunk-pipelined by default (encode
+        # chunk k+1 while chunk k is on the wire, decode-accumulate as
+        # chunks land), serial whole-part path behind ODTP_PIPELINE=0. The
+        # flat paths produce bit-identical flat_avg buffers (the parity
+        # test in tests/test_bulk_pipeline.py holds the pipelined path to
+        # the serial result); the hier path matches them bitwise for
+        # codec=none whenever sums are exactly representable (see
+        # _exchange_hier).
+        if rp.hier is not None:
+            flat_avg = await self._exchange_hier(
+                group, my_idx, n, flat, rp, round_key, deadline, scratch,
+                timings,
+            )
+        else:
+            parts = [flat[bounds[j] : bounds[j + 1]] for j in range(n)]
+            exchange = (
+                self._exchange_pipelined
+                if _pipeline_enabled()
+                else self._exchange_serial
+            )
+            flat_avg = await exchange(
+                group, my_idx, n, parts, bounds, flat.size, round_key,
+                deadline, scratch, timings, plan_meta,
+            )
+        if tr is not None:
+            # per-round WAN egress as a gauge (the counter is cumulative);
+            # obs_report surfaces the intra/WAN split from these
+            tr.gauge(
+                "wire_bytes_wan",
+                tr.counters().get(("wire_tx_bytes_wan", ()), 0.0) - wan_tx0,
+            )
         stage = _OBS_STAGE.get()
         if stage is not None:
             # fold fine-grained stage wall-clock (encode / wire_send /
@@ -1603,6 +1662,312 @@ class TcpBackend(OuterBackend):
             )
         return flat_avg
 
+    @staticmethod
+    def _check_hier_frame(meta: dict, my_plan: Optional[str]) -> None:
+        """Every hierarchical frame carries the v2 wire version and the
+        topology-covering plan hash; a peer that disagrees about either is
+        running a different round shape and must fail loudly, not fold
+        misaligned bytes."""
+        v = int(meta.get(WIRE_VERSION_META_KEY, 0) or 0)
+        if v != WIRE_VERSION:
+            raise WireError(
+                f"hier frame wire version {v}, expected {WIRE_VERSION}"
+            )
+        check_plan(meta, my_plan)
+
+    async def _exchange_hier(
+        self, group, my_idx, n, flat, rp, round_key, deadline, scratch,
+        timings,
+    ):
+        """Two-level exchange (ODTP_HIER): intra-site reduce-scatter of raw
+        f32 partial sums over the fat links, a member->aggregator handoff
+        of the site-summed slices, an aggregators-only butterfly across the
+        WAN with the configured codec, and an intra-site broadcast of the
+        averaged buffer. Per-stage frames ride the ordinary push/result
+        machinery under stage-suffixed round keys (schema.HIER_STAGES).
+
+        Bit-parity contract: contributions fold in canonical orders only —
+        site members in group order inside each site, sites in site order
+        on the WAN leg — and the 1/n scale (n = TOTAL contributors) runs
+        exactly once, on the aggregators, after the full cross-site fold.
+        codec=none rounds with exactly-representable sums are therefore
+        bit-identical to the flat butterfly under ANY site assignment, and
+        every member adopts its aggregator's broadcast bytes verbatim (the
+        encode-once/adopt-decoded discipline of the flat path, lifted to
+        sites), so lossy WAN codecs still yield one identical buffer
+        everywhere."""
+        from opendiloco_tpu import native as _native
+        from opendiloco_tpu.diloco.bulk import release_buffer
+
+        hp = rp.hier
+        tr = obs.tracer()
+        raw = get_codec("none")
+        site_idx = hp.site_of[self._peer_id]
+        site = hp.sites[site_idx]  # group indices, group order
+        li = site.index(my_idx)  # my site-local index
+        m = len(site)
+        agg_idx = hp.aggregators[site_idx]
+        is_agg = agg_idx == my_idx
+        ib = hp.intra_bounds[site_idx]
+        plan_meta = {**rp.plan_meta, WIRE_VERSION_META_KEY: WIRE_VERSION}
+        my_plan = plan_meta.get("plan")
+
+        def _timeout() -> float:
+            return max(5.0, deadline - time.monotonic())
+
+        def _meta(stage_key: str, **extra) -> dict:
+            return {
+                "round": f"{round_key}/{stage_key}",
+                "from": self._peer_id,
+                **extra,
+                **plan_meta,
+            }
+
+        # -- stage A: intra-site reduce-scatter (raw f32, fat links) ------
+        async def push_intra(k: int):
+            j = site[k]
+            part = flat[ib[k] : ib[k + 1]]
+            payload, cmeta = raw.encode(part)
+            record_wire("none", part.size * 4, len(payload))
+            await self._send_part(
+                group[j]["host"], group[j]["port"], "push",
+                _meta("intra", meta=cmeta, shape=[int(part.size)]),
+                payload, timeout=_timeout(), peer_id=group[j]["peer_id"],
+            )
+
+        async def collect_intra():
+            acc = self._checkout_buf(int(ib[li + 1] - ib[li]))
+            scratch.append(acc)
+            first = True
+            for k in range(m):  # site members in group order
+                if site[k] == my_idx:
+                    src = flat[ib[li] : ib[li + 1]]
+                    if first:
+                        np.copyto(acc, src)
+                    else:
+                        _native.add_inplace(acc, src)
+                    first = False
+                    continue
+                pid = group[site[k]]["peer_id"]
+                pmeta, payload = await self._wait_mailbox(
+                    (f"{round_key}/intra", "push", pid), deadline
+                )
+                self._check_hier_frame(pmeta, my_plan)
+                if first:
+                    raw.decode_into(payload, pmeta["meta"], acc)
+                else:
+                    raw.decode_accumulate(payload, pmeta["meta"], acc)
+                first = False
+                release_buffer(payload)
+            return acc
+
+        t_ph = time.monotonic()
+        t_ph_p = time.perf_counter()
+        results = await asyncio.gather(
+            collect_intra(), *[push_intra(k) for k in range(m) if site[k] != my_idx]
+        )
+        site_acc = results[0]  # my slice of the site's UNSCALED sum
+        timings["intra_reduce_s"] = time.monotonic() - t_ph
+        if tr is not None:
+            tr.add_span(
+                "outer/hier_intra", t_ph_p, time.perf_counter(),
+                round=round_key, group=m, site=site_idx,
+            )
+
+        # -- stage A2: handoff — aggregator assembles the full site sum ---
+        t_ph = time.monotonic()
+        t_ph_p = time.perf_counter()
+        site_sum = None
+        if is_agg:
+            site_sum = self._checkout_buf(int(flat.size))
+            scratch.append(site_sum)
+            np.copyto(site_sum[ib[li] : ib[li + 1]], site_acc)
+            for k in range(m):
+                if site[k] == my_idx:
+                    continue
+                pid = group[site[k]]["peer_id"]
+                pmeta, payload = await self._wait_mailbox(
+                    (f"{round_key}/handoff", "push", pid), deadline
+                )
+                self._check_hier_frame(pmeta, my_plan)
+                dst = site_sum[ib[k] : ib[k + 1]]
+                if int(pmeta["shape"][0]) != dst.size:
+                    raise WireError(
+                        f"handoff slice {k}: peer claims {pmeta['shape']} "
+                        f"elements, expected {dst.size}"
+                    )
+                raw.decode_into(payload, pmeta["meta"], dst)
+                release_buffer(payload)
+        else:
+            payload, cmeta = raw.encode(site_acc)
+            record_wire("none", site_acc.size * 4, len(payload))
+            await self._send_part(
+                group[agg_idx]["host"], group[agg_idx]["port"], "push",
+                _meta("handoff", meta=cmeta, shape=[int(site_acc.size)]),
+                payload, timeout=_timeout(),
+                peer_id=group[agg_idx]["peer_id"],
+            )
+        timings["handoff_s"] = time.monotonic() - t_ph
+        if tr is not None:
+            tr.add_span(
+                "outer/hier_handoff", t_ph_p, time.perf_counter(),
+                round=round_key, group=m, site=site_idx,
+            )
+
+        # the caller gets views of flat_avg, so it retires instead of
+        # joining scratch (same lifetime contract as the flat paths)
+        flat_avg = self._checkout_buf(int(flat.size))
+        self._retire_buf(round_key, flat_avg)
+
+        # -- stage B: aggregators-only WAN butterfly (configured codec) ---
+        t_ph = time.monotonic()
+        t_ph_p = time.perf_counter()
+        if is_agg:
+            s = hp.n_sites
+            wb = hp.wan_bounds
+            aggs = hp.aggregators
+            codec = self.codec
+            stage = _OBS_STAGE.get()
+            encode = (
+                stage.timed("encode", codec.encode) if stage else codec.encode
+            )
+            dec_acc = (
+                stage.timed("accumulate", codec.decode_accumulate)
+                if stage else codec.decode_accumulate
+            )
+            dec_into = (
+                stage.timed("accumulate", codec.decode_into)
+                if stage else codec.decode_into
+            )
+
+            async def push_wan(t: int):
+                j = aggs[t]
+                part = site_sum[wb[t] : wb[t + 1]]
+                payload, cmeta = encode(part)
+                record_wire(codec.name, part.size * 4, len(payload))
+                await self._send_part(
+                    group[j]["host"], group[j]["port"], "push",
+                    _meta("wan", meta=cmeta, shape=[int(part.size)]),
+                    payload, timeout=_timeout(), peer_id=group[j]["peer_id"],
+                )
+
+            async def collect_wan():
+                acc = self._checkout_buf(int(wb[site_idx + 1] - wb[site_idx]))
+                scratch.append(acc)
+                first = True
+                for t in range(s):  # sites in site order
+                    if aggs[t] == my_idx:
+                        src = site_sum[wb[site_idx] : wb[site_idx + 1]]
+                        if first:
+                            np.copyto(acc, src)
+                        else:
+                            _native.add_inplace(acc, src)
+                        first = False
+                        continue
+                    pid = group[aggs[t]]["peer_id"]
+                    pmeta, payload = await self._wait_mailbox(
+                        (f"{round_key}/wan", "push", pid), deadline
+                    )
+                    self._check_hier_frame(pmeta, my_plan)
+                    if first:
+                        dec_into(payload, pmeta["meta"], acc)
+                    else:
+                        dec_acc(payload, pmeta["meta"], acc)
+                    first = False
+                    release_buffer(payload)
+                # the single global scale: site sums were never divided
+                _native.scale_inplace(acc, 1.0 / n)
+                return acc
+
+            results = await asyncio.gather(
+                collect_wan(),
+                *[push_wan(t) for t in range(s) if aggs[t] != my_idx],
+            )
+            wan_avg = results[0]
+
+            # fan the averaged WAN part back out, encoded ONCE; adopt the
+            # decoded wire value for our own part (flat path's invariant)
+            result_payload, result_cmeta = encode(wan_avg)
+
+            async def send_wan_result(t: int):
+                j = aggs[t]
+                await self._send_part(
+                    group[j]["host"], group[j]["port"], "result",
+                    _meta(
+                        "wan", part=site_idx, meta=result_cmeta,
+                        shape=[int(wan_avg.size)],
+                    ),
+                    result_payload, timeout=_timeout(),
+                    peer_id=group[j]["peer_id"],
+                )
+
+            async def recv_wan_results():
+                dec_into(
+                    result_payload, result_cmeta,
+                    flat_avg[wb[site_idx] : wb[site_idx + 1]],
+                )
+                for t in range(s):
+                    if aggs[t] == my_idx:
+                        continue
+                    rmeta, payload = await self._wait_mailbox(
+                        (f"{round_key}/wan", "result", t), deadline
+                    )
+                    self._check_hier_frame(rmeta, my_plan)
+                    dst = flat_avg[wb[t] : wb[t + 1]]
+                    if int(rmeta["shape"][0]) != dst.size:
+                        raise WireError(
+                            f"wan result {t}: peer claims {rmeta['shape']} "
+                            f"elements, expected {dst.size}"
+                        )
+                    dec_into(payload, rmeta["meta"], dst)
+                    release_buffer(payload)
+
+            await asyncio.gather(
+                recv_wan_results(),
+                *[send_wan_result(t) for t in range(s) if aggs[t] != my_idx],
+            )
+        timings["wan_reduce_s"] = time.monotonic() - t_ph
+        if tr is not None:
+            tr.add_span(
+                "outer/hier_wan", t_ph_p, time.perf_counter(),
+                round=round_key, group=hp.n_sites, site=site_idx,
+            )
+
+        # -- stage C: intra-site broadcast of the averaged buffer ---------
+        t_ph = time.monotonic()
+        t_ph_p = time.perf_counter()
+        if is_agg:
+            payload, cmeta = raw.encode(flat_avg)
+            record_wire("none", flat_avg.size * 4, len(payload))
+            await asyncio.gather(*[
+                self._send_part(
+                    group[j]["host"], group[j]["port"], "result",
+                    _meta("bcast", part=0, meta=cmeta,
+                          shape=[int(flat_avg.size)]),
+                    payload, timeout=_timeout(), peer_id=group[j]["peer_id"],
+                )
+                for j in site if j != my_idx
+            ])
+        else:
+            rmeta, payload = await self._wait_mailbox(
+                (f"{round_key}/bcast", "result", 0), deadline
+            )
+            self._check_hier_frame(rmeta, my_plan)
+            if int(rmeta["shape"][0]) != flat_avg.size:
+                raise WireError(
+                    f"bcast: aggregator claims {rmeta['shape']} elements, "
+                    f"expected {flat_avg.size}"
+                )
+            raw.decode_into(payload, rmeta["meta"], flat_avg)
+            release_buffer(payload)
+        timings["bcast_s"] = time.monotonic() - t_ph
+        if tr is not None:
+            tr.add_span(
+                "outer/hier_bcast", t_ph_p, time.perf_counter(),
+                round=round_key, group=m, site=site_idx,
+            )
+        return flat_avg
+
     def _chunk_sender(self, dest: dict, deadline: float):
         """Per-destination chunk transport for the pipelined exchange.
 
@@ -1639,6 +2004,9 @@ class TcpBackend(OuterBackend):
                         )
             if state["stream"] is not None:
                 try:
+                    # the RPC fallback below throttles inside
+                    # _send_part_inner; only the stream path pays here
+                    await self._wan_throttle(dest.get("peer_id"), nbytes)
                     stage = _OBS_STAGE.get()
                     t0 = time.perf_counter()
                     await loop.run_in_executor(
@@ -1653,6 +2021,8 @@ class TcpBackend(OuterBackend):
                         tr = obs.tracer()
                         if tr is not None:
                             tr.count("wire_tx_bytes", nbytes)
+                            if self._is_wan_peer(dest.get("peer_id")):
+                                tr.count("wire_tx_bytes_wan", nbytes)
                     return
                 except Exception as e:
                     # the stream poisoned itself and dropped the pooled

@@ -155,6 +155,7 @@ def train(config: Config, backend: Optional[OuterBackend] = None) -> dict:
         )
     loader = get_dataloader(
         fake_data=config.fake_data,
+        fake_data_mode=config.fake_data_mode,
         dataset_name_or_paths=config.dataset_name_or_paths,
         tokenizer_name=config.tokenizer_name,
         seq_length=config.seq_length,
@@ -247,6 +248,7 @@ def train(config: Config, backend: Optional[OuterBackend] = None) -> dict:
     if config.eval_interval:
         eval_loader = get_dataloader(
             fake_data=config.fake_data,
+            fake_data_mode=config.fake_data_mode,
             dataset_name_or_paths=config.dataset_name_or_paths,
             tokenizer_name=config.tokenizer_name,
             seq_length=config.seq_length,
@@ -323,7 +325,11 @@ def train(config: Config, backend: Optional[OuterBackend] = None) -> dict:
         if diloco_opt is not None:
             row["num_peers"] = diloco_opt.max_num_peers
             row["outer_epoch"] = diloco_opt.epoch
-            for k in ("outer_step_s", "outer_allreduce_s", "outer_wait_s"):
+            # round-health fields ride along so the chaos soak can read
+            # elastic rescale and aggregator re-election from the rows
+            for k in ("outer_step_s", "outer_allreduce_s", "outer_wait_s",
+                      "elastic", "expected_peers", "round_retries",
+                      "hier_plan", "hier_aggregators"):
                 if k in metrics:
                     row[k] = metrics[k]
         row.update(extras)

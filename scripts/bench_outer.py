@@ -64,6 +64,12 @@ _STREAM_OUT = os.environ.get("ODTP_STREAM_BENCH_OUT") or os.path.join(
 _COMPRESS_OUT = os.environ.get("ODTP_COMPRESS_BENCH_OUT") or os.path.join(
     REPO, "COMPRESS_BENCH.json"
 )
+# --hier mode banks here: flat butterfly vs two-level hierarchical reduce on
+# an emulated 2-site galaxy (chaos wan_bps/wan_peers uplink shaping), the
+# artifact the topology planner (ODTP_HIER) is judged against
+_HIER_OUT = os.environ.get("ODTP_HIER_BENCH_OUT") or os.path.join(
+    REPO, "HIER_BENCH.json"
+)
 
 
 def expected_group(peers: int, group_cap: int) -> int:
@@ -342,6 +348,17 @@ def worker_main() -> None:
     for k in ("link_plan", "link_shares"):
         if lrh.get(k) is not None:
             health[k] = lrh[k]
+    # cumulative wire byte counters, WAN split included: the hier bench
+    # sums these across workers and gates on the flat/hier WAN ratio (both
+    # arms run the same round structure, so the ratio needs no per-round
+    # normalization)
+    for name in (
+        "wire_tx_bytes", "wire_rx_bytes",
+        "wire_tx_bytes_wan", "wire_rx_bytes_wan",
+    ):
+        health[name] = ctr(name)
+    if lrh.get("hier") is not None:
+        health["hier"] = lrh["hier"]
     faults = {
         dict(labels).get("kind", "?"): int(v)
         for (name, labels), v in snap["counters"].items()
@@ -734,6 +751,218 @@ def hetero_main(args) -> None:
     if not args.selftest and speedup < 1.2:
         raise SystemExit(
             f"hetero speedup {speedup:.2f}x below the 1.2x acceptance line"
+        )
+
+
+def _hier_galaxy(peers: int) -> tuple[list[list[int]], list[int], str, str]:
+    """The emulated 2-site galaxy layout for ``peers`` workers: ranks split
+    into two equal sites, rank 0 of each half is the preferred aggregator.
+    Returns (sites, aggregator ranks, ODTP_SITES spec, ODTP_HIER_AGG spec)
+    over the bench's ``bench-N`` peer ids."""
+    half = peers // 2
+    sites = [list(range(half)), list(range(half, peers))]
+    agg_ranks = [s[0] for s in sites]
+    site_spec = ";".join(
+        "|".join(f"bench-{r}" for r in s) for s in sites
+    )
+    agg_spec = "|".join(f"bench-{r}" for r in agg_ranks)
+    return sites, agg_ranks, site_spec, agg_spec
+
+
+def _hier_sweep(
+    args, server, hier: bool, nic_bps: float, agg_wan_bps: float,
+    member_wan_bps: float, warm: int, rounds: int, base_env: dict,
+) -> tuple[list, list]:
+    """One flat-or-hierarchical pass over the emulated 2-site galaxy.
+
+    Every worker's NIC is token-bucketed at ``nic_bps``; frames to the
+    OTHER site additionally drain a per-worker WAN bucket (chaos
+    wan_bps/wan_peers) — fat for the two aggregator ranks, thin for the
+    rest, the clusters-of-clusters shape where only the site uplink hosts
+    have real WAN bandwidth. Both arms run with ODTP_SITES set so the
+    flat arm's WAN byte accounting is topology-aware too; only ODTP_HIER
+    differs. Returns (per-round seconds after ``warm`` learning rounds,
+    ALL workers' HEALTH dicts — WAN bytes must sum over every worker)."""
+    sites, agg_ranks, site_spec, agg_spec = _hier_galaxy(args.peers)
+    nbytes = sum(a.nbytes for a in make_leaves(args.model, 0))
+    round_timeout = max(60.0, 20.0 * nbytes * 2 / member_wan_bps)
+    procs = []
+    for i in range(args.peers):
+        env = dict(base_env)
+        env["ODTP_BULK_BANDWIDTH_BPS"] = str(int(nic_bps))
+        env["ODTP_LINK_ADAPT"] = "0"
+        env["ODTP_HIER"] = "1" if hier else "0"
+        env["ODTP_SITES"] = site_spec
+        env["ODTP_HIER_AGG"] = agg_spec
+        other = next(s for s in sites if i not in s)
+        wan_bps = agg_wan_bps if i in agg_ranks else member_wan_bps
+        env["ODTP_CHAOS"] = (
+            f"wan_bps={int(wan_bps)};wan_peers="
+            + "|".join(f"bench-{r}" for r in other)
+        )
+        procs.append(subprocess.Popen(
+            [
+                sys.executable, os.path.abspath(__file__), "--worker",
+                "--rendezvous", server.address, "--rank", str(i),
+                "--model", args.model, "--compression", "none",
+                "--rounds", str(warm + rounds),
+                "--peers", str(args.peers),
+                "--timeout", str(round_timeout),
+                "--sweep-start", str(time.time()),
+                "--group-cap", "0", "--pipeline", "1",
+            ],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env,
+        ))
+    proc_timeout = (warm + rounds + 2) * round_timeout + 120.0
+    try:
+        outs = [p.communicate(timeout=proc_timeout)[0] for p in procs]
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        for p in procs:
+            try:
+                p.communicate(timeout=10)
+            except Exception:
+                pass
+        raise SystemExit(f"hier sweep (hier={hier}) timed out")
+    if any(p.returncode for p in procs):
+        detail = [" | ".join(o.splitlines()[-3:])[-400:] for o in outs]
+        raise SystemExit(f"hier sweep (hier={hier}) worker failure: {detail}")
+    line = next(
+        l for o in outs for l in o.splitlines() if l.startswith("RESULT")
+    )
+    times = [float(x) for x in line.split()[1:] if "=" not in x]
+    healths = [
+        json.loads(l.split(None, 1)[1])
+        for o in outs for l in o.splitlines()
+        if l.startswith("HEALTH ")
+    ]
+    return times[warm:], healths
+
+
+def hier_main(args) -> None:
+    """Hierarchical galaxy A/B: the same emulated 2-site topology (fat
+    intra-site links, thin per-worker WAN uplinks, fat uplinks only on the
+    two aggregator hosts), flat butterfly vs the planner's two-level round
+    (ODTP_HIER). Banks HIER_BENCH.json with both arms' medians, the summed
+    WAN egress, and the reduction ratio; the full run exits nonzero below
+    the 3x WAN-reduction acceptance line or if the round time regressed.
+
+    The arithmetic the two-level round exploits: flat, every worker ships
+    its slices for all cross-site owners plus its fan-back part over the
+    WAN (group total ~= the full payload per site per DIRECTION twice);
+    hierarchical, only the two aggregators touch the WAN, exchanging one
+    site-summed butterfly = ~2/S of the payload each way at S sites — a
+    ~peers/2-per-site galaxy cuts WAN bytes ~(peers/sites)x (4x at 2x4),
+    and routing them over the fat aggregator uplinks wins the round time
+    too."""
+    from opendiloco_tpu.diloco.rendezvous import RendezvousServer
+
+    if args.selftest:
+        args.peers, args.model, rounds, warm = 4, "tiny:8", 2, 1
+        nic_bps, agg_wan, member_wan = 64e6, 16e6, 4e6
+        out_path = os.environ.get("ODTP_HIER_BENCH_OUT") or os.path.join(
+            os.environ.get("TMPDIR", "/tmp"), "HIER_BENCH.selftest.json"
+        )
+        # a 2x2 galaxy's theoretical WAN cut is only 2x (n/sites); gate
+        # leniently — the selftest checks the machinery, not the headline
+        wan_floor = 1.5
+    else:
+        args.peers, args.model = 8, "tiny:32"
+        rounds, warm = max(args.rounds, 3), 1
+        nic_bps, agg_wan, member_wan = 64e6, 8e6, 2e6
+        out_path = _HIER_OUT
+        wan_floor = 3.0
+    sites, agg_ranks, site_spec, _ = _hier_galaxy(args.peers)
+    nbytes = sum(a.nbytes for a in make_leaves(args.model, 0))
+    # warmup + learning + measured: every worker runs this many all-reduce
+    # rounds, so cumulative WAN counters normalize to per-round by it
+    total_rounds = 1 + warm + rounds
+    print(
+        f"hier bench: {args.peers} peers in 2 sites {sites}, "
+        f"{nbytes / 1e6:.0f} MB fp32, NIC {nic_bps * 8 / 1e6:.0f} Mbps, WAN "
+        f"{agg_wan * 8 / 1e6:.0f} Mbps (aggregators bench-"
+        f"{'/'.join(str(r) for r in agg_ranks)}) / "
+        f"{member_wan * 8 / 1e6:.0f} Mbps (members), {rounds} measured "
+        f"rounds (+{warm} learning)"
+    )
+    base_env = dict(os.environ)
+    base_env["PYTHONPATH"] = REPO + os.pathsep + base_env.get("PYTHONPATH", "")
+    base_env.setdefault("OPENDILOCO_TPU_PLATFORM", "cpu")
+
+    results = {}
+    server = RendezvousServer(host="127.0.0.1", port=0).start_in_thread()
+    try:
+        for hier in (False, True):
+            mode = "hier" if hier else "flat"
+            times, healths = _hier_sweep(
+                args, server, hier, nic_bps, agg_wan, member_wan, warm,
+                rounds, base_env,
+            )
+            wan_tx = sum(h.get("wire_tx_bytes_wan", 0) for h in healths)
+            tx = sum(h.get("wire_tx_bytes", 0) for h in healths)
+            results[mode] = {
+                "rounds_s": [round(t, 3) for t in times],
+                "median_s": round(statistics.median(times), 3),
+                "best_s": round(min(times), 3),
+                "wan_tx_bytes": wan_tx,
+                "tx_bytes": tx,
+                "wan_bytes_per_round": round(wan_tx / total_rounds),
+            }
+            hp = next((h["hier"] for h in healths if "hier" in h), None)
+            if hp:
+                results[mode]["plan"] = hp
+            print(
+                f"{mode:>5}: median {results[mode]['median_s'] * 1e3:7.0f} "
+                f"ms/round  WAN {wan_tx / total_rounds / 1e6:7.1f} MB/round "
+                f"({wan_tx / max(tx, 1) * 100:.0f}% of egress)"
+            )
+    finally:
+        server.stop()
+
+    wan_reduction = round(
+        results["flat"]["wan_tx_bytes"]
+        / max(results["hier"]["wan_tx_bytes"], 1),
+        3,
+    )
+    speedup = round(
+        results["flat"]["median_s"] / results["hier"]["median_s"], 3
+    )
+    doc = {
+        "bench": "hier",
+        "peers": args.peers,
+        "sites": 2,
+        "model": args.model,
+        "mb_fp32": round(nbytes / 1e6),
+        "nic_mbps": round(nic_bps * 8 / 1e6),
+        "wan_mbps_aggregator": round(agg_wan * 8 / 1e6),
+        "wan_mbps_member": round(member_wan * 8 / 1e6),
+        "selftest": bool(args.selftest),
+        "flat": results["flat"],
+        "hier": results["hier"],
+        "wan_reduction": wan_reduction,
+        "speedup": speedup,
+        "updated": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "host": {
+            "cores": os.cpu_count(), "loadavg": round(os.getloadavg()[0], 2)
+        },
+    }
+    with open(out_path, "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+        f.write("\n")
+    print(
+        f"WAN reduction {wan_reduction:.2f}x, round-time speedup "
+        f"{speedup:.2f}x (banked {out_path})"
+    )
+    if wan_reduction < wan_floor:
+        raise SystemExit(
+            f"hier WAN reduction {wan_reduction:.2f}x below the "
+            f"{wan_floor}x line"
+        )
+    if not args.selftest and speedup <= 1.0:
+        raise SystemExit(
+            f"hier round time regressed: speedup {speedup:.2f}x <= 1.0x"
         )
 
 
@@ -1168,9 +1397,16 @@ def main() -> None:
         "blockwise4bit/topk with error feedback; banks COMPRESS_BENCH.json",
     )
     ap.add_argument(
+        "--hier", action="store_true",
+        help="hierarchical galaxy A/B: flat butterfly vs the two-level "
+        "planner round (ODTP_HIER) on an emulated 2-site topology with "
+        "chaos wan_bps uplink shaping; banks HIER_BENCH.json",
+    )
+    ap.add_argument(
         "--selftest", action="store_true",
-        help="with --hetero/--stream/--compress: small/fast CI shape that "
-        "checks the loop works without asserting the speedup/overhead line",
+        help="with --hetero/--stream/--compress/--hier: small/fast CI "
+        "shape that checks the loop works without asserting the "
+        "speedup/overhead line",
     )
     args = ap.parse_args()
     if args.stream:
@@ -1181,6 +1417,9 @@ def main() -> None:
         return
     if args.compress:
         compress_main(args)
+        return
+    if args.hier:
+        hier_main(args)
         return
     if args.boundary:
         if os.environ.get("MALLOC_MMAP_THRESHOLD_") is None:

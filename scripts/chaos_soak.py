@@ -2,19 +2,26 @@
 """Chaos soak: an 8-worker DiLoCo galaxy trained under scripted fire.
 
 Real TCP data plane (one ``python -m opendiloco_tpu.train`` process per
-worker + one rendezvous daemon), 2m model on fake data, with the
-ODTP_CHAOS fault plane armed end to end:
+worker + one rendezvous daemon), 2m model on the learnable ramp stream
+(``--fake-data-mode ramp``: uniform-random fake data sits at its entropy
+floor, making a loss-descent gate a coin flip), with the ODTP_CHAOS
+fault plane armed end to end:
 
 - every worker injects random connection drops + RPC latency
   (``drop_conn``/``delay_ms``, per-rank seed so runs replay);
 - the rendezvous daemon blacks out mid-soak (``blackout_rdv``) and the
   workers must failover/backoff through it;
-- the parent SIGKILLs one worker mid-run and restarts it WITHOUT
+- the galaxy runs the HIERARCHICAL outer round (``ODTP_HIER=1``, two
+  explicit sites) with the SIGKILL target pinned as a preferred
+  aggregator (``ODTP_HIER_AGG``), so the kill lands on an elected
+  aggregator and the survivors must re-elect without a hang;
+- the parent SIGKILLs that worker mid-run and restarts it WITHOUT
   ``--diloco.skip-load-from-peers`` so the straggler re-onboards through
   the (fp16-compressed) fetch_state path.
 
 The soak passes iff every outer round completed (full or elastic), loss
-descended, and there are zero error rows. The verdict + per-worker
+descended, a replacement aggregator was elected while the killed one was
+down, and there are zero error rows. The verdict + per-worker
 round/fault accounting is banked to CHAOS_SOAK.json at the repo root:
 
     python scripts/chaos_soak.py [--workers 8] [--rounds 6] [--out ...]
@@ -38,13 +45,35 @@ WORKER_CHAOS = "seed={seed};drop_conn=0.05;delay_ms=5..30"
 DAEMON_CHAOS = "seed=99;blackout_rdv=r3;blackout_s=2.0"
 
 
-def worker_env(rank: int) -> dict:
+def hier_sites(workers: int) -> tuple[str, str]:
+    """Two-site galaxy over the train peer ids (``worker-<rank>``):
+    first half / second half, with the LAST rank of each site the
+    preferred aggregator -- so the soak's default SIGKILL target (the
+    last rank) is an elected aggregator and the kill exercises
+    re-election, not just elastic rescale."""
+    ids = [f"worker-{r}" for r in range(workers)]
+    half = max(1, workers // 2)
+    sites = [ids[:half], ids[half:]] if workers >= 2 else [ids]
+    site_spec = ";".join("|".join(s) for s in sites)
+    agg_spec = "|".join(s[-1] for s in sites)
+    return site_spec, agg_spec
+
+
+def worker_env(rank: int, workers: int) -> dict:
     env = dict(os.environ)
     env["OPENDILOCO_TPU_PLATFORM"] = "cpu"
     env["JAX_PLATFORMS"] = "cpu"
     env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     env["ODTP_CHAOS"] = WORKER_CHAOS.format(seed=7 + rank)
+    # close matchmaking on the full galaxy when everyone is alive, so
+    # elastic (partial) rounds appear exactly when a worker is down --
+    # which is what the re-election assertion below keys on
+    env["ODTP_EXPECT_PEERS"] = str(workers)
+    site_spec, agg_spec = hier_sites(workers)
+    env["ODTP_HIER"] = "1"
+    env["ODTP_SITES"] = site_spec
+    env["ODTP_HIER_AGG"] = agg_spec
     return env
 
 
@@ -74,6 +103,7 @@ def spawn_worker(
         sys.executable, "-m", "opendiloco_tpu.train",
         "--path-model", args.model,
         "--fake-data",
+        "--fake-data-mode", "ramp",
         "--seq-length", "64",
         "--per-device-train-batch-size", "4",
         "--total-batch-size", "32",
@@ -97,7 +127,7 @@ def spawn_worker(
         cli.append("--diloco.skip-load-from-peers")
     return subprocess.Popen(
         cli, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
-        env=worker_env(rank), cwd=REPO,
+        env=worker_env(rank, args.workers), cwd=REPO,
     )
 
 
@@ -148,8 +178,8 @@ def main() -> int:
         r: spawn_worker(r, address, logs[r], args, onboard=False)
         for r in range(args.workers)
     }
-    print(f"{args.workers} workers up; SIGKILL of rank {kill_rank} in "
-          f"{args.kill_after_s:.0f}s")
+    print(f"{args.workers} workers up; SIGKILL of rank {kill_rank} "
+          f"(preferred aggregator of its site) in {args.kill_after_s:.0f}s")
 
     time.sleep(args.kill_after_s)
     procs[kill_rank].send_signal(signal.SIGKILL)
@@ -208,6 +238,32 @@ def main() -> int:
             "faults": fault_counts(*(outs.get(r) or ("", ""))),
         })
 
+    # aggregator re-election: the metric rows carry the hier plan's
+    # aggregator list per landed round. While the killed rank was down,
+    # survivors must have elected a replacement (elastic rows without the
+    # kill peer); once it rejoined, the preferred-aggregator pin should
+    # win again (last full-group row has it back).
+    kill_peer = f"worker-{kill_rank}"
+    agg_rows: list[tuple[bool, list]] = []
+    for r in range(args.workers):
+        if r == kill_rank:
+            continue
+        for row in read_rows(logs[r]):
+            if row.get("hier_aggregators"):
+                agg_rows.append(
+                    (bool(row.get("elastic")), row["hier_aggregators"])
+                )
+    kill_was_aggregator = any(kill_peer in aggs for _, aggs in agg_rows)
+    reelected = any(
+        kill_peer not in aggs for el, aggs in agg_rows if el
+    )
+    last_aggs = next(
+        (row["hier_aggregators"]
+         for row in reversed(read_rows(logs[0]))
+         if row.get("hier_aggregators")), [],
+    )
+    aggregator_reelected = kill_was_aggregator and reelected
+
     ref = per_worker[0]
     rounds_completed = ref["final_outer_epoch"] or 0
     every_round_completed = (
@@ -227,6 +283,7 @@ def main() -> int:
     report = {
         "bench": "chaos_soak",
         "model": args.model,
+        "data": "fake ramp stream (learnable; loss gate is real descent)",
         "workers": args.workers,
         "rounds": args.rounds,
         "local_steps": args.local_steps,
@@ -236,9 +293,18 @@ def main() -> int:
             "daemon_spec": DAEMON_CHAOS,
             "sigkill": {"rank": kill_rank, "after_s": args.kill_after_s,
                         "restarted_with_onboarding": True},
+            "hier": {
+                "sites": hier_sites(args.workers)[0],
+                "preferred_aggregators": hier_sites(args.workers)[1],
+                "killed_peer": kill_peer,
+            },
         },
         "every_round_completed": every_round_completed,
         "loss_descended": loss_descended,
+        "aggregator_reelected": aggregator_reelected,
+        "kill_was_aggregator": kill_was_aggregator,
+        "final_aggregators": last_aggs,
+        "hier_rounds_observed": len(agg_rows),
         "error_rows": error_rows,
         "failures": fails,
         "daemon_faults": daemon_faults,
@@ -254,7 +320,7 @@ def main() -> int:
         json.dump(report, f, indent=2)
         f.write("\n")
     print(json.dumps(report, indent=2))
-    ok = every_round_completed and loss_descended
+    ok = every_round_completed and loss_descended and aggregator_reelected
     print("CHAOS SOAK " + ("PASSED" if ok else "FAILED"))
     return 0 if ok else 1
 

@@ -360,12 +360,34 @@ def merge_report(trace_dir: str) -> tuple[dict, dict]:
                 serve_counters.get("serve_spec_accepted", 0) / proposed, 4
             )
 
+    # WAN/intra byte split. The transport classifies every frame against the
+    # round's site map (no map -> everything is WAN, conservatively), so the
+    # hierarchical plane's headline -- WAN bytes cut vs total wire traffic --
+    # is measurable straight from the report, not just the bench artifact.
+    wan: dict = {}
+    tx = counters.get("wire_tx_bytes", 0.0)
+    rx = counters.get("wire_rx_bytes", 0.0)
+    if tx or rx:
+        tx_wan = counters.get("wire_tx_bytes_wan", 0.0)
+        rx_wan = counters.get("wire_rx_bytes_wan", 0.0)
+        wan = {
+            "tx_bytes": tx,
+            "tx_bytes_wan": tx_wan,
+            "tx_bytes_intra": tx - tx_wan,
+            "rx_bytes": rx,
+            "rx_bytes_wan": rx_wan,
+            "rx_bytes_intra": rx - rx_wan,
+        }
+        if tx:
+            wan["wan_tx_fraction"] = round(tx_wan / tx, 4)
+
     body = {
         "workers_traced": len(workers),
         "trace_files": [os.path.basename(p) for p in paths],
         "per_round": rounds,
         **({"per_fragment": fragments} if fragments else {}),
         **({"serve": serve} if serve else {}),
+        **({"wire_wan_split": wan} if wan else {}),
         "counters_total": {k: counters[k] for k in sorted(counters)},
     }
     return body, export.chrome_trace(workers)
@@ -525,6 +547,12 @@ def main() -> int:
         assert isinstance(chrome.get("traceEvents"), list)
         assert any(e.get("ph") == "X" for e in chrome["traceEvents"])
         assert any(e.get("ph") == "M" for e in chrome["traceEvents"])
+        # WAN split must be present and internally consistent: bytes moved,
+        # and the WAN-classified slice never exceeds the total
+        wan = report.get("wire_wan_split")
+        assert wan and wan["tx_bytes"] > 0, "no wire_wan_split in report"
+        assert 0 <= wan["tx_bytes_wan"] <= wan["tx_bytes"]
+        assert 0 <= wan["rx_bytes_wan"] <= wan["rx_bytes"]
     for f_ in fails:
         print("FAILURE:", f_)
     print("OBS REPORT " + ("PASSED" if ok else "FAILED"))

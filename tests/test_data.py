@@ -16,6 +16,22 @@ def test_fake_dataset_deterministic():
     assert not np.array_equal(a[0]["input_ids"], c["input_ids"])
 
 
+def test_fake_dataset_ramp_mode():
+    """mode="ramp" yields consecutive-token wrap-around ramps (the
+    learnable convergence-oracle stream), deterministically per (seed, i)."""
+    a = list(itertools.islice(iter(FakeTokenizedDataset(16, 100, seed=1, mode="ramp")), 5))
+    b = list(itertools.islice(iter(FakeTokenizedDataset(16, 100, seed=1, mode="ramp")), 5))
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(x["input_ids"], y["input_ids"])
+        ids = x["input_ids"]
+        np.testing.assert_array_equal(
+            ids, (ids[0] + np.arange(16)) % 100
+        )
+        np.testing.assert_array_equal(ids, x["labels"])
+    # distinct samples start at distinct points
+    assert len({int(s["input_ids"][0]) for s in a}) > 1
+
+
 def test_loader_state_resume_exact():
     """Resume mid-stream reproduces the exact remaining batches even with
     prefetch running ahead."""

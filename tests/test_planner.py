@@ -1,0 +1,386 @@
+"""Topology planner (diloco/planner.py): the one module every outer
+transport plans through.
+
+Covers the determinism contract (identical snapshot + identical env =
+identical plan, across processes), the ODTP_SITES/ODTP_HIER_AGG
+overrides, the linkstate/optimizer migration back-compat, and the
+acceptance gate: with codec "none" the hierarchical two-level round is
+BITWISE identical to the flat butterfly for any site assignment.
+"""
+
+import json
+import os
+import re
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from opendiloco_tpu.diloco import chaos, linkstate, planner
+from opendiloco_tpu.diloco.backend import PeerProgress
+from opendiloco_tpu.diloco.rendezvous import RendezvousServer
+from opendiloco_tpu.diloco.tcp import TcpBackend
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_NATIVE_DAEMON = os.path.join(_REPO, "native", "odtp-rendezvousd")
+
+
+def _member(pid: str, links: dict | None = None) -> dict:
+    m = {"peer_id": pid, "progress": {}}
+    if links is not None:
+        m["progress"]["links"] = {
+            "v": linkstate.LINK_VEC_VERSION,
+            "peers": links,
+        }
+    return m
+
+
+def _two_dc_group():
+    """4 peers, two fat pairs (a0<->a1, b0<->b1) joined by thin WAN links."""
+    ids = ["dc-a-0", "dc-a-1", "dc-b-0", "dc-b-1"]
+    fat, thin = 1e9, 5e7  # 20x apart, beyond the default 4x site ratio
+    group = []
+    for pid in ids:
+        site = pid[:4]
+        links = {
+            other: {"bps": fat if other[:4] == site else thin, "rtt_ms": 1.0}
+            for other in ids
+            if other != pid
+        }
+        group.append(_member(pid, links))
+    return group
+
+
+# -- site assignment ----------------------------------------------------------
+
+
+def test_sites_from_spec_parsing():
+    ids = ["rack-a-0", "rack-a-1", "rack-b-0", "stray"]
+    sites = planner._sites_from_spec("rack-a-*;rack-b-*", ids)
+    # declared-site order, group order inside; unmatched peers become
+    # singleton sites after the declared ones
+    assert sites == [[0, 1], [2], [3]]
+    # first-match-wins when globs overlap
+    assert planner._sites_from_spec("rack-*;rack-b-*", ids) == [[0, 1, 2], [3]]
+
+
+def test_cluster_sites_from_link_matrix(monkeypatch):
+    monkeypatch.delenv("ODTP_SITES", raising=False)
+    monkeypatch.delenv("ODTP_SITE_RATIO", raising=False)
+    assert planner.cluster_sites(_two_dc_group()) == [[0, 1], [2, 3]]
+    # a ratio wide enough to swallow the WAN gap collapses to one site
+    monkeypatch.setenv("ODTP_SITE_RATIO", "100")
+    assert planner.cluster_sites(_two_dc_group()) == [[0, 1, 2, 3]]
+    # mixed swarm (a member without a link vector) vetoes clustering
+    group = _two_dc_group()
+    group[2]["progress"].pop("links")
+    monkeypatch.delenv("ODTP_SITE_RATIO", raising=False)
+    assert planner.cluster_sites(group) == [[0, 1, 2, 3]]
+
+
+def test_spec_overrides_link_matrix(monkeypatch):
+    # explicit ODTP_SITES wins even when the matrix says otherwise
+    monkeypatch.setenv("ODTP_SITES", "dc-a-0|dc-b-0;dc-a-1|dc-b-1")
+    assert planner.cluster_sites(_two_dc_group()) == [[0, 2], [1, 3]]
+
+
+def test_elect_aggregator(monkeypatch):
+    group = _two_dc_group()
+    monkeypatch.delenv("ODTP_HIER_AGG", raising=False)
+    # capacity-ranked; this matrix is symmetric so the peer-id tiebreak
+    # decides (dc-a-0 < dc-a-1)
+    assert planner.elect_aggregator(group, [0, 1]) == 0
+    # preferred glob narrows the candidates
+    monkeypatch.setenv("ODTP_HIER_AGG", "dc-a-1|dc-b-1")
+    assert planner.elect_aggregator(group, [0, 1]) == 1
+    # no live match in the site = fall back to open election (this is what
+    # makes an aggregator SIGKILL an elastic non-event)
+    monkeypatch.setenv("ODTP_HIER_AGG", "gone-*")
+    assert planner.elect_aggregator(group, [0, 1]) == 0
+
+
+# -- round planning -----------------------------------------------------------
+
+
+def test_plan_round_flat_default_is_unstamped(monkeypatch):
+    """Non-adaptive flat rounds must stay byte-identical to the v1 wire:
+    no plan hash, no health extras, uniform bounds."""
+    for var in ("ODTP_HIER", "ODTP_SITES"):
+        monkeypatch.delenv(var, raising=False)
+    group = [_member(f"worker-{i}") for i in range(4)]
+    rp = planner.plan_round(group, 100_000)
+    assert rp.hier is None and rp.site_of is None
+    assert rp.plan_meta == {} and rp.health == {}
+    np.testing.assert_array_equal(
+        rp.bounds, planner.uniform_bounds(100_000, 4)
+    )
+
+
+def test_plan_round_adaptive_stamps_even_uniform(monkeypatch):
+    """The adaptive plane armed = plan hash on every frame, even when the
+    plan fell back to uniform (a tiny buffer here): disagreeing about the
+    fallback is exactly what the hash exists to catch."""
+    for var in ("ODTP_HIER", "ODTP_SITES"):
+        monkeypatch.delenv(var, raising=False)
+    group = [_member(f"worker-{i}") for i in range(4)]
+    rp = planner.plan_round(group, 100, adaptive=True)
+    assert rp.plan_meta.get("plan")
+    assert rp.health["link_plan"] == rp.plan_meta["plan"]
+
+
+def test_plan_round_hier(monkeypatch):
+    monkeypatch.setenv("ODTP_HIER", "1")
+    monkeypatch.delenv("ODTP_SITES", raising=False)
+    monkeypatch.delenv("ODTP_HIER_AGG", raising=False)
+    group = _two_dc_group()
+    rp = planner.plan_round(group, 100_000)
+    hp = rp.hier
+    assert hp is not None and hp.n_sites == 2
+    assert hp.sites == ((0, 1), (2, 3))
+    assert hp.aggregators == (0, 2)
+    assert rp.site_of == {
+        "dc-a-0": 0, "dc-a-1": 0, "dc-b-0": 1, "dc-b-1": 1,
+    }
+    # both bounds levels partition the full buffer
+    for ib in hp.intra_bounds:
+        assert ib[0] == 0 and ib[-1] == 100_000
+    assert hp.wan_bounds[0] == 0 and hp.wan_bounds[-1] == 100_000
+    # the plan hash rides the frame meta and the health ledger
+    assert rp.plan_meta["plan"] == hp.hash
+    assert rp.health["hier"]["plan"] == hp.hash
+    assert rp.health["hier"]["aggregators"] == ["dc-a-0", "dc-b-0"]
+
+    # determinism: identical inputs, identical plan (including the hash)
+    assert planner.plan_round(group, 100_000).hier == hp
+    # topology skew = different hash (this is the loud-failure contract)
+    monkeypatch.setenv("ODTP_SITES", "dc-a-0|dc-b-0;dc-a-1|dc-b-1")
+    assert planner.plan_round(group, 100_000).hier.hash != hp.hash
+
+
+def test_plan_round_hier_degenerates_to_flat(monkeypatch):
+    """One site (no measurements, nothing to split) = the flat butterfly,
+    and a solo group never plans hierarchy."""
+    monkeypatch.setenv("ODTP_HIER", "1")
+    monkeypatch.delenv("ODTP_SITES", raising=False)
+    group = [_member(f"worker-{i}") for i in range(4)]
+    rp = planner.plan_round(group, 100_000)
+    assert rp.hier is None and rp.plan_meta == {}
+    assert planner.plan_round([_member("solo")], 100_000).hier is None
+
+
+def test_site_map_without_hier(monkeypatch):
+    """ODTP_SITES alone (hier off) still yields the topology view, so the
+    WAN byte counters stay meaningful for a flat comparison arm."""
+    monkeypatch.delenv("ODTP_HIER", raising=False)
+    monkeypatch.setenv("ODTP_SITES", "dc-a-*;dc-b-*")
+    rp = planner.plan_round(_two_dc_group(), 100_000)
+    assert rp.hier is None
+    assert rp.site_of == {
+        "dc-a-0": 0, "dc-a-1": 0, "dc-b-0": 1, "dc-b-1": 1,
+    }
+
+
+# -- cross-process agreement --------------------------------------------------
+
+_HASH_SRC = """
+import json, sys
+from opendiloco_tpu.diloco import planner
+group = json.load(sys.stdin)
+rp = planner.plan_round(group, 1_000_000)
+print("PLAN " + (rp.hier.hash if rp.hier else "flat"), flush=True)
+"""
+
+
+def test_plan_hash_agrees_across_processes(monkeypatch):
+    """The determinism contract end to end: separate interpreters, same
+    snapshot + env, identical hier plan hash."""
+    group = _two_dc_group()
+    env = dict(os.environ)
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env["ODTP_HIER"] = "1"
+    env.pop("ODTP_SITES", None)
+    env.pop("ODTP_HIER_AGG", None)
+    hashes = set()
+    for _ in range(3):
+        out = subprocess.run(
+            [sys.executable, "-c", _HASH_SRC],
+            input=json.dumps(group), env=env, cwd=_REPO,
+            capture_output=True, text=True, timeout=120,
+        )
+        assert out.returncode == 0, out.stderr[-2000:]
+        m = re.search(r"PLAN (\S+)", out.stdout)
+        assert m, out.stdout
+        hashes.add(m.group(1))
+    assert len(hashes) == 1 and "flat" not in hashes, hashes
+
+
+# -- migration back-compat ----------------------------------------------------
+
+
+def test_linkstate_reexports_planner():
+    """The planning functions moved to planner.py; the published linkstate
+    API keeps resolving to the SAME objects (lazy PEP 562 re-export)."""
+    for name in (
+        "group_capacities", "plan_shares", "plan_bounds", "plan_hash",
+        "shares_of",
+    ):
+        assert getattr(linkstate, name) is getattr(planner, name), name
+    with pytest.raises(AttributeError):
+        linkstate.not_a_planner_function
+
+
+def test_fragment_partition_invariants():
+    sizes = [5, 1, 9, 3, 3, 7, 2, 4]
+    for n_frag in (1, 2, 3, len(sizes)):
+        frags = planner.fragment_partition(sizes, n_frag)
+        assert len(frags) == n_frag
+        assert all(frags), frags  # non-empty
+        assert [i for f in frags for i in f] == list(range(len(sizes)))
+    with pytest.raises(ValueError):
+        planner.fragment_partition([1, 2], 3)
+
+
+def test_uniform_bounds_and_shares():
+    b = planner.uniform_bounds(10, 3)
+    assert b[0] == 0 and b[-1] == 10 and len(b) == 4
+    assert sum(planner.shares_of(b, 10)) == pytest.approx(1.0, abs=0.01)
+
+
+# -- chaos WAN shaping spec ---------------------------------------------------
+
+
+def test_chaos_wan_spec():
+    p = chaos.parse_spec("seed=1;wan_bps=5e6;wan_peers=site-b-*|site-c-*")
+    assert p["wan_bps"] == 5e6
+    assert p["wan_peers"] == ["site-b-*", "site-c-*"]
+    cp = chaos.ChaosPlane("seed=1;wan_bps=5e6;wan_peers=site-b-*|site-c-*")
+    assert cp.wan_bps() == 5e6
+    assert cp.is_wan_peer("site-b-3") and cp.is_wan_peer("site-c-0")
+    assert not cp.is_wan_peer("site-a-1")
+    # unset = nothing is WAN-shaped, zero cost
+    cp0 = chaos.ChaosPlane("seed=1")
+    assert cp0.wan_bps() == 0.0 and not cp0.is_wan_peer("anything")
+    with pytest.raises(chaos.ChaosSpecError):
+        chaos.parse_spec("wan_bps=-1")
+
+
+# -- the acceptance gate: flat/hier bit-parity --------------------------------
+
+
+class _NativeDaemon:
+    def __init__(self):
+        self.proc = subprocess.Popen(
+            [_NATIVE_DAEMON, "--port", "0"], stdout=subprocess.PIPE, text=True
+        )
+        line = self.proc.stdout.readline()
+        m = re.search(r":(\d+)", line)
+        assert m, f"daemon did not announce a port: {line!r}"
+        self.address = f"127.0.0.1:{m.group(1)}"
+
+    def stop(self):
+        self.proc.terminate()
+        self.proc.wait(timeout=5)
+
+
+@pytest.fixture(params=["python", "native"])
+def rendezvous(request):
+    if request.param == "native":
+        if not os.path.exists(_NATIVE_DAEMON):
+            pytest.skip("native daemon not built (make -C native)")
+        server = _NativeDaemon()
+        yield server
+        server.stop()
+    else:
+        server = RendezvousServer(host="127.0.0.1", port=0).start_in_thread()
+        yield server
+        server.stop()
+
+
+def _concurrent_allreduce(backends, arrays_per_peer, timeout=90.0):
+    results = [None] * len(backends)
+    errors = []
+
+    def run(i):
+        try:
+            results[i] = backends[i].all_reduce(
+                arrays_per_peer[i], timeout=timeout
+            )
+        except Exception as e:  # pragma: no cover - surfaced via assert
+            errors.append((i, e))
+
+    threads = [
+        threading.Thread(target=run, args=(i,)) for i in range(len(backends))
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=timeout + 30)
+    assert not errors, errors
+    return results
+
+
+def _int_arrays(n_peers, seed=7):
+    """Integer-valued f32: per-element sums are exactly representable, so
+    any fold order gives the identical average and bit-parity is exact."""
+    out = []
+    for rank in range(n_peers):
+        rng = np.random.default_rng(seed + rank)
+        out.append([
+            rng.integers(-64, 64, size=50_003).astype(np.float32),
+            rng.integers(-64, 64, size=(37, 129)).astype(np.float32),
+        ])
+    return out
+
+
+def test_hier_bit_parity_any_site_assignment(rendezvous, monkeypatch):
+    """codec=none: the two-level round reduces to EXACTLY the bytes of the
+    flat butterfly, for every peer, under two different site carvings —
+    and the health ledger shows one agreed hier plan per galaxy."""
+    n = 4
+    arrays = _int_arrays(n)
+    assignments = {
+        "flat": None,
+        "halves": "worker-0|worker-1;worker-2|worker-3",
+        "interleaved": "worker-0|worker-3;worker-1|worker-2",
+    }
+    results = {}
+    for mode, spec in assignments.items():
+        if spec is None:
+            monkeypatch.delenv("ODTP_HIER", raising=False)
+            monkeypatch.delenv("ODTP_SITES", raising=False)
+        else:
+            monkeypatch.setenv("ODTP_HIER", "1")
+            monkeypatch.setenv("ODTP_SITES", spec)
+        backends = [
+            TcpBackend(
+                [rendezvous.address], peer_id=f"worker-{i}",
+                compression="none", expect_peers=n, matchmaking_time=5.0,
+            )
+            for i in range(n)
+        ]
+        try:
+            for i, b in enumerate(backends):
+                b.report_progress(
+                    PeerProgress(b.peer_id, 0, 0, 0.0, time.time())
+                )
+            results[mode] = _concurrent_allreduce(backends, arrays)
+            if spec is not None:
+                healths = [b.last_round_health for b in backends]
+                plans = {h.get("hier", {}).get("plan") for h in healths}
+                assert len(plans) == 1 and None not in plans, plans
+                assert all(
+                    len(h["hier"]["sites"]) == 2 for h in healths
+                ), healths[0]
+        finally:
+            for b in backends:
+                b.close()
+
+    for mode in ("halves", "interleaved"):
+        for (f_out, f_n), (h_out, h_n) in zip(results["flat"], results[mode]):
+            assert f_n == h_n == n
+            for fa, ha in zip(f_out, h_out):
+                np.testing.assert_array_equal(fa, ha)
