@@ -91,10 +91,19 @@ KNOBS: tuple[Knob, ...] = (
          doc_default="config"),
     # -- obs ------------------------------------------------------------------
     Knob("ODTP_OBS", "bool", "", "obs",
-         "`1` arms the tracing/metrics plane. Unset = zero-cost no-op.",
-         doc_default="off"),
+         "`1` arms the tracing/metrics plane (and with it the flight "
+         "recorder, galaxy overseer and anomaly watchdogs). Unset = "
+         "zero-cost no-op.", doc_default="off"),
+    Knob("ODTP_OBS_BLACKBOX_CAP", "int", "512", "obs",
+         "Flight-recorder event-ring length (recent spans/instants kept "
+         "for the black-box dump)."),
+    Knob("ODTP_OBS_BLACKBOX_FLUSH_S", "float", "5.0", "obs",
+         "Min seconds between rate-limited black-box autodumps (per round "
+         "and per chaos fault); `0` dumps on every trigger. Watchdog trips "
+         "always dump immediately."),
     Knob("ODTP_OBS_DIR", "path", "", "obs",
-         "Flush a `trace-w<rank>-<pid>.jsonl` event file here at exit.",
+         "Flush a `trace-w<rank>-<pid>.jsonl` event file here at exit, and "
+         "`blackbox-<worker>-<pid>.json` flight-recorder dumps on trouble.",
          doc_default="no flush"),
     Knob("ODTP_OBS_EVENTS_CAP", "int", "65536", "obs",
          "Event ring limit; overflow increments a `dropped` counter."),
@@ -104,6 +113,18 @@ KNOBS: tuple[Knob, ...] = (
     Knob("ODTP_ROOFLINE", "path", "", "obs",
          "Path override for the banked roofline JSON backing MFU gauges.",
          doc_default="auto-discover"),
+    Knob("ODTP_WATCHDOG_DIVERGE_Z", "float", "6.0", "obs",
+         "Divergence watchdog: trip when own pseudo-grad norm or loss is "
+         "this many sigma from the galaxy's (needs >= 4 reporting workers); "
+         "`0` disables."),
+    Knob("ODTP_WATCHDOG_STALL_S", "float", "0.0", "obs",
+         "Stall watchdog deadline: no outer-round progress for this many "
+         "seconds trips `anomaly_stall` + a black-box dump (never kills "
+         "the run).", doc_default="off"),
+    Knob("ODTP_WATCHDOG_STRAGGLER_X", "float", "3.0", "obs",
+         "Straggler watchdog factor: trip on a worker whose round time "
+         "exceeds X times the galaxy median, or whose inner tokens/s falls "
+         "below 1/X of it; `0` disables."),
     # -- serve ----------------------------------------------------------------
     Knob("ODTP_DECODE_WEIGHT_FORMAT", "str", "", "serve",
          "Replica weight residency override for the serve plane: `w4` keeps "

@@ -29,6 +29,13 @@ Grammar — ``;``-separated ``key=value`` items:
 - ``straggle_ms=A..B``  extra latency for this process's outer contributions
                         (straggler throttling); scope with
                         ``straggle_worker=W`` + ``set_identity(W)``.
+- ``straggle_inner_ms=A..B``  extra latency injected into every INNER
+                        training step (slow-host emulation). Unlike
+                        ``straggle_ms`` — whose delay the whole barrier-
+                        synchronized round absorbs symmetrically — this
+                        collapses the worker's own tokens/s, the
+                        asymmetric signature the straggler watchdog keys
+                        on. Scoped by ``straggle_worker`` too.
 - ``egress_bps=N``      cap this process's bulk/wire payload egress at N
                         bytes/second (token bucket, same machinery as
                         ``ODTP_BULK_BANDWIDTH_BPS``; when both are set the
@@ -125,6 +132,7 @@ def parse_spec(spec: str) -> dict:
         "blackout_rdv": [],
         "blackout_s": 3.0,
         "straggle_ms": (0.0, 0.0),
+        "straggle_inner_ms": (0.0, 0.0),
         "straggle_worker": None,
         "egress_bps": 0.0,
         "wan_bps": 0.0,
@@ -150,7 +158,7 @@ def _parse_item(p: dict, k: str, v: str) -> None:
         p[k] = float(v)
         if not 0.0 <= p[k] <= 1.0:
             raise ChaosSpecError(f"{k}={v} outside [0, 1]")
-    elif k in ("delay_ms", "straggle_ms"):
+    elif k in ("delay_ms", "straggle_ms", "straggle_inner_ms"):
         p[k] = _parse_range(v)
     elif k == "kill_worker":
         p["kill_worker"] = _parse_kills(v)
@@ -206,6 +214,18 @@ class ChaosPlane:
             if len(self.events) < _EVENTS_CAP:
                 self.events.append({"kind": kind, "site": site, **detail})
         log.warning("chaos: injected %s at %s %s", kind, site, detail or "")
+        # every injected fault lands in the flight recorder (and, rate-
+        # limited, on disk): a postmortem can then correlate faults with
+        # the spans they perturbed. No-op unless ODTP_OBS is armed; lazy
+        # import keeps the fault-free path free of obs machinery.
+        try:
+            from opendiloco_tpu.obs import blackbox
+
+            bb = blackbox.recorder()
+            if bb is not None:
+                bb.note_fault(kind, site, detail)
+        except Exception:
+            pass
 
     def snapshot(self) -> dict:
         """Counters + bounded event log, JSON-ready (soak/ledger reporting)."""
@@ -254,6 +274,22 @@ class ChaosPlane:
         d = (lo + (hi - lo) * self._draw()) / 1000.0
         if d > 0.0:
             self._record("straggle", "outer_round", ms=round(d * 1000.0, 3))
+        return d
+
+    def straggle_inner_s(self) -> float:
+        """Slow-host emulation: seconds to sleep inside one inner training
+        step (train loop hook). Consumed once per step so the worker's
+        measured tokens/s — which rides the overseer roll-up — drops by
+        exactly the injected factor."""
+        lo, hi = self.params["straggle_inner_ms"]
+        if hi <= 0.0:
+            return 0.0
+        w = self.params["straggle_worker"]
+        if w is not None and self.identity != w:
+            return 0.0
+        d = (lo + (hi - lo) * self._draw()) / 1000.0
+        if d > 0.0:
+            self._record("straggle_inner", "inner_step", ms=round(d * 1000.0, 3))
         return d
 
     def egress_bps(self) -> float:

@@ -263,6 +263,20 @@ class LinkEstimator:
             }
         return {"v": LINK_VEC_VERSION, "peers": peers}
 
+    def published_capacity(self) -> Optional[float]:
+        """Median published egress bps across peers — the one-number link
+        capacity that rides this worker's overseer health roll-up (the
+        per-peer vector already travels separately as ``links``)."""
+        with self._lock:
+            rates = sorted(
+                v["bps"] for v in self._published.values() if v.get("bps")
+            )
+        if not rates:
+            return None
+        mid = len(rates) // 2
+        return rates[mid] if len(rates) % 2 else 0.5 * (
+            rates[mid - 1] + rates[mid])
+
     def merge_remote(self, peer_id: str, vec: Any) -> None:
         """Keep the latest remote link vector (observability only)."""
         if peer_id == self.own_id or not isinstance(vec, dict):
@@ -293,6 +307,15 @@ def _member_links(member: dict) -> Optional[dict]:
         return None
     peers = vec.get("peers")
     return peers if isinstance(peers, dict) else {}
+
+
+def member_health(member: dict) -> Optional[dict]:
+    """The overseer health roll-up riding a registry/group-snapshot
+    member, if any. Version checking stays with the overseer's merge
+    (obs/overseer.py) — this is pure extraction, kept here next to
+    ``_member_links`` because the two ride the identical channel."""
+    vec = (member.get("progress") or {}).get("health")
+    return vec if isinstance(vec, dict) else None
 
 
 # The partition-planning functions (group_capacities, plan_shares,

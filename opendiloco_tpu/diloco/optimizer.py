@@ -657,6 +657,15 @@ class DiLoCoOptimizer:
                 else:
                     state, outer_metrics = self.outer_step(state)
             metrics.update(outer_metrics)
+            tr = obs.tracer()
+            if tr is not None:
+                # epoch rides the overseer roll-up; the watchdog's stall
+                # deadline resets here so EVERY backend (loopback included,
+                # where no TCP round-health hook fires) counts as progress
+                tr.gauge("outer_epoch", self.epoch)
+                wd = obs.anomaly.watchdog()
+                if wd is not None:
+                    wd.note_progress(self.epoch)
         return state, metrics
 
     def _stream_tick(self, state: dict) -> dict:

@@ -37,10 +37,12 @@ class PeerInfo:
     port: int
     last_seen: float = field(default_factory=time.monotonic)
     # stored and replayed VERBATIM (the native daemon keeps the raw JSON the
-    # same way): workers ride extra keys on it — notably the adaptive
-    # transport's "links" vector (diloco/linkstate.py), which reaches every
-    # group member through the join_group reply's group snapshot. Daemons
-    # MUST NOT normalize or filter this dict.
+    # same way): workers ride extra keys on it — the adaptive transport's
+    # "links" vector (diloco/linkstate.py) and the overseer's "health"
+    # roll-up (obs/overseer.py), both of which reach every group member
+    # through the join_group reply's group snapshot and every peer through
+    # register/progress replies. Daemons MUST NOT normalize or filter this
+    # dict: it is the galaxy's only barrier-free gossip channel.
     progress: Optional[dict] = None
     serves_state: bool = False
     # the worker's embedded rendezvous port (0 = none): lets the swarm

@@ -385,6 +385,15 @@ class TcpBackend(OuterBackend):
         }
         if self._adaptive():
             prog["links"] = self.links.publish()
+        ov = obs.overseer.plane()
+        if ov is not None:
+            # the overseer health roll-up rides the same verbatim-replayed
+            # progress dict as the link vector: every register/progress
+            # reply and join_group snapshot then carries it galaxy-wide
+            # with no new connections (obs/overseer.py)
+            roll = ov.rollup(capacity_bps=self.links.published_capacity())
+            prog["health"] = roll
+            ov.merge(self._peer_id, roll)
         return prog
 
     def _identity_meta(self) -> dict:
@@ -708,6 +717,14 @@ class TcpBackend(OuterBackend):
                     await send_frame(
                         writer, "ok", {"format": "prometheus-0.0.4"}, body
                     )
+                elif msg == "health":
+                    # this worker's converged overseer galaxy matrix, for
+                    # odtp_top --watch (empty when obs disarmed)
+                    ov = obs.overseer.plane()
+                    await send_frame(
+                        writer, "ok",
+                        {"matrix": ov.matrix() if ov is not None else {}},
+                    )
                 elif msg == "fetch_state":
                     if self._state_provider is None:
                         await send_frame(writer, "error", {"error": "no state"})
@@ -858,10 +875,15 @@ class TcpBackend(OuterBackend):
                 },
                 timeout=self.rpc_timeout,
             )
+            ov = obs.overseer.plane()
             for p in meta.get("peers", []):
                 self.links.merge_remote(
                     p.get("peer_id", ""), (p.get("progress") or {}).get("links")
                 )
+                if ov is not None:
+                    ov.merge(
+                        p.get("peer_id", ""), linkstate.member_health(p)
+                    )
         except Exception as e:
             log.debug("links announce failed: %s", e)
 
@@ -1117,9 +1139,12 @@ class TcpBackend(OuterBackend):
             return
         self._note_peers(meta)
         cache = []
+        ov = obs.overseer.plane()
         for p in meta.get("peers", []):
             prog = p.get("progress") or {}
             self.links.merge_remote(p.get("peer_id", ""), prog.get("links"))
+            if ov is not None and p.get("peer_id") != self._peer_id:
+                ov.merge(p.get("peer_id", ""), prog.get("health"))
             cache.append(
                 PeerProgress(
                     peer_id=p["peer_id"],
@@ -1178,6 +1203,7 @@ class TcpBackend(OuterBackend):
     def _record_round_health(
         self, join_key: str, n: int, expected: int, elastic: bool, timings: dict,
         extra: Optional[dict] = None, attempt: int = 0,
+        members: Optional[list] = None,
     ) -> None:
         """Append one row to the round health ledger (and keep the legacy
         ``last_round_timings`` view in sync). Solo and elastic rounds are
@@ -1226,6 +1252,12 @@ class TcpBackend(OuterBackend):
                         tr.gauge("link_bps", vec["bps"], peer=pid)
                     if vec.get("rtt_ms"):
                         tr.gauge("link_rtt_ms", vec["rtt_ms"], peer=pid)
+        ov = obs.overseer.plane()
+        if ov is not None:
+            # refresh own galaxy-matrix row, feed the flight recorder, and
+            # run the anomaly watchdogs (straggler / divergence / dead-peer
+            # / stall) against the freshly recorded round
+            ov.note_round(health, own_id=self._peer_id, members=members)
 
     def all_reduce(
         self, arrays, *, timeout=None, tag: str = "grads", epoch=None, group_cap=0
@@ -1366,18 +1398,29 @@ class TcpBackend(OuterBackend):
             )
         if n == 1:
             timings["matchmake_s"] = time.monotonic() - t_mm
+            timings["round_s"] = time.monotonic() - t_mm
             if tr is not None:
                 tr.add_span(
                     "outer/rendezvous", t_mm_p, time.perf_counter(),
                     round=join_key, group=n,
                 )
             self._record_round_health(
-                join_key, n, expected, elastic, timings, attempt=attempt
+                join_key, n, expected, elastic, timings, attempt=attempt,
+                members=[self._peer_id],
             )
             return [a.copy() for a in arrays], 1
         # fingerprint the membership: retried rounds (same join_key) must not
         # consume stale mailbox traffic from a differently-shaped group
         round_key = f"{join_key}:{planner.group_fingerprint(group)}"
+
+        ov = obs.overseer.plane()
+        if ov is not None:
+            # the group snapshot every member received identically also
+            # carries every member's health roll-up — merge them so the
+            # galaxy matrix converges even between progress announces
+            for p in group:
+                if p["peer_id"] != self._peer_id:
+                    ov.merge(p["peer_id"], linkstate.member_health(p))
 
         timings["matchmake_s"] = time.monotonic() - t_mm
         if tr is not None:
@@ -1472,9 +1515,12 @@ class TcpBackend(OuterBackend):
                 timings[f"{name}_s"] = round(
                     timings.get(f"{name}_s", 0.0) + secs, 6
                 )
+        # round wall time (matchmake through exchange) — the figure the
+        # straggler watchdog compares against the galaxy median
+        timings["round_s"] = time.monotonic() - t_mm
         self._record_round_health(
             join_key, n, expected, elastic, timings, extra=health_extra,
-            attempt=attempt,
+            attempt=attempt, members=[p["peer_id"] for p in group],
         )
         if adaptive:
             # fresh estimates from this round's transfers reach the daemon

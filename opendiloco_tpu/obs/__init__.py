@@ -23,20 +23,33 @@ from opendiloco_tpu.obs.trace import (  # noqa: F401
     count,
     enabled,
     gauge,
-    reset,
     span,
     tracer,
 )
-from opendiloco_tpu.obs import export, mfu  # noqa: F401
+from opendiloco_tpu.obs import anomaly, blackbox, export, mfu, overseer  # noqa: F401
+from opendiloco_tpu.obs import trace as _trace
+
+
+def reset() -> None:
+    """Drop every cached obs singleton (tests / env changes): tracer,
+    flight recorder, overseer, and watchdogs."""
+    anomaly.reset()
+    blackbox.reset()
+    overseer.reset()
+    _trace.reset()
+
 
 __all__ = [
     "StageTimes",
     "Tracer",
+    "anomaly",
+    "blackbox",
     "count",
     "enabled",
     "export",
     "gauge",
     "mfu",
+    "overseer",
     "reset",
     "span",
     "tracer",

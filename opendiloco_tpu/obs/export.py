@@ -18,6 +18,26 @@ _LABEL_OK = re.compile(r"[^a-zA-Z0-9_]")
 
 
 # -- Chrome trace_event -----------------------------------------------------
+def clock_shifts(
+    workers: "list[tuple[Any, list[dict], dict]]",
+) -> "tuple[float, list[float]]":
+    """Cross-process clock alignment: ``(t0, shifts_us)``.
+
+    Each worker's events carry microsecond timestamps relative to its own
+    monotonic origin; its meta record pins that origin to the wall clock
+    (``origin_wall``). Shifting worker *i* by ``shifts_us[i]`` puts every
+    event on one shared timeline whose zero is the earliest origin ``t0``.
+    Both the Chrome merge below and ``scripts/odtp_postmortem.py`` order
+    cross-worker events with exactly this arithmetic.
+    """
+    origins = [m.get("origin_wall", 0.0) for _, _, m in workers]
+    t0 = min(origins) if origins else 0.0
+    shifts = [
+        (m.get("origin_wall", t0) - t0) * 1e6 for _, _, m in workers
+    ]
+    return t0, shifts
+
+
 def chrome_trace(
     workers: "list[tuple[Any, list[dict], dict]]",
 ) -> dict:
@@ -28,11 +48,10 @@ def chrome_trace(
     monotonic clocks across processes). Each worker becomes one Chrome
     ``pid`` row, named ``worker <id>``.
     """
-    origins = [m.get("origin_wall", 0.0) for _, _, m in workers]
-    t0 = min(origins) if origins else 0.0
+    _, shifts = clock_shifts(workers)
     trace_events: list[dict] = []
     for pid, (worker, events, meta) in enumerate(workers):
-        shift_us = (meta.get("origin_wall", t0) - t0) * 1e6
+        shift_us = shifts[pid]
         trace_events.append({
             "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
             "args": {"name": f"worker {worker}"},
@@ -50,6 +69,11 @@ def chrome_trace(
                 out["dur"] = float(ev.get("dur", 0.0))
             elif out["ph"] == "i":
                 out["s"] = ev.get("s", "t")
+            elif out["ph"] == "C":
+                # gauge counter track (see Tracer.gauge): Perfetto keys the
+                # track on (pid, name) and plots args["value"] over time
+                out["args"] = {"value": float(
+                    (ev.get("args") or {}).get("value", 0.0))}
             trace_events.append(out)
     return {
         "traceEvents": trace_events,
@@ -156,6 +180,7 @@ def prometheus_text(tr: Optional[Tracer]) -> str:
 
 __all__ = [
     "chrome_trace",
+    "clock_shifts",
     "tracer_chrome_trace",
     "write_chrome_trace",
     "load_jsonl",

@@ -175,8 +175,17 @@ class Tracer:
         with self._lock:
             if len(self.events) >= self.cap:
                 self.dropped += 1
-                return
-            self.events.append(ev)
+            else:
+                self.events.append(ev)
+        # mirror into the flight recorder's ring of the RECENT past --
+        # including events the capped main buffer dropped (a long run's
+        # tail is exactly what a postmortem needs). Outside self._lock:
+        # the recorder has its own lock and must not nest under ours.
+        from opendiloco_tpu.obs import blackbox
+
+        bb = blackbox.recorder()
+        if bb is not None:
+            bb.note_event(ev)
 
     # -- counters / gauges --------------------------------------------------
     @staticmethod
@@ -189,8 +198,25 @@ class Tracer:
             self._counters[key] = self._counters.get(key, 0.0) + n
 
     def gauge(self, name: str, value: float, **labels: Any) -> None:
+        v = float(value)
         with self._lock:
-            self._gauges[self._key(name, labels)] = float(value)
+            self._gauges[self._key(name, labels)] = v
+        # gauges double as Chrome ``counter`` events (ph="C") so Perfetto
+        # renders loss / tokens_per_s / pseudo_grad_norm as value tracks
+        # alongside the spans; labels fold into the track name the same
+        # way _flat_metrics renders them
+        if labels:
+            body = ",".join(f"{k}={lv}" for k, lv in sorted(labels.items()))
+            track = f"{name}{{{body}}}"
+        else:
+            track = name
+        self._record({
+            "name": track,
+            "ph": "C",
+            "ts": (time.perf_counter() - self.origin) * 1e6,
+            "tid": 0,
+            "args": {"value": v},
+        })
 
     def counters(self) -> dict:
         with self._lock:

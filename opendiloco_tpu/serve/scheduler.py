@@ -384,7 +384,14 @@ class ContinuousBatcher:
         obs.gauge(
             "serve_batch_occupancy", self.slots.num_active / self.slots.num_slots
         )
-        obs.gauge("serve_snapshot_staleness", self.engine.staleness())
+        staleness = self.engine.staleness()
+        obs.gauge("serve_snapshot_staleness", staleness)
+        wd = obs.anomaly.watchdog()
+        if wd is not None:
+            # a breach here means maybe_swap() could NOT restore the bound
+            # (e.g. the trainer stalled and no fresh snapshot exists): the
+            # watchdog records it, serving continues on the stale snapshot
+            wd.serve_staleness(staleness, self.engine.max_stale_rounds)
         if self.spec_proposed:
             obs.gauge(
                 "serve_spec_acceptance", self.spec_accepted / self.spec_proposed

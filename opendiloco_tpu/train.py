@@ -76,6 +76,11 @@ def train(config: Config, backend: Optional[OuterBackend] = None) -> dict:
     _tr = obs.tracer()
     if _tr is not None:
         _tr.set_identity(worker=world_rank)
+        # arm the flight recorder's crash hooks (atexit / fatal signals /
+        # faulthandler) so this worker leaves a black box behind even when
+        # it dies mid-round; identity must be set first so the dump file
+        # is blackbox-<rank>-<pid>.json, not blackbox-<pid>-<pid>.json
+        obs.blackbox.install()
 
     if config.multihost:
         # in-worker multi-host slice: every host of the slice runs this
@@ -362,6 +367,11 @@ def train(config: Config, backend: Optional[OuterBackend] = None) -> dict:
                     host_batch["input_ids"], host_batch["labels"], accum
                 )
             data_wait_s = time.perf_counter() - t0  # ~0 when prefetch keeps up
+            cp = chaos.plane()
+            if cp is not None:
+                d = cp.straggle_inner_s()
+                if d:  # slow-host emulation, inside the measured step window
+                    time.sleep(d)
             if diloco_opt is not None:
                 state, metrics = diloco_opt.step(state, batch)
             else:
@@ -449,6 +459,12 @@ def train(config: Config, backend: Optional[OuterBackend] = None) -> dict:
                 _tr_out.flush()
             except Exception:
                 log.exception("failed to flush obs trace")
+            _bb = obs.blackbox.recorder()
+            if _bb is not None:
+                try:
+                    _bb.dump(reason="train_exit")
+                except Exception:
+                    log.exception("failed to dump flight recorder")
         if owns_backend and backend is not None:
             backend.close()
     return summary

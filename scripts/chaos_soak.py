@@ -19,18 +19,40 @@ fault plane armed end to end:
   ``--diloco.skip-load-from-peers`` so the straggler re-onboards through
   the (fp16-compressed) fetch_state path.
 
+The soak also runs with the OBSERVABILITY plane armed (``ODTP_OBS=1``)
+and gates that the galaxy overseer + flight recorders actually caught
+the injected trouble:
+
+- one rank runs with ``straggle_inner_ms`` chaos (slow-host emulation)
+  and must be named by an ``anomaly_straggler`` trip somewhere in the
+  galaxy (the tokens/s signal gossips via the overseer roll-ups);
+- the SIGKILLed rank must be named by an ``anomaly_dead_peer`` trip on
+  a survivor (an elastic round missing a previously-grouped peer);
+- every worker -- including the killed incarnation -- must leave a
+  ``blackbox-*.json`` flight-recorder dump, and the merged postmortem
+  (scripts/odtp_postmortem.py) must cover every completed round;
+- some survivor's own overseer matrix must converge to all N workers.
+
+The obs verdict + galaxy matrix + merged timeline is banked to
+OBS_GALAXY.json next to CHAOS_SOAK.json.
+
 The soak passes iff every outer round completed (full or elastic), loss
 descended, a replacement aggregator was elected while the killed one was
-down, and there are zero error rows. The verdict + per-worker
-round/fault accounting is banked to CHAOS_SOAK.json at the repo root:
+down, there are zero error rows, and the observability gates hold. The
+verdict + per-worker round/fault accounting is banked to CHAOS_SOAK.json
+at the repo root:
 
     python scripts/chaos_soak.py [--workers 8] [--rounds 6] [--out ...]
+    python scripts/chaos_soak.py --selftest   # 4-worker CI variant
 """
 import argparse
+import glob
+import importlib.util
 import json
 import os
 import pickle
 import re
+import shutil
 import signal
 import subprocess
 import sys
@@ -43,6 +65,14 @@ sys.path.insert(0, REPO)
 
 WORKER_CHAOS = "seed={seed};drop_conn=0.05;delay_ms=5..30"
 DAEMON_CHAOS = "seed=99;blackout_rdv=r3;blackout_s=2.0"
+# slow-host emulation for ONE rank: injected inside the inner step, so its
+# tokens/s collapses asymmetrically (what the straggler watchdog keys on).
+# the sleep must dominate the multi-second step times a CPU-contended
+# loopback galaxy already has, or the signal drowns in scheduler noise
+STRAGGLE_INNER = "straggle_inner_ms=8000..10000"
+# outer-send delay for the kill target: widens its in-round window so the
+# SIGKILL reliably lands mid-round and the black box keeps a partial round
+KILL_RANK_EXTRA = "straggle_ms=800..1500"
 
 
 def hier_sites(workers: int) -> tuple[str, str]:
@@ -59,13 +89,29 @@ def hier_sites(workers: int) -> tuple[str, str]:
     return site_spec, agg_spec
 
 
-def worker_env(rank: int, workers: int) -> dict:
+def worker_env(
+    rank: int, workers: int, obs_dir: str, straggle_rank: int, kill_rank: int
+) -> dict:
     env = dict(os.environ)
     env["OPENDILOCO_TPU_PLATFORM"] = "cpu"
     env["JAX_PLATFORMS"] = "cpu"
     env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
-    env["ODTP_CHAOS"] = WORKER_CHAOS.format(seed=7 + rank)
+    spec = WORKER_CHAOS.format(seed=7 + rank)
+    if rank == straggle_rank:
+        spec += ";" + STRAGGLE_INNER
+    if rank == kill_rank:
+        spec += ";" + KILL_RANK_EXTRA
+    env["ODTP_CHAOS"] = spec
+    # observability plane: overseer roll-ups gossip on the rendezvous
+    # channels, watchdogs run per round, and the flight recorder autodumps
+    # every 0.5s-rate-limited trigger -- tight enough that a SIGKILLed
+    # worker's on-disk black box is at most half a second stale
+    env["ODTP_OBS"] = "1"
+    env["ODTP_OBS_DIR"] = obs_dir
+    env["ODTP_OBS_BLACKBOX_FLUSH_S"] = "0.5"
+    env["ODTP_WATCHDOG_STRAGGLER_X"] = "1.5"
+    env["ODTP_WATCHDOG_STALL_S"] = "240"
     # close matchmaking on the full galaxy when everyone is alive, so
     # elastic (partial) rounds appear exactly when a worker is down --
     # which is what the re-election assertion below keys on
@@ -127,8 +173,58 @@ def spawn_worker(
         cli.append("--diloco.skip-load-from-peers")
     return subprocess.Popen(
         cli, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
-        env=worker_env(rank, args.workers), cwd=REPO,
+        env=worker_env(
+            rank, args.workers, args.obs_dir, args.straggle_rank,
+            args.kill_rank,
+        ),
+        cwd=REPO,
     )
+
+
+def wait_for_midround_evidence(
+        obs_dir: str, rank: int, after_first_round_s: float) -> bool:
+    """Block until rank's own flight recorder PROVES it is mid-round: a
+    round-tagged span whose round its health rows don't contain yet.
+    Killing at that moment guarantees the partial-round evidence the
+    postmortem gate wants is already on disk (the 0.5s-flushed dump we
+    just read IS the file a SIGKILL leaves behind). A blind sleep can
+    land before the first (compile-dominated) round even completes.
+
+    Phase 1 waits for the first completed round with only a coarse
+    backstop (compile time varies wildly across hosts); phase 2 gives up
+    ``after_first_round_s`` later so a kill always happens."""
+    def box():
+        for p in glob.glob(os.path.join(obs_dir, f"blackbox-{rank}-*.json")):
+            try:
+                with open(p) as f:
+                    return json.load(f)
+            except Exception:
+                continue
+        return None
+
+    deadline = None
+    backstop = time.time() + 1800.0  # a worker that never rounds at all
+    while time.time() < backstop:
+        b = box()
+        if b is not None:
+            done = {str(h.get("round")) for h in b.get("health", [])}
+            if done:
+                if deadline is None:
+                    deadline = time.time() + after_first_round_s
+                for e in b.get("events", []):
+                    r = (e.get("args") or {}).get("round")
+                    if r and str(r).split(":")[0] not in done:
+                        print(f"rank {rank} mid-round "
+                              f"({str(r).split(':')[0]}): killing now")
+                        return True
+        if deadline is not None and time.time() > deadline:
+            print(f"rank {rank}: no mid-round evidence within "
+                  f"{after_first_round_s:.0f}s of its first round; "
+                  "killing anyway")
+            return False
+        time.sleep(0.25)
+    print(f"rank {rank}: never completed a round; killing anyway")
+    return False
 
 
 _FAULT_RE = re.compile(r"chaos: injected (\w+)")
@@ -158,14 +254,43 @@ def main() -> int:
     ap.add_argument("--local-steps", type=int, default=3)
     ap.add_argument("--kill-rank", type=int, default=-1,
                     help="rank to SIGKILL+restart (default: last)")
-    ap.add_argument("--kill-after-s", type=float, default=50.0)
+    ap.add_argument("--kill-after-s", type=float, default=50.0,
+                    help="SIGKILL deadline after the kill rank's first "
+                    "completed round; the kill fires as soon as its flight "
+                    "recorder shows mid-round evidence (usually seconds)")
+    ap.add_argument("--restart-delay-s", type=float, default=8.0,
+                    help="downtime before the killed rank restarts, so "
+                    "survivors provably complete elastic rounds without it "
+                    "(what the dead-peer watchdog keys on)")
+    ap.add_argument("--straggle-rank", type=int, default=1,
+                    help="rank that runs with straggle_inner_ms chaos (the "
+                    "straggler the watchdogs must name)")
     ap.add_argument("--timeout", type=float, default=1200.0)
     ap.add_argument("--out", default=os.path.join(REPO, "CHAOS_SOAK.json"))
+    ap.add_argument("--obs-out", default=os.path.join(REPO, "OBS_GALAXY.json"))
     ap.add_argument("--workdir", default="/tmp/odtp_chaos_soak")
+    ap.add_argument(
+        "--selftest", action="store_true",
+        help="small galaxy (4 workers, 4 rounds), artifacts under the "
+        "workdir, same hard gates incl. blackbox dumps + postmortem (CI)",
+    )
     args = ap.parse_args()
+    if args.selftest:
+        args.workers = min(args.workers, 4)
+        args.rounds = min(args.rounds, 4)
+        args.local_steps = min(args.local_steps, 2)
+        args.kill_after_s = min(args.kill_after_s, 30.0)
+        args.out = os.path.join(args.workdir, "CHAOS_SOAK.json")
+        args.obs_out = os.path.join(args.workdir, "OBS_GALAXY.json")
     kill_rank = args.kill_rank if args.kill_rank >= 0 else args.workers - 1
+    args.kill_rank = kill_rank
+    if args.straggle_rank == kill_rank:
+        args.straggle_rank = (kill_rank + 1) % args.workers
+    args.obs_dir = os.path.join(args.workdir, "obs")
 
     os.makedirs(args.workdir, exist_ok=True)
+    shutil.rmtree(args.obs_dir, ignore_errors=True)  # stale dumps poison gates
+    os.makedirs(args.obs_dir, exist_ok=True)
     t0 = time.time()
     daemon, address = spawn_daemon()
     print(f"rendezvous (blackout-armed) at {address}")
@@ -179,12 +304,17 @@ def main() -> int:
         for r in range(args.workers)
     }
     print(f"{args.workers} workers up; SIGKILL of rank {kill_rank} "
-          f"(preferred aggregator of its site) in {args.kill_after_s:.0f}s")
+          f"(preferred aggregator of its site) once its flight recorder "
+          f"shows it mid-round, deadline {args.kill_after_s:.0f}s after "
+          "first round")
 
-    time.sleep(args.kill_after_s)
+    wait_for_midround_evidence(args.obs_dir, kill_rank, args.kill_after_s)
     procs[kill_rank].send_signal(signal.SIGKILL)
     killed_out, killed_err = procs[kill_rank].communicate(timeout=30)
-    print(f"rank {kill_rank} SIGKILLed; restarting with peer onboarding")
+    print(f"rank {kill_rank} SIGKILLed; restart in "
+          f"{args.restart_delay_s:.0f}s (downtime window for the dead-peer "
+          "watchdog) with peer onboarding")
+    time.sleep(args.restart_delay_s)
     restart_log = os.path.join(args.workdir, f"soak_w{kill_rank}_restart.pkl")
     restart = spawn_worker(
         kill_rank, address, restart_log, args, onboard=True
@@ -264,6 +394,110 @@ def main() -> int:
     )
     aggregator_reelected = kill_was_aggregator and reelected
 
+    # -- observability verdict: did the overseer/watchdogs catch it? --------
+    pm_spec = importlib.util.spec_from_file_location(
+        "odtp_postmortem", os.path.join(REPO, "scripts", "odtp_postmortem.py")
+    )
+    pm_mod = importlib.util.module_from_spec(pm_spec)
+    pm_spec.loader.exec_module(pm_mod)
+    boxes = pm_mod.load_boxes(args.obs_dir)
+    pm = pm_mod.merge_postmortem(boxes) if boxes else {}
+    anomalies = pm.get("anomalies") or []
+    anomaly_counters = pm.get("anomaly_counters") or {}
+    timeline = pm.get("timeline") or []
+    straggle_peer = f"worker-{args.straggle_rank}"
+
+    ranks_with_box = {str(b.get("worker")) for b in boxes}
+    blackbox_all = {str(r) for r in range(args.workers)} <= ranks_with_box
+    # pid-suffixed dumps: the killed incarnation's black box must still be
+    # on disk next to its replacement's
+    killed_box_preserved = sum(
+        1 for b in boxes if str(b.get("worker")) == str(kill_rank)
+    ) >= 2
+    sigkill_detected = any(
+        a.get("kind") == "dead_peer" and a.get("subject") == kill_peer
+        for a in anomalies
+    )
+    straggler_detected = any(
+        a.get("kind") == "straggler" and a.get("subject") == straggle_peer
+        for a in anomalies
+    )
+    counters_nonzero = (
+        any(k.startswith("anomaly_dead_peer") for k in anomaly_counters)
+        and any(k.startswith("anomaly_straggler") for k in anomaly_counters)
+    )
+    matrix_full = len(pm.get("galaxy") or {}) >= args.workers
+    converged = max(
+        (len(b.get("galaxy") or {}) for b in boxes), default=0
+    ) >= args.workers
+    grads_epochs = sorted({
+        int(m.group(1))
+        for row in timeline if row["workers_completed"]
+        for m in [re.match(r"grads-epoch-(\d+)$", row["round"])] if m
+    })
+    rounds_covered = bool(grads_epochs) and (
+        len(grads_epochs) >= args.rounds
+        and grads_epochs
+        == list(range(grads_epochs[0], grads_epochs[0] + len(grads_epochs)))
+    )
+    killed_partial = any(
+        str(kill_rank) in row["workers_partial"] for row in timeline
+    )
+    obs_gates = {
+        "blackbox_dump_per_worker": blackbox_all,
+        "killed_incarnation_box_preserved": killed_box_preserved,
+        "sigkill_detected_as_dead_peer": sigkill_detected,
+        "straggler_detected": straggler_detected,
+        "anomaly_counters_nonzero": counters_nonzero,
+        "galaxy_matrix_full": matrix_full,
+        "some_worker_converged_to_full_matrix": converged,
+        "postmortem_covers_every_completed_round": rounds_covered,
+        "killed_worker_final_partial_round": killed_partial,
+    }
+    obs_ok = all(
+        v for k, v in obs_gates.items()
+        # the partial-round gate needs the kill to land mid-exchange; the
+        # widened in-round window makes that near-certain at full scale,
+        # but the 4-worker selftest keeps it informational
+        if not (args.selftest and k == "killed_worker_final_partial_round")
+    )
+    obs_report = {
+        "bench": "obs_galaxy",
+        "model": args.model,
+        "workers": args.workers,
+        "rounds": args.rounds,
+        "backend": "tcp",
+        "chaos": {
+            "sigkill_rank": kill_rank,
+            "restart_delay_s": args.restart_delay_s,
+            "straggle_rank": args.straggle_rank,
+            "straggle_spec": STRAGGLE_INNER,
+            "kill_rank_extra": KILL_RANK_EXTRA,
+        },
+        "obs_env": {
+            "ODTP_OBS_BLACKBOX_FLUSH_S": "0.5",
+            "ODTP_WATCHDOG_STRAGGLER_X": "1.5",
+            "ODTP_WATCHDOG_STALL_S": "240",
+        },
+        "gates": obs_gates,
+        "passed": obs_ok,
+        "workers_in_matrix": len(pm.get("galaxy") or {}),
+        "matrix_coverage_per_dump": {
+            b["_file"]: len(b.get("galaxy") or {}) for b in boxes
+        },
+        "anomaly_counters": anomaly_counters,
+        "grads_epochs_on_timeline": grads_epochs,
+        "postmortem": pm,
+    }
+    with open(args.obs_out, "w") as f:
+        json.dump(obs_report, f, indent=1)
+        f.write("\n")
+    print(
+        f"banked {args.obs_out}: {obs_report['workers_in_matrix']} workers "
+        f"in matrix, {len(timeline)} rounds on the merged timeline, "
+        f"anomaly counters {anomaly_counters}"
+    )
+
     ref = per_worker[0]
     rounds_completed = ref["final_outer_epoch"] or 0
     every_round_completed = (
@@ -314,13 +548,16 @@ def main() -> int:
             fault_counts(killed_out, killed_err).values()
         ),
         "per_worker": per_worker,
+        "obs": {"gates": obs_gates, "passed": obs_ok,
+                "report": os.path.basename(args.obs_out)},
         "elapsed_s": round(time.time() - t0, 1),
     }
     with open(args.out, "w") as f:
         json.dump(report, f, indent=2)
         f.write("\n")
     print(json.dumps(report, indent=2))
-    ok = every_round_completed and loss_descended and aggregator_reelected
+    ok = (every_round_completed and loss_descended and aggregator_reelected
+          and obs_ok)
     print("CHAOS SOAK " + ("PASSED" if ok else "FAILED"))
     return 0 if ok else 1
 

@@ -228,6 +228,37 @@ def serve_breakdown(events: list[dict]) -> dict[str, float]:
     return out
 
 
+def galaxy_section(trace_dir: str) -> dict:
+    """The overseer galaxy matrix as banked by the flight recorders: union
+    of every ``blackbox-*.json`` dump in ``trace_dir`` keeping the freshest
+    roll-up per worker, plus how many peers each worker's OWN matrix held
+    at its last dump (gossip convergence, per dump)."""
+    matrix: dict = {}
+    coverage: dict = {}
+    for name in sorted(os.listdir(trace_dir)):
+        if not (name.startswith("blackbox-") and name.endswith(".json")):
+            continue
+        try:
+            with open(os.path.join(trace_dir, name)) as f:
+                box = json.load(f)
+        except (OSError, ValueError):
+            continue
+        gal = box.get("galaxy") or {}
+        coverage[str(box.get("worker"))] = len(gal)
+        for pid, vec in gal.items():
+            cur = matrix.get(pid)
+            if cur is None or float(vec.get("ts", 0) or 0) > float(
+                    cur.get("ts", 0) or 0):
+                matrix[pid] = vec
+    if not matrix:
+        return {}
+    return {
+        "workers_in_matrix": len(matrix),
+        "matrix_coverage_per_dump": coverage,
+        "matrix": {pid: matrix[pid] for pid in sorted(matrix)},
+    }
+
+
 def merge_report(trace_dir: str) -> tuple[dict, dict]:
     """Merge every worker trace in ``trace_dir`` by round id. Returns
     (report body, merged Chrome trace)."""
@@ -238,6 +269,12 @@ def merge_report(trace_dir: str) -> tuple[dict, dict]:
         for f in os.listdir(trace_dir)
         if f.startswith("trace-") and f.endswith(".jsonl")
     )
+    if not paths:
+        raise SystemExit(
+            f"no obs traces (trace-*.jsonl) under {trace_dir!r} -- the run "
+            "was not armed (export ODTP_OBS=1 and ODTP_OBS_DIR=<dir>) or "
+            "flushed its traces somewhere else; nothing to report on"
+        )
     workers = []
     for p in paths:
         events, meta = export.load_jsonl(p)
@@ -381,6 +418,8 @@ def merge_report(trace_dir: str) -> tuple[dict, dict]:
         if tx:
             wan["wan_tx_fraction"] = round(tx_wan / tx, 4)
 
+    galaxy = galaxy_section(trace_dir)
+
     body = {
         "workers_traced": len(workers),
         "trace_files": [os.path.basename(p) for p in paths],
@@ -388,6 +427,7 @@ def merge_report(trace_dir: str) -> tuple[dict, dict]:
         **({"per_fragment": fragments} if fragments else {}),
         **({"serve": serve} if serve else {}),
         **({"wire_wan_split": wan} if wan else {}),
+        **({"galaxy": galaxy} if galaxy else {}),
         "counters_total": {k: counters[k] for k in sorted(counters)},
     }
     return body, export.chrome_trace(workers)
@@ -553,6 +593,20 @@ def main() -> int:
         assert wan and wan["tx_bytes"] > 0, "no wire_wan_split in report"
         assert 0 <= wan["tx_bytes_wan"] <= wan["tx_bytes"]
         assert 0 <= wan["rx_bytes_wan"] <= wan["rx_bytes"]
+        # overseer roll-ups must have gossiped: the union matrix from the
+        # flight-recorder dumps covers the whole galaxy, and at least one
+        # worker's OWN matrix converged to every peer (no new sockets --
+        # roll-ups ride the rendezvous progress dicts)
+        gal = report.get("galaxy")
+        assert gal, "no galaxy section (flight recorders never dumped?)"
+        assert gal["workers_in_matrix"] == args.workers, (
+            f"galaxy matrix has {gal['workers_in_matrix']}/{args.workers} "
+            "workers"
+        )
+        assert max(gal["matrix_coverage_per_dump"].values()) == args.workers, (
+            "no worker's own overseer matrix converged to the full galaxy: "
+            f"{gal['matrix_coverage_per_dump']}"
+        )
     for f_ in fails:
         print("FAILURE:", f_)
     print("OBS REPORT " + ("PASSED" if ok else "FAILED"))

@@ -1,0 +1,286 @@
+#!/usr/bin/env python
+"""Merge per-worker flight-recorder dumps into one postmortem timeline.
+
+Every worker's flight recorder (opendiloco_tpu/obs/blackbox.py) leaves a
+``blackbox-<worker>-<pid>.json`` behind in ``ODTP_OBS_DIR`` —
+continuously while healthy, and on fatal signal / chaos fault /
+watchdog trip; a restarted rank writes a new file, so every incarnation
+survives. This tool merges them into a single causally-ordered round
+timeline:
+
+- per-round rows: which workers completed the round, which appear in it
+  only partially (a worker SIGKILLed mid-round leaves spans for a round
+  it never finished — exactly the evidence a postmortem needs),
+- every watchdog anomaly and injected chaos fault on the shared clock,
+- the union galaxy health matrix (freshest roll-up per worker),
+- summed ``anomaly_*`` counters across all dumps.
+
+Cross-worker ordering reuses the obs exporter's clock alignment
+(``export.clock_shifts``): each dump pins its monotonic origin to the
+wall clock, so events from different processes land on one timeline
+without assuming synchronized steady clocks.
+
+    python scripts/odtp_postmortem.py --dir /path/to/obs_dir
+    python scripts/odtp_postmortem.py --dir ... --out PM.json --trace-out PM_TRACE.json
+"""
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def load_boxes(obs_dir: str) -> list[dict]:
+    """Every parseable blackbox-*.json in ``obs_dir``, sorted by worker."""
+    boxes = []
+    try:
+        names = sorted(os.listdir(obs_dir))
+    except OSError:
+        return []
+    for name in names:
+        if not (name.startswith("blackbox-") and name.endswith(".json")):
+            continue
+        path = os.path.join(obs_dir, name)
+        try:
+            with open(path) as f:
+                box = json.load(f)
+        except (OSError, ValueError):
+            continue  # a dump mid-replace or truncated by the crash itself
+        box["_file"] = name
+        boxes.append(box)
+    boxes.sort(key=lambda b: str(b.get("worker")))
+    return boxes
+
+
+def merge_postmortem(boxes: list[dict]) -> dict:
+    """The merged postmortem body (JSON-ready). Pure: no I/O."""
+    from opendiloco_tpu.obs import export
+
+    # reuse the exporter's cross-process clock alignment: a dump is shaped
+    # like one worker's (id, events, meta) triple
+    workers = [
+        (b.get("worker"), b.get("events") or [], {
+            "origin_wall": float(b.get("origin_wall") or 0.0),
+        })
+        for b in boxes
+    ]
+    t0, shifts = export.clock_shifts(workers)
+
+    def wall_of(box_idx: int, ev: dict) -> float:
+        return t0 + (float(ev.get("ts", 0.0)) + shifts[box_idx]) / 1e6
+
+    # per (round, incarnation): did this dump's process complete it (an
+    # ``outer/round`` health instant or a ledger row), or merely
+    # participate (any event tagged with the round id — the killed
+    # worker's partial round)? Completion is tracked per DUMP, not per
+    # worker id: round join keys are per-worker epoch counters, so a
+    # restarted rank re-runs the same-named rounds, and its second
+    # incarnation completing ``grads-epoch-1`` must not erase the first
+    # incarnation's partial evidence for it. Wire/stage spans are tagged
+    # with the fingerprinted round key (``<join_key>:<fp>[/stage]``);
+    # fold them into the base join key so a worker killed mid-exchange
+    # lands in the same row the survivors completed.
+    rounds: dict[str, dict] = {}
+
+    def base_round(rid) -> str:
+        return str(rid).split(":")[0]
+
+    def slot(rid: str) -> dict:
+        return rounds.setdefault(rid, {
+            "round": rid, "completed": set(), "partial": set(),
+            "start_wall": None, "end_wall": None,
+            "group_size": 0, "elastic": False, "retries": 0,
+        })
+
+    anomalies: list[dict] = []
+    faults: list[dict] = []
+    galaxy: dict[str, dict] = {}
+    counters: dict[str, float] = {}
+
+    for i, box in enumerate(boxes):
+        wid = str(box.get("worker"))
+        for ev in box.get("events") or []:
+            args = ev.get("args") or {}
+            rid = args.get("round")
+            if not rid:
+                continue
+            r = slot(base_round(rid))
+            wall = wall_of(i, ev)
+            r["start_wall"] = wall if r["start_wall"] is None else min(
+                r["start_wall"], wall)
+            r["end_wall"] = wall if r["end_wall"] is None else max(
+                r["end_wall"], wall)
+            if ev.get("name") == "outer/round":
+                r["completed"].add((wid, i))
+                r["group_size"] = max(
+                    r["group_size"], int(args.get("group_size", 0) or 0))
+                r["elastic"] = r["elastic"] or bool(args.get("elastic"))
+                r["retries"] = max(
+                    r["retries"], int(args.get("retries", 0) or 0))
+            else:
+                r["partial"].add((wid, i))
+        for row in box.get("health") or []:
+            rid = row.get("round")
+            if rid:
+                slot(base_round(rid))["completed"].add((wid, i))
+        for rec in box.get("anomalies") or []:
+            anomalies.append({"worker": wid, **rec})
+        for rec in box.get("faults") or []:
+            faults.append({"worker": wid, **rec})
+        for pid, vec in (box.get("galaxy") or {}).items():
+            cur = galaxy.get(pid)
+            if cur is None or float(vec.get("ts", 0) or 0) > float(
+                    cur.get("ts", 0) or 0):
+                galaxy[pid] = vec
+        for k, v in ((box.get("metrics") or {}).get("counters") or {}).items():
+            counters[k] = counters.get(k, 0.0) + float(v)
+
+    timeline = []
+    for rid, r in rounds.items():
+        # a worker can be BOTH completed and partial for one round id:
+        # its killed incarnation left partial spans, its restart finished
+        # the same-named round
+        completed = sorted({w for w, _ in r["completed"]})
+        partial = sorted({
+            w for w, i in r["partial"] if (w, i) not in r["completed"]
+        })
+        timeline.append({
+            "round": rid,
+            "start_wall": r["start_wall"],
+            "end_wall": r["end_wall"],
+            "duration_s": (
+                round(r["end_wall"] - r["start_wall"], 6)
+                if r["start_wall"] is not None else None
+            ),
+            "group_size": r["group_size"],
+            "elastic": r["elastic"],
+            "retries": r["retries"],
+            "workers_completed": completed,
+            "workers_partial": partial,
+        })
+    # causal order: earliest aligned event wall time, then round id for
+    # rounds whose events all fell out of every ring
+    timeline.sort(key=lambda r: (r["start_wall"] or float("inf"), r["round"]))
+    anomalies.sort(key=lambda a: a.get("wall", 0.0))
+    faults.sort(key=lambda a: a.get("wall", 0.0))
+
+    anomaly_counters = {
+        k: v for k, v in sorted(counters.items())
+        if k.startswith("anomaly_")
+    }
+    return {
+        "postmortem": "odtp_postmortem",
+        "dumps_merged": len(boxes),
+        "workers": [
+            {
+                "worker": b.get("worker"),
+                "file": b.get("_file"),
+                "pid": b.get("pid"),
+                "last_reason": b.get("reason"),
+                "last_wall": b.get("wall"),
+                "dumps": b.get("dumps"),
+                "rounds": (b.get("galaxy") or {}).get(
+                    str(b.get("worker")), {}).get("rounds"),
+            }
+            for b in boxes
+        ],
+        "timeline": timeline,
+        "anomalies": anomalies,
+        "anomaly_counters": anomaly_counters,
+        "faults_injected": len(faults),
+        "fault_kinds": sorted({f.get("kind") for f in faults if f.get("kind")}),
+        "galaxy": galaxy,
+    }
+
+
+def chrome_trace_of(boxes: list[dict]) -> dict:
+    """The merged dumps as one Chrome trace (the black-box tail of every
+    worker side by side — the crash-window companion to OBS_TRACE.json)."""
+    from opendiloco_tpu.obs import export
+
+    return export.chrome_trace([
+        (b.get("worker"), b.get("events") or [], {
+            "origin_wall": float(b.get("origin_wall") or 0.0),
+            "identity": b.get("identity") or {},
+        })
+        for b in boxes
+    ])
+
+
+def render_text(pm: dict) -> str:
+    out = [f"postmortem: {pm['dumps_merged']} black box(es) merged"]
+    for w in pm["workers"]:
+        out.append(
+            f"  worker {w['worker']}: last dump '{w['last_reason']}' "
+            f"(x{w['dumps']}) at {w['last_wall']}"
+        )
+    out.append(f"rounds on timeline: {len(pm['timeline'])}")
+    for r in pm["timeline"]:
+        partial = f" partial={','.join(r['workers_partial'])}" if (
+            r["workers_partial"]) else ""
+        flags = "".join([
+            " ELASTIC" if r["elastic"] else "",
+            f" retries={r['retries']}" if r["retries"] else "",
+        ])
+        out.append(
+            f"  {r['round']}: {len(r['workers_completed'])} completed"
+            f"{flags}{partial}"
+        )
+    if pm["anomaly_counters"]:
+        out.append("anomaly counters: " + ", ".join(
+            f"{k}={int(v)}" for k, v in pm["anomaly_counters"].items()))
+    for a in pm["anomalies"]:
+        out.append(
+            f"  anomaly[{a.get('kind')}] worker {a.get('worker')} "
+            f"subject={a.get('subject', '')!r} at {a.get('wall')}"
+        )
+    out.append(
+        f"chaos faults injected: {pm['faults_injected']} "
+        f"({', '.join(pm['fault_kinds']) or 'none'})"
+    )
+    return "\n".join(out)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument(
+        "--dir", default=os.environ.get("ODTP_OBS_DIR") or ".",
+        help="directory holding blackbox-*.json dumps "
+        "(default: $ODTP_OBS_DIR, else .)",
+    )
+    ap.add_argument("--out", default="", help="write the merged JSON here")
+    ap.add_argument(
+        "--trace-out", default="",
+        help="also write the merged dumps as a Chrome trace (Perfetto)",
+    )
+    args = ap.parse_args()
+
+    boxes = load_boxes(args.dir)
+    if not boxes:
+        print(
+            f"no blackbox-*.json dumps under {args.dir!r}.\n"
+            "Flight recorders dump there when a run has ODTP_OBS=1 and "
+            "ODTP_OBS_DIR set (continuously per round, and on crash / "
+            "chaos fault / watchdog trip).",
+            file=sys.stderr,
+        )
+        return 1
+    pm = merge_postmortem(boxes)
+    print(render_text(pm))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(pm, f, indent=1)
+            f.write("\n")
+        print(f"wrote {args.out}")
+    if args.trace_out:
+        with open(args.trace_out, "w") as f:
+            json.dump(chrome_trace_of(boxes), f)
+            f.write("\n")
+        print(f"wrote {args.trace_out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,140 @@
+#!/usr/bin/env python
+"""Live galaxy health table from the overseer matrix — `top` for a DiLoCo run.
+
+Two sources, one table:
+
+- ``--peer HOST:PORT``: ask any worker's existing control port for its
+  converged overseer matrix (the new ``health`` frame — one one-shot RPC,
+  no new listener on the worker side). Because roll-ups gossip on the
+  rendezvous/linkstate channels, ONE peer's answer covers the galaxy.
+- ``--dir OBS_DIR``: offline mode; read the freshest flight-recorder
+  dump per worker (works after the run is gone).
+
+``--watch`` re-renders every ``--interval`` seconds until Ctrl-C.
+
+    python scripts/odtp_top.py --peer 127.0.0.1:31000 --watch
+    python scripts/odtp_top.py --dir /tmp/obs
+"""
+import argparse
+import asyncio
+import importlib.util
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+_COLS = (
+    ("worker", 10), ("round", 18), ("epoch", 5), ("loss", 8),
+    ("tok/s", 9), ("pg_norm", 9), ("wan_tx", 9), ("round_s", 8),
+    ("stale", 5), ("age_s", 6),
+)
+
+
+def _load_postmortem_mod():
+    spec = importlib.util.spec_from_file_location(
+        "odtp_postmortem", os.path.join(REPO, "scripts", "odtp_postmortem.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def matrix_from_peer(peer: str, timeout: float = 10.0) -> dict:
+    """The overseer matrix held by one worker, via its control port."""
+    from opendiloco_tpu.diloco import wire
+
+    host, port = peer.rsplit(":", 1)
+
+    async def _ask():
+        msg, meta, _ = await wire.request(
+            host, int(port), "health", {}, timeout=timeout
+        )
+        if msg != "ok":
+            raise RuntimeError(f"peer replied {msg!r}: {meta}")
+        return meta.get("matrix") or {}
+
+    return asyncio.run(_ask())
+
+
+def matrix_from_dir(obs_dir: str) -> dict:
+    """Union matrix from on-disk flight-recorder dumps, freshest roll-up
+    per worker (same freshness rule the overseer merge uses)."""
+    pm = _load_postmortem_mod()
+    matrix: dict = {}
+    for box in pm.load_boxes(obs_dir):
+        for pid, vec in (box.get("galaxy") or {}).items():
+            cur = matrix.get(pid)
+            if cur is None or float(vec.get("ts", 0) or 0) > float(
+                    cur.get("ts", 0) or 0):
+                matrix[pid] = vec
+    return matrix
+
+
+def _fmt(v, width: int) -> str:
+    if v is None:
+        s = "-"
+    elif isinstance(v, float):
+        s = f"{v:.3g}"
+    else:
+        s = str(v)
+    return s[:width].rjust(width)
+
+
+def render(matrix: dict, now: float) -> str:
+    header = " ".join(name.rjust(w) for name, w in _COLS)
+    lines = [header, "-" * len(header)]
+    rows = sorted(matrix.items(), key=lambda kv: str(kv[0]))
+    for pid, vec in rows:
+        stages = vec.get("stages") or {}
+        ts = float(vec.get("ts", 0) or 0)
+        cells = (
+            vec.get("worker", pid), vec.get("round"), vec.get("epoch"),
+            vec.get("loss"), vec.get("tokens_per_s"), vec.get("pg_norm"),
+            vec.get("wire_tx_bytes_wan"), stages.get("round_s"),
+            vec.get("staleness"), round(now - ts, 1) if ts else None,
+        )
+        lines.append(" ".join(
+            _fmt(c, w) for c, (_, w) in zip(cells, _COLS)))
+    lines.append(f"{len(rows)} worker(s) in matrix")
+    return "\n".join(lines)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    src = ap.add_mutually_exclusive_group(required=True)
+    src.add_argument(
+        "--peer", default="",
+        help="HOST:PORT of any live worker's control port",
+    )
+    src.add_argument(
+        "--dir", default="",
+        help="read flight-recorder dumps from this directory instead",
+    )
+    ap.add_argument("--watch", action="store_true", help="refresh forever")
+    ap.add_argument("--interval", type=float, default=2.0)
+    args = ap.parse_args()
+
+    while True:
+        try:
+            matrix = (
+                matrix_from_peer(args.peer) if args.peer
+                else matrix_from_dir(args.dir)
+            )
+        except Exception as exc:
+            print(f"fetch failed: {exc}", file=sys.stderr)
+            if not args.watch:
+                return 1
+            time.sleep(args.interval)
+            continue
+        if args.watch:
+            print("\033[2J\033[H", end="")  # clear screen, home cursor
+        print(render(matrix, time.time()))
+        if not args.watch:
+            return 0 if matrix else 1
+        time.sleep(args.interval)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -99,7 +99,8 @@ def test_thread_safety(monkeypatch):
         t.start()
     for t in threads:
         t.join()
-    assert len(tr.events) == n_threads * n_iter
+    # one "X" span event + one "C" counter-track event per gauge() call
+    assert len(tr.events) == 2 * n_threads * n_iter
     counters = tr.counters()
     for i in range(n_threads):
         assert counters[("ops", (("worker", i),))] == n_iter
