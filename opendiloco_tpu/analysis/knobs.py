@@ -22,7 +22,7 @@ class Knob:
     name: str
     type: str  # bool | int | float | str | spec | path
     default: str  # canonical code default ("" = unset)
-    subsystem: str  # transport | diloco | chaos | obs | serve | model | bench | analysis
+    subsystem: str  # transport | diloco | chaos | obs | serve | fleet | model | bench | analysis
     doc: str  # one line, lands verbatim in the README table
     doc_default: str = ""  # display override when default="" reads poorly
 
@@ -61,6 +61,9 @@ KNOBS: tuple[Knob, ...] = (
     Knob("ODTP_SERVE_BENCH_OUT", "path", "", "bench",
          "Output path override for `scripts/serve_bench.py`.",
          doc_default="repo artifact"),
+    Knob("ODTP_SERVE_FLEET_BENCH_OUT", "path", "", "bench",
+         "Output path override for `scripts/serve_fleet_bench.py`.",
+         doc_default="repo artifact"),
     Knob("ODTP_STREAM_BENCH_OUT", "path", "", "bench",
          "Output path override for `bench_outer.py --stream`.",
          doc_default="repo artifact"),
@@ -84,6 +87,19 @@ KNOBS: tuple[Knob, ...] = (
     Knob("ODTP_TOPK_DENSITY", "float", "0.03125", "diloco",
          "Fraction of largest-|x| elements the `topk` codec keeps (1/32 "
          "default ~= 0.25 B/elem on the wire)."),
+    # -- fleet ----------------------------------------------------------------
+    Knob("ODTP_FLEET_CODEC", "str", "", "fleet",
+         "Delta-push codec override for the serving fleet "
+         "(`blockwise4bit` or `topk`); keyframes always ride the "
+         "onboarding state codec.", doc_default="config"),
+    Knob("ODTP_FLEET_KEYFRAME_EVERY", "int", "", "fleet",
+         "Full-snapshot keyframe cadence override (outer epochs) for the "
+         "fleet delta publisher; keyframes re-pin replica bit-exactness "
+         "and onboard (re)joining replicas.", doc_default="config"),
+    Knob("ODTP_FLEET_PUSH_INTERVAL_S", "float", "", "fleet",
+         "Seconds between fleet pusher wake-ups per replica (each wake-up "
+         "ships pending delta/keyframe frames or a staleness ping).",
+         doc_default="config"),
     # -- model ----------------------------------------------------------------
     Knob("ODTP_SCAN_UNROLL", "int", "", "model",
          "Overrides the scan-over-layers unroll factor (experiments and "

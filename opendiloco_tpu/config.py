@@ -290,6 +290,65 @@ class ServeConfig(BaseModel):
         return self
 
 
+class FleetConfig(BaseModel):
+    """Serving fleet (opendiloco_tpu/fleet): N replica engines fed by
+    delta pushes from the trainer's masters, behind one front-end router."""
+
+    model_config = ConfigDict(extra="forbid")
+
+    enabled: bool = False
+    replicas: int = 2
+    host: str = "127.0.0.1"
+    port: int = 0  # router ingress; 0 -> ephemeral
+    # run replicas inside the trainer process (tests/benches) instead of
+    # as `python -m opendiloco_tpu.fleet.replica` subprocesses
+    inprocess: bool = False
+    # delta-push channel: per-fragment master deltas in this codec with
+    # per-replica error feedback; a full state-codec keyframe every
+    # keyframe_every epochs re-pins bit-exactness and onboards
+    # (re)joining replicas without history replay
+    codec: Literal["blockwise4bit", "topk"] = "blockwise4bit"
+    fragments: int = 4
+    keyframe_every: int = 8
+    error_feedback: bool = True
+    push_interval_s: float = 0.25
+    # health bound: a replica whose serving weights lag the trainer by
+    # MORE than this many outer rounds reports itself stale and the
+    # router stops preferring it
+    max_stale_rounds: int = 2
+    # per-replica engine geometry (same semantics as ServeConfig)
+    max_batch: int = 4
+    max_context: int = 256
+    prefill_buckets: list[int] = [32, 128]
+    max_queue: int = 1024
+    prefix_cache: bool = True
+
+    @field_validator("prefill_buckets", mode="before")
+    @classmethod
+    def _coerce_buckets(cls, v: Any) -> Any:
+        if isinstance(v, str):
+            return [int(x) for x in v.split(",") if x.strip()]
+        return v
+
+    @model_validator(mode="after")
+    def _geometry(self):
+        if self.replicas < 1:
+            raise ValueError("fleet.replicas must be >= 1")
+        if self.fragments < 1:
+            raise ValueError("fleet.fragments must be >= 1")
+        if self.keyframe_every < 1:
+            raise ValueError("fleet.keyframe_every must be >= 1")
+        if self.max_stale_rounds < 0:
+            raise ValueError("fleet.max_stale_rounds must be >= 0")
+        if not self.prefill_buckets:
+            raise ValueError("fleet.prefill_buckets must be non-empty")
+        if max(self.prefill_buckets) > self.max_context:
+            raise ValueError(
+                "largest fleet prefill bucket exceeds fleet.max_context"
+            )
+        return self
+
+
 class Config(BaseModel):
     """Top-level training config (reference: open_diloco/train_fsdp.py:104-129)."""
 
@@ -381,6 +440,9 @@ class Config(BaseModel):
     diloco: Optional[DilocoConfig] = None  # None -> plain data-parallel mode
     # in-process serving plane; None or enabled=False -> training only
     serve: Optional[ServeConfig] = None
+    # serving fleet (replica galaxy + delta-push sync + router); None or
+    # enabled=False -> no fleet
+    fleet: Optional[FleetConfig] = None
 
     @field_validator("adam_betas", mode="before")
     @classmethod

@@ -84,6 +84,35 @@ PLAN_HASH_ALGO = "sha1"
 PLAN_HASH_HEXLEN = 12
 PLAN_META_KEY = "plan"
 
+# -- serving-fleet delta-push frames ------------------------------------------
+#
+# The fleet push channel (fleet/wire.py) reuses the ODTP frame verbatim:
+# [MAGIC][header_len][{"type", "meta", "payload_len"}][payload]. A weight
+# push is either a "keyframe" (every leaf, state-codec encoded — the same
+# full-snapshot layout install_wire consumes) or a "delta" (one fragment's
+# leaves, outer-codec encoded master-minus-shadow). Both carry a "leaves"
+# list in meta; each entry slices the concatenated payload:
+#
+#   {"i": leaf index, "shape": full leaf shape, "off": payload byte offset,
+#    "len": payload byte length, "meta": per-leaf codec meta}
+#
+# "ping" frames carry no payload — they advance the replica's view of the
+# trainer epoch so staleness accounting runs even when no weights move.
+
+FLEET_FRAME_KINDS = ("hello", "ping", "keyframe", "delta", "ok", "error")
+FLEET_KEYFRAME_META_FIELDS = ("kind", "epoch", "tepoch", "codec", "leaves")
+FLEET_DELTA_META_FIELDS = (
+    "kind",
+    "epoch",
+    "tepoch",
+    "base_epoch",
+    "frag",
+    "nfrag",
+    "codec",
+    "leaves",
+)
+FLEET_LEAF_META_FIELDS = ("i", "shape", "off", "len", "meta")
+
 # -- codec wire-record geometry ----------------------------------------------
 #
 # chunk_align: chunk element offsets must be multiples of this (blockwise

@@ -725,6 +725,13 @@ class TcpBackend(OuterBackend):
                         writer, "ok",
                         {"matrix": ov.matrix() if ov is not None else {}},
                     )
+                elif msg == "fleet":
+                    # serving-fleet roll-up (publisher/router/replica view
+                    # of this worker's plane; {"enabled": False} when no
+                    # fleet runs here)
+                    from opendiloco_tpu import fleet as _fleet
+
+                    await send_frame(writer, "ok", _fleet.status())
                 elif msg == "fetch_state":
                     if self._state_provider is None:
                         await send_frame(writer, "error", {"error": "no state"})

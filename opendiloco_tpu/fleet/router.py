@@ -1,0 +1,454 @@
+"""Front-end router: one ingress over a galaxy of serving replicas.
+
+Speaks the same two protocols as ``serve/server.py`` (HTTP ``POST
+/generate`` + JSONL) so clients cannot tell a fleet from a single
+replica. Dispatch is least-loaded with a prefix-affinity override: a
+request sharing a long prompt prefix with something a replica recently
+served routes there, where the KV prefix cache is warm (PR 11's
+scheduler-side reuse), unless that replica is already clearly busier
+than the least-loaded one.
+
+Replica death is a non-event by design: a connection error (or a
+retryable reject) marks the backend dead, trips the dead-peer watchdog,
+and the in-flight request is re-dispatched to another replica — the
+client sees one answer, never an error, as long as any replica lives.
+A health-probe thread keeps polling dead backends' ``/healthz`` so a
+rejoined (or respawned) replica resumes taking traffic without any
+registration call, and replicas self-reporting ``stale`` (weight pushes
+stalled past ``max_stale_rounds``) are dispatched to only when nothing
+fresh is alive.
+
+The router is engine-free and jax-free: it moves JSON lines between
+sockets (``common_prefix_len`` from serve/kvcache.py is numpy-only).
+"""
+from __future__ import annotations
+
+import collections
+import json
+import logging
+import socket
+import threading
+import time
+from typing import Optional
+
+from opendiloco_tpu import obs
+from opendiloco_tpu.serve.kvcache import common_prefix_len
+
+log = logging.getLogger(__name__)
+
+
+def _bind_with_fallback(host: str, port: int, what: str) -> socket.socket:
+    """Same contract as serve.server.bind_with_fallback, duplicated here
+    because importing serve.server pulls the jitted engine (jax) and the
+    router must stay importable in an engine-free process."""
+    sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    try:
+        sock.bind((host, port))
+    except OSError as e:
+        if port == 0:
+            sock.close()
+            raise
+        log.warning(
+            "%s port %d unavailable (%s); falling back to an ephemeral port",
+            what,
+            port,
+            e,
+        )
+        sock.bind((host, 0))
+    return sock
+
+_HTTP_VERBS = (b"GET ", b"POST", b"PUT ", b"HEAD", b"DELE", b"OPTI", b"PATC")
+
+# replica-side rejects worth trying on another replica; anything else is
+# the request's own fault (bad prompt, too long) and is returned as-is
+_RETRYABLE = ("server stopped", "queue full", "timeout")
+
+
+class _Backend:
+    def __init__(self, rid: str, host: str, port: int):
+        self.rid = rid
+        self.host = host
+        self.port = int(port)
+        self.dead = False
+        self.stale = False
+        self.ready = True
+        self.inflight = 0
+        self.dispatched = 0
+        self.lock = threading.Lock()
+        self.pool: list[socket.socket] = []
+        # recent prompts, newest last: the affinity signal for warm-KV
+        # routing (mirrors what the replica's prefix cache may still hold)
+        self.recent: collections.deque = collections.deque(maxlen=32)
+
+    def acquire(self, timeout: float) -> socket.socket:
+        with self.lock:
+            if self.pool:
+                return self.pool.pop()
+        conn = socket.create_connection((self.host, self.port), timeout=2.0)
+        conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        conn.settimeout(timeout)
+        return conn
+
+    def release(self, conn: socket.socket) -> None:
+        with self.lock:
+            if not self.dead and len(self.pool) < 8:
+                self.pool.append(conn)
+                return
+        try:
+            conn.close()
+        except OSError:
+            pass
+
+    def close_pool(self) -> None:
+        with self.lock:
+            pool, self.pool = self.pool, []
+        for conn in pool:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+
+class FleetRouter:
+    def __init__(
+        self,
+        *,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        request_timeout: float = 120.0,
+        affinity_min_tokens: int = 8,
+        affinity_max_extra_inflight: int = 2,
+        probe_interval_s: float = 1.0,
+    ):
+        self.request_timeout = float(request_timeout)
+        self.affinity_min_tokens = int(affinity_min_tokens)
+        self.affinity_max_extra_inflight = int(affinity_max_extra_inflight)
+        self.probe_interval_s = float(probe_interval_s)
+        self._backends: dict[str, _Backend] = {}
+        self._lock = threading.Lock()
+        self.redispatches = 0
+        self.deaths = 0
+        self._stop = threading.Event()
+        self._sock = _bind_with_fallback(host, port, "fleet-router")
+        self._sock.listen(64)
+        self.host = host
+        self.port = self._sock.getsockname()[1]
+        threading.Thread(
+            target=self._accept_loop, name="odtp-fleet-router", daemon=True
+        ).start()
+        threading.Thread(
+            target=self._probe_loop, name="odtp-fleet-probe", daemon=True
+        ).start()
+
+    # -- membership ----------------------------------------------------------
+
+    def add_replica(self, rid: str, host: str, port: int) -> None:
+        with self._lock:
+            self._backends[rid] = _Backend(rid, host, port)
+        self._publish_live()
+
+    def remove_replica(self, rid: str) -> None:
+        with self._lock:
+            b = self._backends.pop(rid, None)
+        if b is not None:
+            b.close_pool()
+        self._publish_live()
+
+    def _publish_live(self) -> None:
+        with self._lock:
+            live = sum(1 for b in self._backends.values() if not b.dead)
+        obs.gauge("fleet_replicas_live", live)
+
+    # -- dispatch ------------------------------------------------------------
+
+    def _candidates(self, exclude: set) -> list:
+        with self._lock:
+            backends = [
+                b
+                for b in self._backends.values()
+                if b.rid not in exclude and not b.dead
+            ]
+        fresh = [b for b in backends if b.ready and not b.stale]
+        return fresh or backends
+
+    def _pick(self, prompt: list, exclude: set) -> Optional[_Backend]:
+        cands = self._candidates(exclude)
+        if not cands:
+            return None
+        least = min(cands, key=lambda b: b.inflight)
+        if len(prompt) >= self.affinity_min_tokens:
+            best, best_p = None, 0
+            for b in cands:
+                for recent in b.recent:
+                    p = common_prefix_len(prompt, recent)
+                    if p > best_p:
+                        best, best_p = b, p
+            if (
+                best is not None
+                and best_p >= self.affinity_min_tokens
+                and best.inflight
+                <= least.inflight + self.affinity_max_extra_inflight
+            ):
+                obs.count("fleet_router_affinity_hits", replica=best.rid)
+                return best
+        return least
+
+    def _forward(self, b: _Backend, payload: dict) -> dict:
+        """One JSONL round trip on a pooled connection. The replica's
+        JSONL handler answers one line at a time per connection, so a
+        connection carries exactly one in-flight request."""
+        conn = b.acquire(self.request_timeout)
+        try:
+            conn.sendall((json.dumps(payload) + "\n").encode())
+            buf = b""
+            while b"\n" not in buf:
+                chunk = conn.recv(65536)
+                if not chunk:
+                    raise OSError("replica closed mid-request")
+                buf += chunk
+            line, _, rest = buf.partition(b"\n")
+            out = json.loads(line.decode())
+        except (OSError, ValueError):
+            try:
+                conn.close()
+            except OSError:
+                pass
+            raise
+        if rest:
+            # a pooled conn must be quiescent; drop it rather than reuse
+            try:
+                conn.close()
+            except OSError:
+                pass
+        else:
+            b.release(conn)
+        return out
+
+    def dispatch(self, payload: dict) -> dict:
+        prompt = [int(t) for t in payload.get("prompt") or []]
+        tried: set = set()
+        last_error = "no live replicas"
+        with self._lock:
+            attempts = max(1, 2 * len(self._backends))
+        for _ in range(attempts):
+            b = self._pick(prompt, tried)
+            if b is None:
+                break
+            b.inflight += 1
+            try:
+                out = self._forward(b, payload)
+            except (OSError, ValueError) as e:
+                last_error = f"replica {b.rid} failed: {e}"
+                tried.add(b.rid)
+                self._mark_dead(b)
+                self.redispatches += 1
+                obs.count("fleet_router_redispatch", replica=b.rid)
+                continue
+            finally:
+                b.inflight -= 1
+            if out.get("error") in _RETRYABLE:
+                last_error = f"replica {b.rid}: {out['error']}"
+                tried.add(b.rid)
+                self.redispatches += 1
+                obs.count("fleet_router_redispatch", replica=b.rid)
+                continue
+            b.dispatched += 1
+            b.recent.append(prompt)
+            obs.count("fleet_router_dispatch", replica=b.rid)
+            return out
+        out = {"error": last_error}
+        if payload.get("id") is not None:
+            out["id"] = payload["id"]
+        return out
+
+    def _mark_dead(self, b: _Backend) -> None:
+        if not b.dead:
+            b.dead = True
+            self.deaths += 1
+            b.close_pool()
+            obs.count("fleet_replica_deaths", replica=b.rid)
+            wd = obs.anomaly.watchdog()
+            if wd is not None:
+                wd.fleet_replica_dead(b.rid)
+            log.warning("fleet replica %s marked dead", b.rid)
+        self._publish_live()
+
+    # -- health probing ------------------------------------------------------
+
+    def _probe(self, b: _Backend) -> None:
+        try:
+            conn = socket.create_connection((b.host, b.port), timeout=1.0)
+        except OSError:
+            if not b.dead:
+                self._mark_dead(b)
+            return
+        try:
+            conn.settimeout(2.0)
+            conn.sendall(b"GET /healthz HTTP/1.0\r\n\r\n")
+            raw = b""
+            while True:
+                chunk = conn.recv(65536)
+                if not chunk:
+                    break
+                raw += chunk
+            body = raw.partition(b"\r\n\r\n")[2]
+            health = json.loads(body.decode() or "{}")
+        except (OSError, ValueError):
+            if not b.dead:
+                self._mark_dead(b)
+            return
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+        if b.dead:
+            log.info("fleet replica %s is back; resuming dispatch", b.rid)
+            obs.count("fleet_replica_rejoins", replica=b.rid)
+        b.dead = False
+        b.stale = bool(health.get("stale", False))
+        b.ready = bool(health.get("ready", True)) and bool(
+            health.get("ok", True)
+        )
+        self._publish_live()
+
+    def _probe_loop(self) -> None:
+        while not self._stop.wait(self.probe_interval_s):
+            with self._lock:
+                backends = list(self._backends.values())
+            for b in backends:
+                self._probe(b)
+
+    # -- front-end server ----------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            threading.Thread(
+                target=self._handle, args=(conn,), daemon=True
+            ).start()
+
+    def _handle(self, conn: socket.socket) -> None:
+        try:
+            conn.settimeout(self.request_timeout)
+            head = conn.recv(4096)
+            if not head:
+                return
+            if head[:4].ljust(4) in _HTTP_VERBS or head[:5] == b"PATCH":
+                self._handle_http(conn, head)
+            else:
+                self._handle_jsonl(conn, head)
+        except OSError:
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _handle_http(self, conn: socket.socket, head: bytes) -> None:
+        while b"\r\n\r\n" not in head and len(head) < 65536:
+            chunk = conn.recv(4096)
+            if not chunk:
+                break
+            head += chunk
+        header, _, body = head.partition(b"\r\n\r\n")
+        lines = header.split(b"\r\n")
+        method, path = (lines[0].split(b" ") + [b"", b""])[:2]
+        clen = 0
+        for ln in lines[1:]:
+            if ln.lower().startswith(b"content-length:"):
+                clen = int(ln.split(b":", 1)[1].strip() or 0)
+        while len(body) < clen:
+            chunk = conn.recv(65536)
+            if not chunk:
+                break
+            body += chunk
+        if method == b"POST" and path.startswith(b"/generate"):
+            try:
+                payload = json.loads(body.decode() or "{}")
+            except (ValueError, UnicodeDecodeError):
+                self._respond(conn, 400, {"error": "malformed JSON body"})
+                return
+            out = self.dispatch(payload)
+            self._respond(conn, 400 if "error" in out else 200, out)
+        elif method == b"GET" and path.startswith(b"/healthz"):
+            with self._lock:
+                live = sum(1 for b in self._backends.values() if not b.dead)
+                total = len(self._backends)
+            self._respond(
+                conn, 200, {"ok": live > 0, "live": live, "replicas": total}
+            )
+        elif method == b"GET" and path.startswith(b"/stats"):
+            self._respond(conn, 200, self.stats())
+        else:
+            self._respond(conn, 404, {"error": "unknown route"})
+
+    def _respond(self, conn: socket.socket, status: int, obj: dict) -> None:
+        body = (json.dumps(obj) + "\n").encode()
+        reason = {200: "OK", 400: "Bad Request", 404: "Not Found"}.get(
+            status, "Error"
+        )
+        head = (
+            f"HTTP/1.0 {status} {reason}\r\n"
+            "Content-Type: application/json\r\n"
+            f"Content-Length: {len(body)}\r\n\r\n"
+        ).encode()
+        conn.sendall(head + body)
+
+    def _handle_jsonl(self, conn: socket.socket, buf: bytes) -> None:
+        while True:
+            while b"\n" in buf:
+                line, _, buf = buf.partition(b"\n")
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    payload = json.loads(line.decode())
+                except (ValueError, UnicodeDecodeError):
+                    out = {"error": "malformed JSON line"}
+                else:
+                    out = self.dispatch(payload)
+                conn.sendall((json.dumps(out) + "\n").encode())
+            chunk = conn.recv(65536)
+            if not chunk:
+                return
+            buf += chunk
+
+    # -- introspection -------------------------------------------------------
+
+    def stats(self) -> dict:
+        with self._lock:
+            backends = dict(self._backends)
+        return {
+            "port": self.port,
+            "redispatches": self.redispatches,
+            "deaths": self.deaths,
+            "replicas": {
+                rid: {
+                    "host": b.host,
+                    "port": b.port,
+                    "dead": b.dead,
+                    "stale": b.stale,
+                    "ready": b.ready,
+                    "inflight": b.inflight,
+                    "dispatched": b.dispatched,
+                }
+                for rid, b in backends.items()
+            },
+        }
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        with self._lock:
+            backends = list(self._backends.values())
+        for b in backends:
+            b.close_pool()

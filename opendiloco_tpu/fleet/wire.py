@@ -1,0 +1,70 @@
+"""Synchronous ODTP framing for the fleet push channel.
+
+Byte-identical to the asyncio control plane's frames (diloco/wire.py):
+``[4B magic "ODTP"][4B BE header_len][header JSON][payload]`` with the
+header carrying ``{"type", "meta", "payload_len"}``. The push channel is
+a plain blocking socket per (publisher, replica) pair — no asyncio loop
+on either side — so this module provides the sync twins of
+``send_frame``/``read_frame``, importing every layout constant from
+``diloco/schema.py`` (the wire-schema lint rejects struct literals
+anywhere else).
+"""
+from __future__ import annotations
+
+import json
+import socket
+from typing import Any, Optional
+
+from opendiloco_tpu.diloco.schema import (  # single layout declaration
+    FRAME_HDR as _HDR,
+    MAGIC,
+    MAX_HEADER,
+)
+
+
+class FleetWireError(RuntimeError):
+    pass
+
+
+def send_frame(
+    sock: socket.socket,
+    msg_type: str,
+    meta: dict[str, Any],
+    payload: bytes = b"",
+) -> None:
+    header = json.dumps(
+        {"type": msg_type, "meta": meta, "payload_len": len(payload)}
+    ).encode()
+    # header and payload written separately: no large concat copy
+    sock.sendall(_HDR.pack(MAGIC, len(header)) + header)
+    if payload:
+        sock.sendall(payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        k = sock.recv_into(view[got:], n - got)
+        if k == 0:
+            raise FleetWireError("connection closed mid-frame")
+        got += k
+    return bytes(buf)
+
+
+def recv_frame(
+    sock: socket.socket, *, timeout: Optional[float] = None
+) -> tuple[str, dict[str, Any], bytes]:
+    if timeout is not None:
+        sock.settimeout(timeout)
+    hdr = _recv_exact(sock, _HDR.size)
+    magic, hlen = _HDR.unpack(hdr)
+    if magic != MAGIC or hlen > MAX_HEADER:
+        raise FleetWireError(f"bad frame header: magic={magic!r} hlen={hlen}")
+    header = json.loads(_recv_exact(sock, hlen))
+    payload = b""
+    n = int(header.get("payload_len", 0))
+    if n:
+        payload = _recv_exact(sock, n)
+    return header["type"], header.get("meta", {}), payload

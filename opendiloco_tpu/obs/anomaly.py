@@ -228,6 +228,12 @@ class Watchdog:
                 bound=float(bound),
             )
 
+    def fleet_replica_dead(self, replica_id: str) -> bool:
+        """Fleet-router hook: a serving replica stopped answering. Same
+        anomaly kind as a departed training peer — the subject prefix
+        tells the two planes apart in the counters."""
+        return self._trip("dead_peer", subject=f"replica:{replica_id}")
+
     # -- stall deadline -------------------------------------------------------
     def note_progress(self, epoch: Optional[int] = None) -> None:
         """Any sign of outer progress resets the stall deadline. Called

@@ -43,10 +43,17 @@ class Request:
     tokens: list = dataclasses.field(default_factory=list)
     error: Optional[str] = None
     epoch: Optional[int] = None  # weights epoch that finished the request
+    cancelled: bool = False
     _done: threading.Event = dataclasses.field(default_factory=threading.Event)
 
     def wait(self, timeout: Optional[float] = None) -> bool:
         return self._done.wait(timeout)
+
+    def cancel(self) -> None:
+        """Ask the loop to retire this request (client went away). The
+        slot frees on the next scheduler iteration — decoding stops
+        instead of running the remaining tokens into a dead socket."""
+        self.cancelled = True
 
     def finish(self, error: Optional[str] = None) -> None:
         self.error = error
@@ -97,6 +104,7 @@ class ContinuousBatcher:
         self.completed = 0
         self.rejected = 0
         self.failed = 0
+        self.cancelled = 0
         self.total_new_tokens = 0
         self._latencies: collections.deque = collections.deque(maxlen=4096)
         self._ttfts: collections.deque = collections.deque(maxlen=4096)
@@ -197,6 +205,7 @@ class ContinuousBatcher:
     def _run(self) -> None:
         try:
             while not self._stop.is_set():
+                self._sweep_cancelled()
                 admitted = self._admit()
                 stepped = self._decode()
                 if stepped:
@@ -223,6 +232,29 @@ class ContinuousBatcher:
             for req in pending:
                 self.failed += 1
                 req.finish(self.loop_error)
+
+    def _sweep_cancelled(self) -> None:
+        """Retire cancelled requests: queued ones finish immediately,
+        active ones free their slot before the next decode step."""
+        with self._cond:
+            if any(r.cancelled for r in self._queue):
+                keep: collections.deque = collections.deque()
+                for req in self._queue:
+                    if req.cancelled:
+                        self.cancelled += 1
+                        req.finish("cancelled")
+                        obs.count("serve_cancelled")
+                    else:
+                        keep.append(req)
+                self._queue = keep
+        gone = [s for s, st in self._active.items() if st.req.cancelled]
+        for slot in gone:
+            st = self._active.pop(slot)
+            self.slots.free(slot)
+            self.cancelled += 1
+            st.req.epoch = self.engine.weights_epoch
+            st.req.finish("cancelled")
+            obs.count("serve_cancelled")
 
     def _find_prefix(self, prompt: list) -> tuple[Optional[int], int]:
         """Longest usable shared prompt prefix among the live slots.
@@ -411,6 +443,7 @@ class ContinuousBatcher:
             "completed": self.completed,
             "rejected": self.rejected,
             "failed": self.failed,
+            "cancelled": self.cancelled,
             "queued": len(self._queue),
             "active": self.slots.num_active,
             "decode_steps": self.decode_steps,
@@ -424,6 +457,7 @@ class ContinuousBatcher:
             "ttft_ms": {"p50": pct(ttft, 50), "p99": pct(ttft, 99)},
             "weight_swaps": self.engine.swap_count,
             "weights_epoch": self.engine.weights_epoch,
+            "staleness": self.engine.staleness(),
             # int keys in numeric order: json.dump(sort_keys=True) sorts
             # dict items BEFORE stringifying, so the artifact reads
             # 0, 1, 2, ... 10 instead of the lexicographic "0", "1", "10"

@@ -23,9 +23,11 @@ from __future__ import annotations
 
 import json
 import logging
+import select
 import socket
 import threading
-from typing import Optional
+import time
+from typing import Callable, Optional, Union
 
 from opendiloco_tpu.serve.scheduler import ContinuousBatcher
 
@@ -64,9 +66,15 @@ class ServeServer:
         host: str = "127.0.0.1",
         port: int = 0,
         request_timeout: float = 300.0,
+        identity: Optional[Union[dict, Callable[[], dict]]] = None,
     ):
         self.batcher = batcher
         self.request_timeout = float(request_timeout)
+        # who this serving process is (worker/replica id, staleness, ...):
+        # a dict, or a callable re-evaluated per request so dynamic fields
+        # like staleness stay live. Folded into /healthz and /stats so a
+        # fleet router (or odtp_top) can tell replicas apart.
+        self._identity = identity
         self._sock = bind_with_fallback(host, port, "serve")
         self._sock.listen(32)
         self.host = host
@@ -107,16 +115,46 @@ class ServeServer:
             except OSError:
                 pass
 
+    # -- identity ------------------------------------------------------------
+
+    def identity(self) -> dict:
+        ident = self._identity
+        if ident is None:
+            return {}
+        return dict(ident() if callable(ident) else ident)
+
     # -- one generation ----------------------------------------------------
 
-    def _generate(self, payload: dict) -> dict:
+    @staticmethod
+    def _disconnected(conn: socket.socket) -> bool:
+        """True when the peer closed the connection (EOF is readable)."""
+        try:
+            readable, _, _ = select.select([conn], [], [], 0)
+            if not readable:
+                return False
+            return conn.recv(1, socket.MSG_PEEK) == b""
+        except (OSError, ValueError):
+            return True
+
+    def _generate(
+        self, payload: dict, conn: Optional[socket.socket] = None
+    ) -> Optional[dict]:
         req = self.batcher.submit(
             payload.get("prompt") or [],
             max_new_tokens=int(payload.get("max_new_tokens", 16)),
             eos_id=payload.get("eos_id"),
         )
-        if not req.wait(self.request_timeout):
-            return {"error": "timeout", "id": payload.get("id")}
+        # wait in slices, watching the client socket: a disconnect
+        # mid-generation retires the slot immediately instead of decoding
+        # the remaining tokens into a dead socket (None = nobody to answer)
+        deadline = time.monotonic() + self.request_timeout
+        while not req.wait(0.05):
+            if conn is not None and self._disconnected(conn):
+                req.cancel()
+                return None
+            if time.monotonic() >= deadline:
+                req.cancel()
+                return {"error": "timeout", "id": payload.get("id")}
         out = {
             "tokens": req.tokens,
             "epoch": req.epoch,
@@ -157,8 +195,9 @@ class ServeServer:
             except (ValueError, UnicodeDecodeError):
                 self._respond(conn, 400, {"error": "malformed JSON body"})
                 return
-            out = self._generate(payload)
-            self._respond(conn, 400 if "error" in out else 200, out)
+            out = self._generate(payload, conn)
+            if out is not None:
+                self._respond(conn, 400 if "error" in out else 200, out)
         elif method == b"GET" and path.startswith(b"/healthz"):
             self._respond(
                 conn,
@@ -168,10 +207,15 @@ class ServeServer:
                     "weights_epoch": self.batcher.engine.weights_epoch,
                     "staleness": self.batcher.engine.staleness(),
                     "free_slots": self.batcher.slots.num_free,
+                    **self.identity(),
                 },
             )
         elif method == b"GET" and path.startswith(b"/stats"):
-            self._respond(conn, 200, self.batcher.stats())
+            stats = self.batcher.stats()
+            ident = self.identity()
+            if ident:
+                stats["identity"] = ident
+            self._respond(conn, 200, stats)
         else:
             self._respond(conn, 404, {"error": "unknown route"})
 
@@ -201,7 +245,9 @@ class ServeServer:
                 except (ValueError, UnicodeDecodeError):
                     out = {"error": "malformed JSON line"}
                 else:
-                    out = self._generate(payload)
+                    out = self._generate(payload, conn)
+                    if out is None:  # client disconnected mid-generation
+                        return
                 conn.sendall((json.dumps(out) + "\n").encode())
             chunk = conn.recv(65536)
             if not chunk:

@@ -249,6 +249,27 @@ def train(config: Config, backend: Optional[OuterBackend] = None) -> dict:
             config.serve.max_context,
         )
 
+    # serving fleet: replica engines (subprocesses by default) fed by
+    # delta pushes off the masters, behind one router (opendiloco_tpu/fleet)
+    fleet_plane = None
+    if config.fleet is not None and config.fleet.enabled:
+        from opendiloco_tpu.fleet import build_fleet
+
+        fleet_plane = build_fleet(
+            config.fleet,
+            model_cfg,
+            state["params"],
+            diloco_opt,
+            compute_dtype=tc.compute_dtype,
+        )
+        log.info(
+            "serving fleet up: router %s:%d over %d replicas (codec %s)",
+            config.fleet.host,
+            fleet_plane.port,
+            config.fleet.replicas,
+            config.fleet.codec,
+        )
+
     eval_iter = None
     if config.eval_interval:
         eval_loader = get_dataloader(
@@ -431,6 +452,10 @@ def train(config: Config, backend: Optional[OuterBackend] = None) -> dict:
         log.error("a DiLoCo worker dropped and fail_rank_drop is set; exiting")
         raise
     finally:
+        if fleet_plane is not None:
+            # pusher threads read master snapshots through diloco_opt;
+            # stop them (and the replicas) before the backend goes away
+            fleet_plane.stop()
         if serving is not None:
             # before the backend goes away: the batcher thread may be
             # mid-swap pulling a master snapshot through diloco_opt

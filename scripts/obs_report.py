@@ -259,6 +259,73 @@ def galaxy_section(trace_dir: str) -> dict:
     }
 
 
+def _parse_flat_key(key: str) -> tuple[str, dict]:
+    """'name{a=b,c=d}' flat metric key -> (name, labels)."""
+    if "{" not in key:
+        return key, {}
+    name, body = key.split("{", 1)
+    labels = dict(
+        kv.split("=", 1) for kv in body.rstrip("}").split(",") if "=" in kv
+    )
+    return name, labels
+
+
+def fleet_section(counters: dict) -> dict:
+    """Serving-fleet surface, straight from the ``fleet_*`` counters:
+    per-replica push bytes split delta-vs-keyframe (the delta-push
+    saving, measurable without the bench artifact), a staleness
+    histogram (rounds the serving weights lagged the trainer, one sample
+    per push reply), and the router's dispatch/redispatch/death/rejoin
+    ledger per replica."""
+    push: dict = {}
+    stale_hist: dict = {}
+    router: dict = {}
+    for key, v in counters.items():
+        if not key.startswith("fleet_"):
+            continue
+        name, labels = _parse_flat_key(key)
+        rid = labels.get("replica", "?")
+        if name in ("fleet_push_bytes", "fleet_push_frames"):
+            unit = "bytes" if name.endswith("bytes") else "frames"
+            slot = push.setdefault(
+                rid,
+                {
+                    "delta_bytes": 0,
+                    "keyframe_bytes": 0,
+                    "delta_frames": 0,
+                    "keyframe_frames": 0,
+                },
+            )
+            slot[f"{labels.get('kind', '?')}_{unit}"] = slot.get(
+                f"{labels.get('kind', '?')}_{unit}", 0
+            ) + int(v)
+        elif name == "fleet_staleness_rounds":
+            rounds = labels.get("rounds", "?")
+            stale_hist[rounds] = stale_hist.get(rounds, 0) + int(v)
+        elif name in (
+            "fleet_router_dispatch",
+            "fleet_router_redispatch",
+            "fleet_router_affinity_hits",
+            "fleet_replica_deaths",
+            "fleet_replica_rejoins",
+        ):
+            short = name.removeprefix("fleet_router_").removeprefix("fleet_replica_")
+            router.setdefault(short, {})
+            router[short][rid] = router[short].get(rid, 0) + int(v)
+    if not (push or stale_hist or router):
+        return {}
+    out: dict = {}
+    if push:
+        out["push_bytes_per_replica"] = {r: push[r] for r in sorted(push)}
+    if stale_hist:
+        out["staleness_hist"] = {
+            k: stale_hist[k] for k in sorted(stale_hist, key=str)
+        }
+    if router:
+        out["router"] = {k: router[k] for k in sorted(router)}
+    return out
+
+
 def merge_report(trace_dir: str) -> tuple[dict, dict]:
     """Merge every worker trace in ``trace_dir`` by round id. Returns
     (report body, merged Chrome trace)."""
@@ -419,6 +486,7 @@ def merge_report(trace_dir: str) -> tuple[dict, dict]:
             wan["wan_tx_fraction"] = round(tx_wan / tx, 4)
 
     galaxy = galaxy_section(trace_dir)
+    fleet = fleet_section(counters)
 
     body = {
         "workers_traced": len(workers),
@@ -426,6 +494,7 @@ def merge_report(trace_dir: str) -> tuple[dict, dict]:
         "per_round": rounds,
         **({"per_fragment": fragments} if fragments else {}),
         **({"serve": serve} if serve else {}),
+        **({"fleet": fleet} if fleet else {}),
         **({"wire_wan_split": wan} if wan else {}),
         **({"galaxy": galaxy} if galaxy else {}),
         "counters_total": {k: counters[k] for k in sorted(counters)},
