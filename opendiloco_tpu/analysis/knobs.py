@@ -47,6 +47,9 @@ KNOBS: tuple[Knob, ...] = (
     Knob("ODTP_DECODE_BENCH_OUT", "path", "", "bench",
          "Output path override for `scripts/serve_bench.py --decode`.",
          doc_default="repo artifact"),
+    Knob("ODTP_GOSSIP_BENCH_OUT", "path", "", "bench",
+         "Output path override for `bench_outer.py --gossip`.",
+         doc_default="repo artifact"),
     Knob("ODTP_HETERO_BENCH_OUT", "path", "", "bench",
          "Output path override for `bench_outer.py --hetero`.",
          doc_default="repo artifact"),
@@ -80,6 +83,20 @@ KNOBS: tuple[Knob, ...] = (
          "How many times a failed outer round re-forms before the step "
          "raises (callers may pass a different programmatic default)."),
     # -- diloco ---------------------------------------------------------------
+    Knob("ODTP_GOSSIP_LINK_BIAS", "float", "1.0", "diloco",
+         "Exponent on the normalized pair capacity when gossip draws "
+         "partners (linkstate-aware pairing); `0` disables link awareness, "
+         "higher prefers fast pairs harder."),
+    Knob("ODTP_GOSSIP_LINK_FLOOR", "float", "0.25", "diloco",
+         "Minimum relative draw weight for the slowest gossip pair — keeps "
+         "every pair reachable under any bias (never starved; NoLoCo "
+         "mixing needs connectivity)."),
+    Knob("ODTP_GOSSIP_SEED", "int", "0", "diloco",
+         "Shared pairing-PRNG seed for gossip outer rounds; must match "
+         "galaxy-wide (every worker derives the same pairing locally)."),
+    Knob("ODTP_GOSSIP_SELF_ROUND", "str", "nesterov", "diloco",
+         "Odd-galaxy self-pair policy: `nesterov` steps on own state "
+         "(plain DiLoCo step, no wire), `hold` skips the round entirely."),
     Knob("ODTP_STATE_CODEC", "str", "", "diloco",
          "Codec override for onboarding/serve state payloads (`none` "
          "restores raw fp32; default: configured codec when fp16-family, "

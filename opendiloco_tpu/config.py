@@ -99,10 +99,17 @@ class DilocoConfig(BaseModel):
 
     # outer averaging topology:
     #   "allreduce" - every epoch averages over the whole galaxy (reference)
-    #   "gossip"    - NoLoCo-style (arxiv 2506.10911): each worker averages
-    #                 (master, pseudo_grad) with ONE partner per epoch; the
-    #                 rendezvous re-pairs every round, so disagreement mixes
-    #                 away over rounds with no global synchronization point
+    #   "gossip"    - NoLoCo (arxiv 2506.10911): every worker mixes
+    #                 (master, momentum, pseudo_grad) with ONE partner per
+    #                 round over a point-to-point push-pull — no global
+    #                 barrier, no rendezvous round. Pairings are derived
+    #                 locally from a shared epoch-keyed PRNG over the
+    #                 gossiped membership (diloco/gossip.py), link-biased
+    #                 when link_adapt is on; disagreement mixes away over
+    #                 re-pairings. Composes with streaming_fragments
+    #                 (fragment k pairs on its own clock), overlap_comm,
+    #                 sub-8-bit codecs + per-partner error feedback, and
+    #                 device placement.
     outer_mode: Literal["allreduce", "gossip"] = "allreduce"
 
     # overlap the outer all-reduce with the next inner epoch (Eager Updates
@@ -140,8 +147,8 @@ class DilocoConfig(BaseModel):
     #              are fused, donated jit ops at HBM bandwidth and the
     #              boundary D2H moves wire-width bytes (diloco/outer_device.py)
     #   "auto"   - device on TPU meshes, host elsewhere
-    # Device placement is single-process allreduce only; gossip and
-    # multihost meshes fall back to host with a warning.
+    # Device placement is single-process only; multihost meshes fall back
+    # to host with a warning.
     outer_placement: Literal["auto", "host", "device"] = "auto"
 
     # bandwidth-aware adaptive outer transport (diloco/linkstate.py):
@@ -155,11 +162,6 @@ class DilocoConfig(BaseModel):
     @model_validator(mode="after")
     def _streaming_constraints(self):
         if self.streaming_fragments > 1:
-            if self.outer_mode != "allreduce":
-                raise ValueError(
-                    "streaming_fragments requires outer_mode='allreduce' "
-                    "(gossip mixes full masters per pair)"
-                )
             if self.average_state_every:
                 raise ValueError(
                     "streaming_fragments makes average_state_every "
@@ -175,31 +177,12 @@ class DilocoConfig(BaseModel):
             )
         return self
 
-    @model_validator(mode="after")
-    def _gossip_constraints(self):
-        if self.outer_mode == "gossip" and self.overlap_comm != "none":
-            raise ValueError(
-                "outer_mode='gossip' does not compose with overlap_comm yet; "
-                "gossip rounds already avoid the global synchronization stall"
-            )
-        if self.outer_mode == "gossip" and self.compression not in (
-            "none",
-            "fp16",
-            "scaled-fp16",
-        ):
-            raise ValueError(
-                "outer_mode='gossip' sends the master weights over the wire "
-                "every epoch; sub-fp16 codecs are tuned for pseudo-gradient "
-                "magnitudes and would accumulate unbounded master error -- "
-                "use none/fp16/scaled-fp16"
-            )
-        if self.outer_mode == "gossip" and self.error_feedback:
-            raise ValueError(
-                "error_feedback requires pseudo-gradient rounds; "
-                "outer_mode='gossip' averages full masters, so there is no "
-                "residual to carry"
-            )
-        return self
+    # The former _gossip_constraints validator is gone: NoLoCo gossip now
+    # composes with overlap_comm, streaming_fragments, sub-8-bit codecs,
+    # error feedback (per-partner residuals), and device placement. The
+    # master weights ride the STATE codec (fp16 family) on the pair wire;
+    # only the pseudo-gradient section uses the configured lossy codec,
+    # so sub-fp16 codecs no longer touch master bytes (see MIGRATION.md).
 
     @model_validator(mode="after")
     def _error_feedback_constraints(self):

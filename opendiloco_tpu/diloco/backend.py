@@ -90,6 +90,31 @@ class OuterBackend(abc.ABC):
     def serve_state(self, get_state: Callable[[], dict[str, Any]]) -> None:
         """Register a callback that provides state to late joiners."""
 
+    def gossip_view(self) -> tuple[list[str], Optional[dict]]:
+        """(sorted live member ids, link matrix or None) — the local view
+        the gossip pair scheduler derives pairings from. Default: whoever
+        has gossiped progress recently (no barrier, no extra messages)."""
+        members = {p.peer_id for p in self.peer_progress()}
+        members.add(self.peer_id)
+        return sorted(members), None
+
+    def pair_exchange(
+        self,
+        payload: bytes,
+        meta: dict,
+        *,
+        partner_id: str,
+        round_key: str,
+        timeout: Optional[float] = None,
+    ) -> tuple[dict, bytes]:
+        """One symmetric push-pull with ``partner_id`` under ``round_key``:
+        deposit own (meta, payload), return the partner's. Raises
+        AllReduceError on partner death / timeout (the gossip plane treats
+        that as a dropped round, a non-event)."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support gossip pair exchange"
+        )
+
     def barrier(self, *, timeout: Optional[float] = None) -> None:
         """Optional synchronization point (used by tests)."""
 

@@ -1,0 +1,501 @@
+"""Barrier-free NoLoCo gossip outer plane (arXiv 2506.10911).
+
+Replaces the global outer collective with one pairwise exchange per
+(epoch, fragment): every worker derives the SAME pairing locally from a
+shared epoch-keyed PRNG over the sorted live-membership view — no
+rendezvous round, no barrier, no matchmaking messages. Two paired
+workers push their (master, momentum, pseudo-grad) fragment to each
+other on the existing bulk/wire stack and mix; the NoLoCo
+modified-Nesterov correction is then a plain Nesterov step on the MIXED
+state with the pair-averaged pseudo-gradient (outer_optimizer.noloco_step),
+so per-round cost is flat in galaxy size.
+
+Agreement without messaging:
+
+  pair_schedule(sorted(members), key)   key = f"f{frag}-e{epoch}"
+
+seeds ``random.Random`` with a string (hashed via sha512, stable across
+processes and runs), so every worker holding the same membership view
+computes the identical pairing. Views CAN diverge transiently under
+churn — the two sides of a mismatched pair then wait on different round
+keys, time out, and drop the round: a non-event by design (residual
+retained, params keep local progress, next epoch re-pairs).
+
+Link-aware sampling: published link vectors (linkstate gossip) bias the
+partner draw toward fast pairs. Capacities are bucketed to powers of two
+before weighting so transient EWMA wiggle cannot de-synchronize two
+workers' schedules, and a weight floor guarantees slow pairs are sampled
+forever (never starved — NoLoCo's mixing proof needs connectivity).
+
+Odd galaxy: exactly one worker self-pairs per round. Policy "nesterov"
+(default) runs the outer step on its own state (plain DiLoCo step, no
+wire); "hold" skips the round entirely (master frozen, pg re-captured
+next epoch).
+
+Compression composes: masters/momentum ride the state codec (fp16
+family), pseudo-grads ride the configured codec (blockwise4bit / topk /
+...), with per-PARTNER error-feedback residuals — each pair link keeps
+its own EF ledger, so the mass a lossy codec drops toward partner A is
+replayed the next time A is drawn, not leaked into rounds with B.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import logging
+import math
+import os
+import random
+import threading
+import time
+from typing import Any, Optional
+
+import numpy as np
+
+from opendiloco_tpu import obs
+from opendiloco_tpu.diloco.backend import AllReduceError
+from opendiloco_tpu.diloco.compression import get_codec, record_wire
+from opendiloco_tpu.diloco.error_feedback import ErrorFeedback
+
+log = logging.getLogger(__name__)
+
+_HEALTH_LEDGER_CAP = 256
+
+
+# -- knobs ---------------------------------------------------------------------
+
+
+def gossip_seed() -> int:
+    """ODTP_GOSSIP_SEED: shared pairing-PRNG seed (must match galaxy-wide)."""
+    return int(os.environ.get("ODTP_GOSSIP_SEED", "0") or 0)
+
+
+def link_bias() -> float:
+    """ODTP_GOSSIP_LINK_BIAS: exponent on the normalized pair capacity when
+    drawing partners (0 disables link awareness; higher prefers fast pairs
+    harder)."""
+    return float(os.environ.get("ODTP_GOSSIP_LINK_BIAS", "1.0") or 1.0)
+
+
+def link_floor() -> float:
+    """ODTP_GOSSIP_LINK_FLOOR: minimum relative draw weight for the slowest
+    pair — keeps every pair reachable (never starved) under any bias."""
+    return float(os.environ.get("ODTP_GOSSIP_LINK_FLOOR", "0.25") or 0.25)
+
+
+def self_round_policy() -> str:
+    """ODTP_GOSSIP_SELF_ROUND: odd-worker self-pair policy — "nesterov"
+    steps on own state (default), "hold" skips the round."""
+    return os.environ.get("ODTP_GOSSIP_SELF_ROUND", "nesterov") or "nesterov"
+
+
+# -- pair scheduling -----------------------------------------------------------
+
+
+def _pair_key(a: str, b: str) -> tuple[str, str]:
+    return (a, b) if a <= b else (b, a)
+
+
+def pair_schedule(
+    members,
+    key: str,
+    *,
+    weights: Optional[dict] = None,
+    seed: int = 0,
+) -> dict[str, str]:
+    """Deterministic pairing of ``members`` for round ``key``.
+
+    Returns a symmetric map id -> partner covering every member; with odd
+    N exactly one member maps to itself. Every process computing this
+    over the same member set gets the identical map: the PRNG is seeded
+    with a string (hashed, process-stable) and the pool is sorted, so
+    draw order is fixed. ``weights`` (optional) maps _pair_key(a, b) ->
+    relative draw weight.
+    """
+    pool = sorted(set(members))
+    rng = random.Random(f"odtp-gossip:{int(seed)}:{key}")
+    pairs: dict[str, str] = {}
+    while pool:
+        a = pool.pop(0)
+        if not pool:
+            pairs[a] = a  # odd leftover: self-round
+            break
+        if weights:
+            w = [
+                max(float(weights.get(_pair_key(a, x), 1.0)), 1e-9)
+                for x in pool
+            ]
+            b = rng.choices(pool, weights=w)[0]
+        else:
+            b = pool[rng.randrange(len(pool))]
+        pool.remove(b)
+        pairs[a] = b
+        pairs[b] = a
+    return pairs
+
+
+def link_pair_weights(
+    matrix: Optional[dict], members
+) -> Optional[dict[tuple[str, str], float]]:
+    """Pair draw weights from the gossiped link matrix.
+
+    Published bps are bucketed to powers of two BEFORE weighting: the
+    schedule must be identical on every worker, and bucketing makes the
+    weight a step function of capacity, immune to the EWMA's last digit
+    differing between two workers' snapshots. Unknown links weigh
+    neutral (1.0 = fastest bucket): never punish what we haven't
+    measured. Weight = max(floor, (bucket / max_bucket) ** bias).
+    """
+    bias = link_bias()
+    if not matrix or bias <= 0:
+        return None
+    floor = max(0.0, min(1.0, link_floor()))
+    ids = sorted(set(members))
+    buckets: dict[tuple[str, str], Optional[int]] = {}
+    top = 0
+    for i, a in enumerate(ids):
+        for b in ids[i + 1:]:
+            bps = pair_bps(matrix, a, b)
+            if bps and bps > 0:
+                bk = 1 << max(0, int(math.log2(bps)))
+                buckets[(a, b)] = bk
+                top = max(top, bk)
+            else:
+                buckets[(a, b)] = None
+    if top <= 0:
+        return None
+    return {
+        k: 1.0 if bk is None else max(floor, (bk / top) ** bias)
+        for k, bk in buckets.items()
+    }
+
+
+def pair_bps(matrix: dict, a: str, b: str) -> Optional[float]:
+    """Symmetric pair capacity from a matrix-shaped link view
+    ({pid: {"v", "peers": {pid: {"bps", ...}}}}): the max of whichever
+    directional estimates have been published (either side's egress
+    measurement is evidence about the path)."""
+    vals = []
+    for x, y in ((a, b), (b, a)):
+        vec = matrix.get(x)
+        if not isinstance(vec, dict):
+            continue
+        ent = (vec.get("peers") or {}).get(y)
+        if isinstance(ent, dict):
+            bps = ent.get("bps")
+            if bps:
+                vals.append(float(bps))
+    return max(vals) if vals else None
+
+
+# -- wire sections -------------------------------------------------------------
+
+
+def _encode_leaves(codec, arrays) -> tuple[list[bytes], list[dict], int]:
+    chunks: list[bytes] = []
+    metas: list[dict] = []
+    raw = 0
+    for a in arrays:
+        flat = np.ascontiguousarray(np.asarray(a, np.float32).reshape(-1))
+        payload, meta = codec.encode(flat)
+        b = bytes(payload)
+        chunks.append(b)
+        metas.append({"shape": list(np.shape(a)), "meta": meta, "len": len(b)})
+        raw += flat.nbytes
+    return chunks, metas, raw
+
+
+def _decode_section(codec, metas, payload, off: int) -> tuple[list[np.ndarray], int]:
+    out: list[np.ndarray] = []
+    for m in metas:
+        n = int(m["len"])
+        raw = payload[off:off + n]
+        shape = tuple(int(s) for s in m["shape"])
+        size = int(np.prod(shape)) if shape else 1
+        a = np.asarray(
+            codec.decode(raw, (size,), m["meta"]), np.float32
+        ).reshape(shape)
+        out.append(np.array(a, np.float32))  # owned + writeable
+        off += n
+    return out, off
+
+
+def _avg_sorted(first, second) -> list[np.ndarray]:
+    # both sides add in the SAME (sorted-pair) operand order, so the mixed
+    # state is bit-identical on both ends — paired masters never drift
+    return [(x + y) * np.float32(0.5) for x, y in zip(first, second)]
+
+
+# -- the plane -----------------------------------------------------------------
+
+
+class GossipPlane:
+    """Per-worker gossip state: pair scheduling inputs, per-partner error
+    feedback, wire encode/decode, and round-health accounting. One
+    instance per DiLoCoOptimizer; ``exchange`` is thread-safe (streaming
+    calls it from per-fragment comm threads)."""
+
+    def __init__(
+        self,
+        backend,
+        n_leaves: int,
+        *,
+        compression: str = "none",
+        error_feedback: bool = False,
+    ):
+        self.backend = backend
+        self.n_leaves = int(n_leaves)
+        self.codec = get_codec(compression)
+        # masters/momentum are weights, not pseudo-grads: they ride the
+        # state codec (fp16 family) like onboarding snapshots do
+        from opendiloco_tpu.diloco.tcp import state_codec
+
+        self.state_codec = state_codec(self.codec)
+        self.error_feedback = bool(error_feedback)
+        self.seed = gossip_seed()
+        self.self_policy = self_round_policy()
+        self._ef: dict[str, ErrorFeedback] = {}
+        self._ef_lock = threading.Lock()
+
+    # -- per-partner error feedback ----------------------------------------
+
+    def _ef_for(self, partner: str) -> ErrorFeedback:
+        with self._ef_lock:
+            ef = self._ef.get(partner)
+            if ef is None:
+                ef = ErrorFeedback(self.codec, self.n_leaves)
+                self._ef[partner] = ef
+        return ef
+
+    def abort_all(self) -> None:
+        with self._ef_lock:
+            efs = list(self._ef.values())
+        for ef in efs:
+            ef.abort_all()
+
+    def host_state(self) -> Optional[dict]:
+        """Checkpoint payload: partner id -> per-leaf residual list."""
+        with self._ef_lock:
+            items = list(self._ef.items())
+        out = {}
+        for pid, ef in items:
+            res = ef.host_residuals()
+            if res is not None:
+                out[pid] = res
+        return out or None
+
+    def load(self, state: Optional[dict]) -> None:
+        if not state:
+            return
+        for pid, res in state.items():
+            self._ef_for(pid).load(res)
+
+    def residual_mass(self) -> float:
+        """Total |residual| mass across partners (soak conservation checks)."""
+        total = 0.0
+        with self._ef_lock:
+            efs = list(self._ef.values())
+        for ef in efs:
+            for r in ef.residual:
+                if r is not None:
+                    total += float(np.abs(r, dtype=np.float64).sum())
+        return total
+
+    # -- scheduling --------------------------------------------------------
+
+    def round_pairs(self, members, links, key: str) -> dict[str, str]:
+        weights = link_pair_weights(links, members)
+        return pair_schedule(members, key, weights=weights, seed=self.seed)
+
+    # -- the round ---------------------------------------------------------
+
+    def exchange(
+        self,
+        *,
+        epoch: int,
+        frag_id: int,
+        idxs,
+        masters: list[np.ndarray],
+        bufs: Optional[list[np.ndarray]],
+        pgs: list[np.ndarray],
+        timeout: Optional[float] = None,
+    ):
+        """One pair round for fragment ``frag_id`` at outer ``epoch``.
+
+        ``masters``/``bufs``/``pgs`` are the fragment's host f32 leaves
+        (bufs None when momentum is off). Returns
+        ``(mix_m, mix_b, avg_g, partner, n)`` — the pair-mixed master and
+        momentum leaves plus pair-averaged pseudo-gradient, ready for
+        ``outer_optimizer.noloco_step`` — or None when the round dropped
+        (partner death / timeout / "hold" self-round): EF residual
+        retained, nothing adopted, next epoch re-pairs.
+        """
+        t0 = time.perf_counter()
+        key = f"f{int(frag_id)}-e{int(epoch)}"
+        members, links = self.backend.gossip_view()
+        own = self.backend.peer_id
+        members = set(members)
+        members.add(own)
+        pairs = self.round_pairs(members, links, key)
+        partner = pairs.get(own, own)
+
+        if partner == own:
+            if self.self_policy == "hold":
+                self._record(key, partner=own, n=0, t0=t0, dropped=True)
+                return None
+            mix_m = [np.array(m, np.float32) for m in masters]
+            mix_b = None if bufs is None else [
+                np.array(b, np.float32) for b in bufs
+            ]
+            avg_g = [np.array(g, np.float32) for g in pgs]
+            self._record(key, partner=own, n=1, t0=t0)
+            return mix_m, mix_b, avg_g, own, 1
+
+        lo, hi = sorted((own, partner))
+        fp = hashlib.sha1(
+            f"{key}|{lo}|{hi}|{self.seed}".encode()
+        ).hexdigest()[:12]
+        round_key = f"gossip-{key}:{fp}"
+        ef = self._ef_for(partner) if self.error_feedback else None
+        # EF folds the residual into the pg IN PLACE — work on owned copies
+        gs = [np.array(np.asarray(g, np.float32)) for g in pgs]
+        if ef is not None:
+            ef.prepare(round_key, idxs, gs)
+        try:
+            m_chunks, m_metas, raw_m = _encode_leaves(self.state_codec, masters)
+            if bufs is not None:
+                b_chunks, b_metas, raw_b = _encode_leaves(self.state_codec, bufs)
+            else:
+                b_chunks, b_metas, raw_b = [], None, 0
+            g_chunks, g_metas, raw_g = _encode_leaves(self.codec, gs)
+            payload = b"".join(m_chunks + b_chunks + g_chunks)
+            meta = {
+                "gossip": 1,
+                "sections": {"m": m_metas, "b": b_metas, "g": g_metas},
+                "codec": {
+                    "state": self.state_codec.name,
+                    "grad": self.codec.name,
+                },
+            }
+            p_meta, p_payload = self.backend.pair_exchange(
+                payload,
+                meta,
+                partner_id=partner,
+                round_key=round_key,
+                timeout=timeout,
+            )
+            # decode OWN bytes too (codec roundtrip): both sides average
+            # the identical decoded operands, so the mix is bit-identical
+            mine_m, off = _decode_section(self.state_codec, m_metas, payload, 0)
+            mine_b: Optional[list[np.ndarray]] = None
+            if b_metas is not None:
+                mine_b, off = _decode_section(
+                    self.state_codec, b_metas, payload, off
+                )
+            mine_g, _ = _decode_section(self.codec, g_metas, payload, off)
+
+            p_sections = p_meta["sections"]
+            p_state = get_codec(p_meta["codec"]["state"])
+            p_grad = get_codec(p_meta["codec"]["grad"])
+            theirs_m, poff = _decode_section(
+                p_state, p_sections["m"], p_payload, 0
+            )
+            theirs_b: Optional[list[np.ndarray]] = None
+            if p_sections.get("b") is not None:
+                theirs_b, poff = _decode_section(
+                    p_state, p_sections["b"], p_payload, poff
+                )
+            theirs_g, _ = _decode_section(p_grad, p_sections["g"], p_payload, poff)
+            if len(theirs_m) != len(mine_m) or len(theirs_g) != len(mine_g):
+                raise AllReduceError(
+                    f"gossip section mismatch with {partner}: "
+                    f"{len(theirs_m)}/{len(theirs_g)} leaves vs "
+                    f"{len(mine_m)}/{len(mine_g)}"
+                )
+        except (AllReduceError, TimeoutError, asyncio.TimeoutError,
+                OSError, KeyError, ValueError) as e:
+            if ef is not None:
+                ef.abort(round_key)
+            log.warning(
+                "gossip round dropped (frag %s epoch %s partner %s): %s",
+                frag_id, epoch, partner, e,
+            )
+            self._record(key, partner=partner, n=0, t0=t0, dropped=True)
+            return None
+
+        if own == lo:
+            mix_m = _avg_sorted(mine_m, theirs_m)
+            mix_b = (
+                None if mine_b is None or theirs_b is None
+                else _avg_sorted(mine_b, theirs_b)
+            )
+            avg_g = _avg_sorted(mine_g, theirs_g)
+        else:
+            mix_m = _avg_sorted(theirs_m, mine_m)
+            mix_b = (
+                None if mine_b is None or theirs_b is None
+                else _avg_sorted(theirs_b, mine_b)
+            )
+            avg_g = _avg_sorted(theirs_g, mine_g)
+        if ef is not None:
+            ef.commit(round_key)
+        wire = len(payload)
+        record_wire("gossip", raw_m + raw_b + raw_g, wire)
+        self._record(key, partner=partner, n=2, t0=t0, wire=wire)
+        return mix_m, mix_b, avg_g, partner, 2
+
+    # -- health ------------------------------------------------------------
+
+    def _record(
+        self,
+        key: str,
+        *,
+        partner: str,
+        n: int,
+        t0: float,
+        wire: int = 0,
+        dropped: bool = False,
+    ) -> None:
+        t1 = time.perf_counter()
+        health = {
+            "round": f"gossip-{key}",
+            "group_size": n,
+            # a pair round's full group IS the pair; elastic-ness is
+            # "did it complete", not "how many showed up"
+            "expected": 2 if partner != self.backend.peer_id else 1,
+            "elastic": dropped,
+            "retries": 0,
+            "gossip": True,
+            "partner": partner,
+            "pair_s": round(t1 - t0, 6),
+        }
+        if dropped:
+            health["dropped"] = True
+        if wire:
+            health["wire_bytes"] = int(wire)
+        try:
+            self.backend.last_round_health = health
+            led = self.backend.round_ledger
+            led.append(health)
+            if len(led) > _HEALTH_LEDGER_CAP:
+                del led[:-_HEALTH_LEDGER_CAP]
+        except AttributeError:
+            pass
+        tr = obs.tracer()
+        if tr is not None:
+            tr.add_span(
+                "outer/gossip_pair", t0, t1,
+                partner=partner, round=health["round"], dropped=dropped,
+            )
+            tr.instant("outer/round", worker=self.backend.peer_id, **health)
+            tr.gauge("gossip_pair_s", t1 - t0)
+            tr.count("gossip_pair_rounds")
+            tr.count("outer_rounds")
+            if dropped:
+                tr.count("gossip_dropped_rounds")
+            if wire:
+                tr.count("gossip_wire_bytes", wire)
+        ov = obs.overseer.plane()
+        if ov is not None:
+            ov.note_round(health, own_id=self.backend.peer_id)

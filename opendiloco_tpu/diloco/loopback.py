@@ -50,6 +50,9 @@ class LoopbackWorld:
         self._rounds: dict[str, dict] = {}
         # gossip round state: round_key -> {"_partition": [...], chunk: {...}}
         self._gossip: dict = {}
+        # pair-exchange mailboxes: round_key -> {peer_id: (meta, payload)}
+        # (NoLoCo gossip, diloco/gossip.py); "_taken" tracks pickup for GC
+        self._pairbox: dict[str, dict] = {}
 
     def make_backends(self) -> list["LoopbackBackend"]:
         return [LoopbackBackend(self, f"peer-{i}") for i in range(self.n_peers)]
@@ -108,6 +111,57 @@ class LoopbackBackend(OuterBackend):
     def num_peers(self) -> int:
         with self.world.lock:
             return len(self.world.live)
+
+    def gossip_view(self):
+        with self.world.lock:
+            return sorted(self.world.live), None
+
+    def pair_exchange(self, payload, meta, *, partner_id, round_key,
+                      timeout=None):
+        """Symmetric push-pull through a keyed in-world mailbox: deposit
+        own frame, wait for the partner's. Partner close() mid-round (or a
+        divergent pairing putting the partner on a different key) resolves
+        as AllReduceError — the gossip plane's dropped-round non-event."""
+        self._chaos_gate()
+        w = self.world
+        deadline = time.monotonic() + (timeout if timeout else 60.0)
+        with w.cond:
+            slot = w._pairbox.setdefault(round_key, {"_taken": set()})
+            slot[self._peer_id] = (dict(meta), bytes(payload))
+            w.cond.notify_all()
+            while partner_id not in slot:
+                if partner_id not in w.live:
+                    slot.pop(self._peer_id, None)
+                    self._pairbox_gc(round_key)
+                    raise AllReduceError(
+                        f"gossip partner {partner_id} left mid-round "
+                        f"({round_key})"
+                    )
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    slot.pop(self._peer_id, None)
+                    self._pairbox_gc(round_key)
+                    raise AllReduceError(
+                        f"gossip pair round {round_key} timed out waiting "
+                        f"for {partner_id}"
+                    )
+                w.cond.wait(timeout=min(remaining, 0.1))
+            p_meta, p_payload = slot[partner_id]
+            slot["_taken"].add(self._peer_id)
+            self._pairbox_gc(round_key)
+        return p_meta, p_payload
+
+    def _pairbox_gc(self, round_key: str) -> None:
+        """Under world.lock: drop a fully-consumed (or abandoned) slot and
+        cap the box so dropped rounds' deposits cannot accumulate."""
+        box = self.world._pairbox
+        slot = box.get(round_key)
+        if slot is not None:
+            deposited = set(slot) - {"_taken"}
+            if not deposited or deposited <= slot["_taken"]:
+                box.pop(round_key, None)
+        while len(box) > 256:
+            box.pop(next(iter(box)))
 
     def all_reduce(self, arrays, *, timeout=None, tag="grads", epoch=None, group_cap=0):
         """Average across live peers. The round completes when every live

@@ -33,8 +33,9 @@ from opendiloco_tpu.diloco import planner
 from opendiloco_tpu.diloco.backend import OuterBackend, PeerProgress, wait_for_peers
 from opendiloco_tpu.diloco.compression import get_codec
 from opendiloco_tpu.diloco.error_feedback import ErrorFeedback
+from opendiloco_tpu.diloco.gossip import GossipPlane
 from opendiloco_tpu.diloco.outer_device import DeviceOuterPlane
-from opendiloco_tpu.diloco.outer_optimizer import OuterSGD
+from opendiloco_tpu.diloco.outer_optimizer import OuterSGD, noloco_step
 from opendiloco_tpu.diloco.streaming import StreamScheduler
 from opendiloco_tpu.parallel.world import HostWorld
 from opendiloco_tpu.trainer import InnerTrainer
@@ -67,11 +68,11 @@ def resolve_outer_placement(cfg: DilocoConfig, trainer, world) -> str:
 
     'auto' picks device on TPU meshes (the master fits HBM there; the host
     offload is a GPU-memory artifact of the reference) and host elsewhere.
-    Device placement requires the configurations it can keep consistent:
-    single-process meshes (the plane is not collective-aware) and the
-    allreduce outer mode (gossip puts the master itself on the wire every
-    round, which would D2H the whole plane anyway) — anything else falls
-    back to host with a warning rather than failing the run."""
+    Device placement requires single-process meshes (the plane is not
+    collective-aware) — multihost falls back to host with a warning
+    rather than failing the run. Gossip composes: a pair round fetches
+    only its fragment's leaves (host_frag) and lands the mixed result
+    back through the plane's donated jits."""
     if cfg.outer_placement == "host":
         return "host"
     if cfg.outer_placement == "auto":
@@ -82,13 +83,6 @@ def resolve_outer_placement(cfg: DilocoConfig, trainer, world) -> str:
         log.warning(
             "outer_placement=device is single-process only (multihost "
             "slices replicate the host master); falling back to host"
-        )
-        return "host"
-    if cfg.outer_mode == "gossip":
-        log.warning(
-            "outer_placement=device does not compose with outer_mode="
-            "'gossip' (the master rides the wire every round); falling "
-            "back to host"
         )
         return "host"
     return "device"
@@ -135,7 +129,12 @@ class DiLoCoOptimizer:
                 momentum=cfg.outer_momentum,
                 nesterov=cfg.outer_nesterov,
                 compression=cfg.compression,
-                error_feedback=cfg.error_feedback,
+                # gossip keeps its per-PARTNER EF ledgers host-side in the
+                # GossipPlane (the pair wire encode happens on host); the
+                # device plane's in-jit residual add is per-worker and
+                # would mix partners' residuals into every pair round
+                error_feedback=cfg.error_feedback
+                and cfg.outer_mode != "gossip",
             )
             # the plane owns master + momentum; the host list stays empty
             # (every device-mode path goes through self._plane)
@@ -151,7 +150,7 @@ class DiLoCoOptimizer:
         # fuses the residual add into the plane's pseudo-gradient jit and
         # stores the residuals in HBM; host placement adds in prepare().
         self._ef: Optional[ErrorFeedback] = None
-        if cfg.error_feedback:
+        if cfg.error_feedback and cfg.outer_mode != "gossip":
             self._ef = ErrorFeedback(
                 get_codec(cfg.compression),
                 len(flat_dev),
@@ -164,6 +163,18 @@ class DiLoCoOptimizer:
         self.outer_opt = OuterSGD(
             lr=cfg.outer_lr, momentum=cfg.outer_momentum, nesterov=cfg.outer_nesterov
         )
+
+        # NoLoCo gossip plane (diloco/gossip.py): pair scheduling + the
+        # point-to-point push-pull + per-partner error feedback. Messenger
+        # process only — followers receive the mixed result via fanout.
+        self._gossip: Optional[GossipPlane] = None
+        if cfg.outer_mode == "gossip" and self.backend is not None:
+            self._gossip = GossipPlane(
+                self.backend,
+                len(flat_dev),
+                compression=cfg.compression,
+                error_feedback=cfg.error_feedback,
+            )
 
         self._schema = schema_fingerprint(state["params"])
         # streaming fragment sync (arxiv 2501.18512): size-balanced
@@ -652,6 +663,21 @@ class DiLoCoOptimizer:
                     and self._fragments is None
                     and not self._is_state_avg_epoch()
                 )
+                if overlap and self.cfg.outer_mode == "gossip":
+                    # full-model overlapped gossip: the delta-landing
+                    # machinery is pseudo-gradient-only. Overlapped gossip
+                    # rides the streaming scheduler instead (set
+                    # streaming_fragments > 1: each fragment pairs and
+                    # lands mid-phase) — full-model boundaries block.
+                    if not getattr(self, "_warned_gossip_overlap", False):
+                        self._warned_gossip_overlap = True
+                        log.warning(
+                            "overlap_comm without streaming_fragments "
+                            "falls back to blocking under outer_mode="
+                            "'gossip'; set streaming_fragments>1 for "
+                            "overlapped gossip rounds"
+                        )
+                    overlap = False
                 if overlap:
                     state, outer_metrics = self._outer_step_overlapped(state)
                 else:
@@ -1201,6 +1227,10 @@ class DiLoCoOptimizer:
             # abandoned rounds never commit; the live residual survives
             # state adoption (it is this worker's own compression debt)
             self._ef.abort_all()
+        if self._gossip is not None:
+            # same contract per partner: pending pair rounds are discarded,
+            # committed residual ledgers survive
+            self._gossip.abort_all()
 
     def flush(self, state: dict) -> dict:
         """Resolve any in-flight outer communication (call before
@@ -1291,6 +1321,65 @@ class DiLoCoOptimizer:
         avg, meta = self._messenger_fanout(produce, [a.shape for a in arrays])
         return avg, int(meta["n"]), int(meta["live"])
 
+    def _gossip_round(
+        self,
+        masters: list[np.ndarray],
+        bufs: Optional[list[np.ndarray]],
+        pgs: list[np.ndarray],
+        *,
+        idxs,
+        frag_id: int,
+        epoch: int,
+    ):
+        """One NoLoCo pair round through the gossip plane, with the same
+        messenger/follower fan-out shape as ``_wan_all_reduce``.
+
+        Returns ``(mix_m, mix_b, avg_g, pair_n, live_peers)``; ``pair_n``
+        is 0 when the round dropped (partner death / timeout / "hold"
+        self-round) — mix arrays are None then and the caller treats the
+        boundary as a non-event (master untouched, EF residual retained).
+        """
+        k = len(masters)
+        if self.world.process_count == 1:
+            res = self._gossip.exchange(
+                epoch=epoch, frag_id=frag_id, idxs=idxs,
+                masters=masters, bufs=bufs, pgs=pgs,
+                timeout=self.cfg.averaging_timeout,
+            )
+            live = self.backend.num_peers()
+            if res is None:
+                return None, None, None, 0, live
+            mix_m, mix_b, avg_g, _partner, n = res
+            return mix_m, mix_b, avg_g, n, live
+
+        # momentum-armed-ness must be config-symmetric across processes:
+        # follower shape templates are derived from it without messaging
+        has_b = bufs is not None
+
+        def produce():
+            res = self._gossip.exchange(
+                epoch=epoch, frag_id=frag_id, idxs=idxs,
+                masters=masters, bufs=bufs, pgs=pgs,
+                timeout=self.cfg.averaging_timeout,
+            )
+            live = self.backend.num_peers()
+            if res is None:
+                # dropped round: fan the INPUTS out (cheap, right shapes);
+                # n=0 tells every process to ignore them
+                return masters + (bufs or []) + pgs, {"n": 0, "live": live}
+            mix_m, mix_b, avg_g, _partner, n = res
+            return mix_m + (mix_b or []) + avg_g, {"n": n, "live": live}
+
+        shapes = [a.shape for a in masters + (bufs or []) + pgs]
+        arrays, meta = self._messenger_fanout(produce, shapes)
+        n, live = int(meta["n"]), int(meta["live"])
+        if n == 0:
+            return None, None, None, 0, live
+        mix_m = arrays[:k]
+        mix_b = arrays[k:2 * k] if has_b else None
+        avg_g = arrays[-k:]
+        return mix_m, mix_b, avg_g, n, live
+
     def _outer_step_device(self, state: dict) -> tuple[dict, dict]:
         """Blocking outer round, device placement: the pseudo-gradient and
         the Nesterov apply are fused, donated jit ops; D2H moves wire-width
@@ -1345,14 +1434,18 @@ class DiLoCoOptimizer:
 
         fetcher = threading.Thread(target=_fetch)
         fetcher.start()
-        wait_for_peers(
-            self.backend,
-            target_samples=self.target_samples,
-            own_epoch=self.epoch,
-            strategy=self.cfg.all_reduce_strategy,
-            timeout_waiting_for_peers=self.cfg.timeout_waiting_for_peers,
-            log=log,
-        )
+        if self.cfg.outer_mode != "gossip":
+            # gossip skips the straggler wait: a pair round has no group
+            # to assemble (no global barrier); the pair push-pull itself
+            # bounds how long a fast worker waits on its partner
+            wait_for_peers(
+                self.backend,
+                target_samples=self.target_samples,
+                own_epoch=self.epoch,
+                strategy=self.cfg.all_reduce_strategy,
+                timeout_waiting_for_peers=self.cfg.timeout_waiting_for_peers,
+                log=log,
+            )
         wait_s = time.monotonic() - t0
         if tr is not None:
             tr.add_span(
@@ -1365,6 +1458,13 @@ class DiLoCoOptimizer:
         pseudo_grad, pg_norm, _ = fetch_result[0]
         if tr is not None and pg_norm is not None:
             tr.gauge("pseudo_grad_norm", pg_norm)
+        if self.cfg.outer_mode == "gossip":
+            # pair-mix on host (the wire encode is host-side anyway), then
+            # land the mixed fragment back through the plane's donated jits
+            return self._outer_step_device_gossip(
+                state, device_leaves, frag, pseudo_grad,
+                t0=t0, t0p=t0p, wait_s=wait_s,
+            )
         if self._ef is not None:
             # residual already added in the plane's jit; stage the error
             self._ef.prepare(
@@ -1461,6 +1561,109 @@ class DiLoCoOptimizer:
         self.last_outer_metrics = outer_metrics
         return state, outer_metrics
 
+    def _outer_step_device_gossip(
+        self,
+        state: dict,
+        device_leaves: list,
+        frag: Optional[list[int]],
+        pseudo_grad: list[np.ndarray],
+        *,
+        t0: float,
+        t0p: float,
+        wait_s: float,
+    ) -> tuple[dict, dict]:
+        """Gossip tail of the blocking device-placement round: the pair
+        mix and NoLoCo step run on host f32 copies of this boundary's
+        fragment (the pair wire encode is host-side regardless), then the
+        mixed result lands back through the plane's donated H2D jits —
+        the D2H/H2D still moves one fragment, not the model."""
+        plane = self._plane
+        tr = obs.tracer()
+        idxs = frag if frag is not None else list(range(len(device_leaves)))
+        masters_np, bufs_np = plane.host_frag(frag)
+        if self.cfg.outer_momentum != 0.0 and bufs_np is None:
+            # zeros when momentum never armed: wire shapes must be static
+            bufs_np = [np.zeros_like(m) for m in masters_np]
+        frag_id = (
+            self.epoch % len(self._fragments)
+            if self._fragments is not None else 0
+        )
+        t1 = time.monotonic()
+        t1p = time.perf_counter() if tr is not None else 0.0
+        mix_m, mix_b, avg_g, group_size, live_peers = self._gossip_round(
+            masters_np, bufs_np, pseudo_grad,
+            idxs=idxs, frag_id=frag_id, epoch=self.epoch,
+        )
+        dropped = group_size == 0
+        self._check_group_size(live_peers)
+        allreduce_s = time.monotonic() - t1
+        if tr is not None:
+            tr.add_span(
+                "outer/allreduce", t1p, time.perf_counter(),
+                epoch=self.epoch, group=group_size,
+            )
+        t_apply = time.perf_counter() if tr is not None else 0.0
+        log.info(
+            "outer step %d: gossip exchange over %d peers took %.3fs",
+            self.epoch, group_size, allreduce_s,
+        )
+        if self._is_state_avg_epoch() and not dropped:
+            # NoLoCo pair mixing already averages the masters every round;
+            # the periodic full-state leg would need a global collective
+            # (exactly what gossip removes), so it is a no-op here
+            log.debug(
+                "average_state_every is redundant under gossip "
+                "(masters mix every pair round); skipping"
+            )
+        if dropped:
+            # non-event: master/momentum/EF stay put, params keep local
+            # progress (next pseudo-gradient re-captures this epoch)
+            with self._serve_lock:
+                self.epoch += 1
+                self.local_step = 0
+                self.samples_in_epoch = 0
+                self._blocking_snap = None
+        else:
+            new_m, new_b = noloco_step(
+                mix_m, mix_b, avg_g,
+                lr=self.cfg.outer_lr,
+                momentum=self.cfg.outer_momentum,
+                nesterov=self.cfg.outer_nesterov,
+            )
+            with plane.lock:
+                leaves = plane.gossip_land(
+                    frag, new_m, new_b, sync=device_leaves
+                )
+                state["params"] = jax.tree.unflatten(self.treedef, leaves)
+                with self._serve_lock:
+                    self.epoch += 1
+                    self.local_step = 0
+                    self.samples_in_epoch = 0
+                    self._blocking_snap = None
+        if tr is not None:
+            tr.add_span(
+                "outer/apply", t_apply, time.perf_counter(),
+                epoch=self.epoch - 1,
+            )
+        self._epoch_t0 = time.monotonic()
+        outer_metrics = {
+            "outer_step_s": time.monotonic() - t0,
+            "outer_allreduce_s": allreduce_s,
+            "outer_wait_s": wait_s,
+            "num_peers": group_size,
+            **self._round_health_metrics(),
+        }
+        if tr is not None:
+            tr.add_span(
+                "outer/step", t0p, time.perf_counter(),
+                epoch=self.epoch - 1, group=group_size,
+            )
+            tr.gauge("outer_step_s", outer_metrics["outer_step_s"])
+            tr.gauge("outer_allreduce_s", allreduce_s)
+            tr.gauge("outer_wait_s", wait_s)
+        self.last_outer_metrics = outer_metrics
+        return state, outer_metrics
+
     def outer_step(self, state: dict) -> tuple[dict, dict]:
         if self._plane is not None:
             return self._outer_step_device(state)
@@ -1517,9 +1720,12 @@ class DiLoCoOptimizer:
 
         fetcher = threading.Thread(target=_fetch)
         fetcher.start()
-        if self.world.is_messenger:
+        if self.world.is_messenger and self.cfg.outer_mode != "gossip":
             # followers skip the straggler wait: they have no peer view,
-            # and they re-join the messenger at the fan-out collective
+            # and they re-join the messenger at the fan-out collective.
+            # Gossip skips it entirely — a pair round has no group to
+            # assemble (THE point: no global barrier); the pair push-pull
+            # itself bounds how long a fast worker waits on its partner.
             wait_for_peers(
                 self.backend,
                 target_samples=self.target_samples,
@@ -1555,9 +1761,9 @@ class DiLoCoOptimizer:
             # slot 0 only)
             pseudo_grad = self._pseudo_grad_into(device_flat, slot=0)
         if self._ef is not None:
-            # residual folded into the wire pg in place (config rejects
-            # error_feedback with gossip, so this is always the plain
-            # pseudo-gradient all-reduce below)
+            # residual folded into the wire pg in place (gossip keeps its
+            # per-partner EF inside the GossipPlane instead, so self._ef
+            # is None there and this is always the all-reduce path)
             self._ef.prepare(
                 "main",
                 frag if frag is not None else range(len(pseudo_grad)),
@@ -1575,21 +1781,40 @@ class DiLoCoOptimizer:
 
         t1 = time.monotonic()
         t1p = time.perf_counter() if tr is not None else 0.0
-        if self.cfg.outer_mode == "gossip":
-            # NoLoCo-style (arxiv 2506.10911): average (master, pseudo_grad)
-            # with ONE re-paired partner per epoch -- state mixing keeps the
-            # per-worker masters from drifting apart while no round ever
-            # waits on the whole galaxy
-            k = len(self.master)
-            avg, group_size, live_peers = self._wan_all_reduce(
-                self.master + pseudo_grad,
-                timeout=self.cfg.averaging_timeout,
-                tag="gossip",
-                epoch=self.epoch,
-                group_cap=2,
+        gossip = self.cfg.outer_mode == "gossip"
+        dropped = False
+        mix_m: Optional[list[np.ndarray]] = None
+        mix_b: Optional[list[np.ndarray]] = None
+        if gossip:
+            # NoLoCo (arxiv 2506.10911): mix (master, momentum) with ONE
+            # locally-scheduled partner per round over a point-to-point
+            # push-pull (diloco/gossip.py) — no barrier, no collective —
+            # then run the unchanged Nesterov rule on the mixed state with
+            # the pair-averaged pseudo-gradient (the modified-Nesterov
+            # correction, expressed through step_mixed_indices)
+            idxs = frag if frag is not None else list(range(len(self.master)))
+            g_masters = [self.master[i] for i in idxs]
+            g_bufs = None
+            if self.cfg.outer_momentum != 0.0:
+                oo = self.outer_opt
+                # zeros when momentum never armed: wire shapes must be
+                # static so both sides' sections always line up
+                g_bufs = [
+                    np.zeros_like(self.master[i]) if oo.bufs is None
+                    else oo.bufs[i]
+                    for i in idxs
+                ]
+            frag_id = (
+                self.epoch % len(self._fragments)
+                if self._fragments is not None else 0
             )
-            self.master = [np.asarray(a, np.float32).copy() for a in avg[:k]]
-            averaged = avg[k:]
+            mix_m, mix_b, averaged, group_size, live_peers = (
+                self._gossip_round(
+                    g_masters, g_bufs, pseudo_grad,
+                    idxs=idxs, frag_id=frag_id, epoch=self.epoch,
+                )
+            )
+            dropped = group_size == 0
             # pair size says nothing about the swarm: peer-drop detection
             # (incl. fail_rank_drop) runs on the live-peer count instead
             self._check_group_size(live_peers)
@@ -1617,7 +1842,7 @@ class DiLoCoOptimizer:
         log.info(
             "outer step %d: %s over %d peers took %.3fs",
             self.epoch,
-            "gossip exchange" if self.cfg.outer_mode == "gossip" else "all-reduce",
+            "gossip exchange" if gossip else "all-reduce",
             group_size,
             allreduce_s,
         )
@@ -1626,14 +1851,20 @@ class DiLoCoOptimizer:
         # in place, and a serve-thread fetch may hold references to the
         # current master/buf arrays (copies happen outside the lock); every
         # live array must stay bit-stable once published
-        new_master = [m.copy() for m in self.master]
-        new_opt = self.outer_opt.clone()
-        if frag is not None:
-            new_opt.step_indices(new_master, averaged, frag)
-        else:
-            new_opt.step(new_master, averaged)
-        self.master = new_master
-        self.outer_opt = new_opt
+        if not dropped:
+            new_master = [m.copy() for m in self.master]
+            new_opt = self.outer_opt.clone()
+            if gossip:
+                new_opt.step_mixed_indices(
+                    new_master, mix_m, mix_b, averaged,
+                    frag if frag is not None else range(len(new_master)),
+                )
+            elif frag is not None:
+                new_opt.step_indices(new_master, averaged, frag)
+            else:
+                new_opt.step(new_master, averaged)
+            self.master = new_master
+            self.outer_opt = new_opt
 
         # optional periodic full state averaging (hivemind
         # average_state_every, hivemind_diloco.py:634-638): corrects any
@@ -1648,7 +1879,15 @@ class DiLoCoOptimizer:
             self.master = [np.array(a, dtype=np.float32) for a in averaged_state]
             log.info("averaged full state over %d peers at epoch %d", n, self.epoch)
 
-        if frag is not None:
+        if dropped:
+            # dropped pair round: a non-event by design. Master, momentum,
+            # and per-partner EF residual all stay put; the params KEEP
+            # their local progress (writing the stale master back would
+            # erase this epoch's inner training), so the next boundary's
+            # pseudo-gradient (master - params) re-captures the update and
+            # the fresh epoch key re-pairs.
+            pass
+        elif frag is not None:
             # streaming semantics: only the synced fragment resets to the
             # (freshly outer-stepped) master; every other leaf KEEPS its
             # local training progress AND stays on-device (the live jax
@@ -1737,6 +1976,8 @@ class DiLoCoOptimizer:
             }
             if self._ef is not None:
                 sd["ef_residual"] = self._plane.ef_host_state()
+            if self._gossip is not None:
+                sd["gossip_ef"] = self._gossip.host_state()
             return sd
         sd = {
             "master": [m.copy() for m in self.master],
@@ -1747,6 +1988,10 @@ class DiLoCoOptimizer:
         }
         if self._ef is not None:
             sd["ef_residual"] = self._ef.host_residuals()
+        if self._gossip is not None:
+            # per-partner residual ledgers (diloco/gossip.py): compression
+            # debt owed to each pair link survives the checkpoint
+            sd["gossip_ef"] = self._gossip.host_state()
         return sd
 
     def load_state_dict(self, sd: dict) -> None:
@@ -1779,6 +2024,8 @@ class DiLoCoOptimizer:
                             self.local_step * self.batch_size,
                         )
                     )
+            if self._gossip is not None:
+                self._gossip.load(sd.get("gossip_ef"))
             return
         with self._serve_lock:
             self._blocking_snap = None  # superseded pre-round snapshot
@@ -1796,3 +2043,5 @@ class DiLoCoOptimizer:
             self.samples_in_epoch = int(
                 sd.get("samples_in_epoch", self.local_step * self.batch_size)
             )
+        if self._gossip is not None:
+            self._gossip.load(sd.get("gossip_ef"))

@@ -255,6 +255,13 @@ def _overwrite_fused(masters, params):
     return [m + jnp.zeros((), m.dtype) for m in masters]
 
 
+@functools.partial(jax.jit, donate_argnums=(1,))
+def _sub_fused(new, base):
+    # delta = new - base over a fragment (gossip landing under streaming);
+    # the retained base copies are dead after this, so they donate
+    return [a - b for a, b in zip(new, base)]
+
+
 @functools.partial(jax.jit, static_argnames=("dtype",))
 def _cast_fused(leaves, dtype):
     # wire-width pre-cast for masters-only host fetches (serve snapshots):
@@ -650,6 +657,69 @@ class DeviceOuterPlane:
         for j, i in enumerate(frag):
             merged[i] = fresh[j]
         return merged
+
+    def host_frag(
+        self, frag: Optional[list[int]]
+    ) -> tuple[list[np.ndarray], Optional[list[np.ndarray]]]:
+        """Host f32 copies of one fragment's (masters, bufs) — the gossip
+        pair wire is encoded host-side, so a pair round D2H-fetches only
+        its fragment. Lock held across the fetch (donation-race rule of
+        host_state); bufs is None until momentum arms."""
+        with self.lock:
+            m = jax.device_get(self._sel(self.masters, frag))
+            b = (
+                jax.device_get(self._sel(self.bufs, frag))
+                if self.bufs is not None else None
+            )
+        return (
+            [_own(x) for x in m],
+            None if b is None else [_own(x) for x in b],
+        )
+
+    def gossip_land(
+        self,
+        frag: Optional[list[int]],
+        masters_np: Sequence[np.ndarray],
+        bufs_np: Optional[Sequence[np.ndarray]],
+        *,
+        sync: Optional[Sequence[jax.Array]] = None,
+        base: Optional[list[jax.Array]] = None,
+    ):
+        """Adopt a NoLoCo-stepped fragment (host numpy from noloco_step):
+        H2D the new masters/momentum and rebind the fragment entries.
+
+        Blocking path passes ``sync`` (the live param leaves) and gets the
+        merged post-sync leaves back — the fragment's params reset to the
+        new master via the donating overwrite, unsynced leaves pass
+        through live. Streaming passes ``base`` (the retained pre-round
+        master copies) and gets the device delta (new - base) for
+        _apply_frag_delta; the base copies are donated. Caller holds
+        self.lock when it needs the rebind atomic with a params update."""
+        with self.lock:
+            new_m = [
+                jax.device_put(np.asarray(m, np.float32), s)
+                for m, s in zip(masters_np, self._sel(self.shardings, frag))
+            ]
+            self._put_back("masters", frag, new_m)
+            if self._has_mom and bufs_np is not None:
+                self._ensure_bufs()
+                new_b = [
+                    jax.device_put(np.asarray(b, np.float32), s)
+                    for b, s in zip(bufs_np, self._sel(self.shardings, frag))
+                ]
+                self._put_back("bufs", frag, new_b)
+            if sync is not None:
+                p = self._sel(list(sync), frag)
+                fresh = _overwrite_fused(new_m, p)
+                if frag is None:
+                    return list(fresh)
+                merged = list(sync)
+                for j, i in enumerate(frag):
+                    merged[i] = fresh[j]
+                return merged
+            if base is not None:
+                return _sub_fused(new_m, base)
+            return None
 
     def set_ef_residuals(
         self, idxs: Sequence[int], host_errs: list[np.ndarray]

@@ -71,6 +71,29 @@ class OuterSGD:
                 d = buf
             p -= self.lr * d
 
+    def step_mixed_indices(
+        self,
+        params: list[np.ndarray],
+        mix_m: list[np.ndarray],
+        mix_b: Optional[list[np.ndarray]],
+        grads: list[np.ndarray],
+        idxs,
+    ) -> None:
+        """NoLoCo modified-Nesterov step (arXiv 2506.10911) on a fragment:
+        adopt the pair-MIXED master and momentum for ``idxs``, then run the
+        unchanged Nesterov rule with the pair-averaged pseudo-gradient.
+        Expressing the correction as a plain step on mixed state keeps the
+        ONE copy of the update rule (``step_indices``) authoritative."""
+        for j, i in enumerate(idxs):
+            params[i] = np.asarray(mix_m[j], np.float32)
+        if self.momentum != 0.0:
+            if self.bufs is None:
+                self.bufs = [np.zeros_like(p) for p in params]
+            if mix_b is not None:
+                for j, i in enumerate(idxs):
+                    self.bufs[i] = np.asarray(mix_b[j], np.float32)
+        self.step_indices(params, grads, idxs)
+
     def clone(self) -> "OuterSGD":
         """Deep copy (one buf copy, not the two of state_dict+load).
         Enables the copy-on-write discipline in DiLoCoOptimizer: step the
@@ -101,3 +124,29 @@ class OuterSGD:
         self.nesterov = state["nesterov"]
         bufs = state["bufs"]
         self.bufs = None if bufs is None else [np.asarray(b).copy() for b in bufs]
+
+
+def noloco_step(
+    mix_m: list[np.ndarray],
+    mix_b: Optional[list[np.ndarray]],
+    avg_g: list[np.ndarray],
+    *,
+    lr: float,
+    momentum: float,
+    nesterov: bool,
+) -> tuple[list[np.ndarray], Optional[list[np.ndarray]]]:
+    """Functional NoLoCo outer step: run the Nesterov rule on pair-mixed
+    (master, momentum) with the pair-averaged pseudo-gradient, returning
+    fresh ``(new_masters, new_bufs)`` without touching the inputs. The
+    streaming gossip path lands through this (comm thread computes the
+    result; the landing thread adopts it into the live optimizer)."""
+    opt = OuterSGD(lr=lr, momentum=momentum, nesterov=nesterov)
+    params = [np.array(m, np.float32) for m in mix_m]
+    if momentum != 0.0:
+        if mix_b is None:
+            opt.bufs = [np.zeros_like(p) for p in params]
+        else:
+            opt.bufs = [np.array(b, np.float32) for b in mix_b]
+    grads = [np.ascontiguousarray(np.asarray(g, np.float32)) for g in avg_g]
+    opt.step(params, grads)
+    return params, opt.bufs

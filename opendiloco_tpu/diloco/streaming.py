@@ -58,7 +58,8 @@ import jax
 import numpy as np
 
 from opendiloco_tpu import native, obs
-from opendiloco_tpu.diloco.outer_optimizer import OuterSGD
+from opendiloco_tpu.diloco.backend import AllReduceError
+from opendiloco_tpu.diloco.outer_optimizer import OuterSGD, noloco_step
 from opendiloco_tpu.utils.logger import get_text_logger
 
 log = get_text_logger(__name__)
@@ -204,6 +205,18 @@ class StreamScheduler:
             )
             rec["placement"] = "device"
             rec["retained"] = retained
+            if opt._gossip is not None:
+                # the pair exchange carries (master, momentum) alongside
+                # the pseudo-gradient; capture the live refs now —
+                # stream_launch never rebinds, so these stay the
+                # pre-round values until this round's own landing
+                with opt._plane.lock:
+                    rec["m_refs"] = opt._plane._sel(opt._plane.masters, frag)
+                    rec["b_refs"] = (
+                        opt._plane._sel(opt._plane.bufs, frag)
+                        if opt._plane.bufs is not None
+                        else None
+                    )
             if eager:
                 state = opt._apply_frag_delta(state, frag, delta)
             fut = self._spawn(k, epoch, wire=wire, ef_rec=rec)
@@ -224,6 +237,14 @@ class StreamScheduler:
                 opt._ef.prepare(rec["round"], frag, pg)
             rec["placement"] = "host"
             oo = opt.outer_opt
+            if opt._gossip is not None:
+                # clone-then-rebind discipline: master/buf entries are
+                # never mutated in place, so these refs stay the
+                # pre-round values for the comm thread
+                rec["m_refs"] = [opt.master[i] for i in frag]
+                rec["b_refs"] = (
+                    None if oo.bufs is None else [oo.bufs[i] for i in frag]
+                )
             if eager:
                 est_opt = OuterSGD(
                     lr=oo.lr, momentum=oo.momentum, nesterov=oo.nesterov
@@ -240,7 +261,7 @@ class StreamScheduler:
                 rec["est_m"] = est_m
             else:
                 rec["boundary"] = bh
-            fut = self._spawn(k, epoch, pg=pg)
+            fut = self._spawn(k, epoch, pg=pg, ef_rec=rec)
         rec["future"] = fut
         self._inflight[k] = rec
         self._launched.add(k)
@@ -292,6 +313,58 @@ class StreamScheduler:
                         opt._ef.prepare(
                             ef_rec["round"], ef_rec["frag"], arrays
                         )
+                if opt._gossip is not None:
+                    m_refs = ef_rec["m_refs"]
+                    b_refs = ef_rec["b_refs"]
+                    if ef_rec["placement"] == "device":
+                        m_np = [
+                            np.array(x, np.float32)
+                            for x in jax.device_get(m_refs)
+                        ]
+                        b_np = (
+                            None
+                            if b_refs is None
+                            else [
+                                np.array(x, np.float32)
+                                for x in jax.device_get(b_refs)
+                            ]
+                        )
+                    else:
+                        m_np = [np.array(x, np.float32) for x in m_refs]
+                        b_np = (
+                            None
+                            if b_refs is None
+                            else [np.array(x, np.float32) for x in b_refs]
+                        )
+                    if b_np is None and opt.cfg.outer_momentum != 0.0:
+                        b_np = [np.zeros_like(m) for m in m_np]
+                    res = opt._gossip.exchange(
+                        epoch=epoch,
+                        frag_id=k,
+                        idxs=ef_rec["frag"],
+                        masters=m_np,
+                        bufs=b_np,
+                        pgs=arrays,
+                        timeout=opt.cfg.averaging_timeout,
+                    )
+                    if res is None:
+                        # rides the existing dropped-round path; the
+                        # per-partner EF was already aborted in exchange
+                        raise AllReduceError(
+                            f"gossip pair round dropped "
+                            f"(frag {k} epoch {epoch})"
+                        )
+                    mix_m, mix_b, avg_g, _partner, n = res
+                    new_m, new_b = noloco_step(
+                        mix_m,
+                        mix_b,
+                        avg_g,
+                        lr=opt.cfg.outer_lr,
+                        momentum=opt.cfg.outer_momentum,
+                        nesterov=opt.cfg.outer_nesterov,
+                    )
+                    fut.set_result(((new_m, new_b), n))
+                    return
                 avg, n = opt.backend.all_reduce(
                     arrays,
                     timeout=opt.cfg.averaging_timeout,
@@ -336,11 +409,49 @@ class StreamScheduler:
                 tr.count("outer_fragment_rounds_dropped")
                 tr.gauge("outer_inflight_fragments", len(self._inflight))
             return state
-        opt._check_group_size(group)
+        if opt._gossip is None:
+            opt._check_group_size(group)
         if opt._ef is not None:
             opt._ef.commit(rec["round"])
         frag = rec["frag"]
-        if rec["placement"] == "device":
+        if opt._gossip is not None:
+            # gossip round: the comm thread already ran the NoLoCo step —
+            # the future carries the new (master, momentum) fragment, not
+            # a raw average. Land it exactly like the all-reduce true
+            # step: delta vs the retained estimate/boundary, then rebind.
+            new_m, new_b = avg
+            if rec["placement"] == "device":
+                delta = opt._plane.gossip_land(
+                    frag, new_m, new_b, base=rec["retained"]
+                )
+                state = opt._apply_frag_delta(state, frag, delta)
+            else:
+                if rec["eager"]:
+                    delta = [t - e for t, e in zip(new_m, rec["est_m"])]
+                else:
+                    delta = [t - b for t, b in zip(new_m, rec["boundary"])]
+                state = opt._apply_frag_delta(state, frag, delta)
+                oo = opt.outer_opt
+                new_master = list(opt.master)
+                for j, i in enumerate(frag):
+                    new_master[i] = np.asarray(new_m[j], np.float32)
+                new_opt = OuterSGD(
+                    lr=oo.lr, momentum=oo.momentum, nesterov=oo.nesterov
+                )
+                if oo.momentum != 0.0:
+                    base = (
+                        [np.zeros_like(p) for p in opt.master]
+                        if oo.bufs is None
+                        else list(oo.bufs)
+                    )
+                    if new_b is not None:
+                        for j, i in enumerate(frag):
+                            base[i] = np.asarray(new_b[j], np.float32)
+                    new_opt.bufs = base
+                with opt._serve_lock:
+                    opt.master = new_master
+                    opt.outer_opt = new_opt
+        elif rec["placement"] == "device":
             if rec["eager"]:
                 delta = opt._plane.stream_land(
                     frag, avg, est_m=rec["retained"]

@@ -1174,6 +1174,63 @@ class TcpBackend(OuterBackend):
             out.append(self._own_progress)
         return out
 
+    # -- gossip pair exchange (diloco/gossip.py) -----------------------------
+
+    def gossip_view(self):
+        """(members, link matrix) for the pair scheduler: membership from
+        the gossiped progress cache (refreshed when stale by
+        peer_progress — no barrier, no rendezvous round), links from the
+        same announce channel when the adaptive layer is on."""
+        members = {p.peer_id for p in self.peer_progress()}
+        members.add(self._peer_id)
+        links = self.links.matrix() if self._adaptive() else None
+        return sorted(members), links
+
+    def pair_exchange(self, payload, meta, *, partner_id, round_key,
+                      timeout=None):
+        """One symmetric push-pull with ``partner_id``: push own frame on
+        the existing bulk/wire stack (stripes, pipelining, WAN shaping),
+        then await the partner's identical push in the generic mailbox.
+        Both server planes already mailbox any "push" frame, so the pair
+        round is purely client-side. Raises AllReduceError when the
+        partner is unknown, unreachable, or never deposits in time."""
+        timeout = timeout if timeout else 300.0
+        deadline = time.monotonic() + timeout
+        try:
+            return self._run(
+                self._pair_exchange(payload, dict(meta), partner_id,
+                                    round_key, deadline),
+                timeout=timeout + 10.0,
+            )
+        except asyncio.TimeoutError as e:
+            raise AllReduceError(
+                f"gossip pair round {round_key} with {partner_id} "
+                f"timed out"
+            ) from e
+
+    async def _pair_exchange(self, payload, meta, partner_id, round_key,
+                             deadline):
+        peer = self._peers_view.get(partner_id)
+        if not peer or not peer.get("host"):
+            raise AllReduceError(
+                f"gossip partner {partner_id} not in registry view"
+            )
+        send_meta = {
+            **meta,
+            "round": round_key,
+            "from": self._peer_id,
+            WIRE_VERSION_META_KEY: WIRE_VERSION,
+        }
+        await self._send_part(
+            peer["host"], int(peer["port"]), "push", send_meta, payload,
+            timeout=max(1.0, deadline - time.monotonic()),
+            peer_id=partner_id,
+        )
+        p_meta, p_payload = await self._wait_mailbox(
+            (round_key, "push", partner_id), deadline
+        )
+        return p_meta, bytes(p_payload)
+
     def _checkout_buf(self, count: int) -> np.ndarray:
         with self._pool_lock:
             free = self._free_bufs.get(count)

@@ -49,7 +49,12 @@ _COUNTER_FIELDS = (
 )
 # round-health ledger keys carried verbatim (stage StageTimes rows ride
 # as their ``*_s`` ledger names)
-_HEALTH_FIELDS = ("round", "group_size", "expected", "elastic", "retries")
+_HEALTH_FIELDS = (
+    "round", "group_size", "expected", "elastic", "retries",
+    # gossip pair rounds (diloco/gossip.py): who this worker mixed with
+    # last round, and whether the round was a pair round at all
+    "gossip", "partner",
+)
 _STAGE_SUFFIX = "_s"
 
 

@@ -70,6 +70,14 @@ _COMPRESS_OUT = os.environ.get("ODTP_COMPRESS_BENCH_OUT") or os.path.join(
 _HIER_OUT = os.environ.get("ODTP_HIER_BENCH_OUT") or os.path.join(
     REPO, "HIER_BENCH.json"
 )
+# --gossip mode banks here: NoLoCo pairwise outer rounds vs the global
+# butterfly all-reduce across growing single-host loopback galaxies, the
+# artifact the barrier-free gossip plane (outer_mode="gossip") is judged
+# against: per-round cost stays ~flat in galaxy size and wire bytes per
+# worker per round are independent of N
+_GOSSIP_OUT = os.environ.get("ODTP_GOSSIP_BENCH_OUT") or os.path.join(
+    REPO, "GOSSIP_BENCH.json"
+)
 
 
 def expected_group(peers: int, group_cap: int) -> int:
@@ -1343,6 +1351,191 @@ def stream_main(args) -> None:
         )
 
 
+def _gossip_galaxy(
+    n_workers: int, rounds: int, model: str, compression: str, mode: str
+) -> tuple[list[list[float]], list[list[float]], list[int], list[int]]:
+    """One galaxy of ``n_workers`` loopback threads running ``rounds``
+    outer rounds in ``mode`` ("gossip" pair exchange vs "allreduce"
+    global butterfly stand-in). Returns per-worker wall seconds, per-
+    worker CPU (thread_time) seconds, wire bytes, and dropped counts.
+
+    Wall time on an oversubscribed single host mostly measures the
+    timesharing of N threads; per-round THREAD CPU is the scalable
+    signal — it excludes waiting, so it prices exactly the work one
+    worker must do per round (encode/decode/mix for gossip; codec
+    roundtrip plus a 1/N share of the O(N x model) published sum for the
+    all-reduce)."""
+    from opendiloco_tpu.diloco.gossip import GossipPlane
+    from opendiloco_tpu.diloco.loopback import LoopbackWorld
+
+    world = LoopbackWorld(n_workers, compression=compression)
+    backends = world.make_backends()
+    wall: list[list[float]] = [[] for _ in range(n_workers)]
+    cpu: list[list[float]] = [[] for _ in range(n_workers)]
+    wire = [0] * n_workers
+    drops = [0] * n_workers
+    errors: list[str] = []
+    start = threading.Barrier(n_workers)
+
+    def worker(rank: int) -> None:
+        try:
+            masters = make_leaves(model, rank)
+            bufs = make_leaves(model, 100 + rank)
+            pgs = make_leaves(model, 200 + rank)
+            idxs = list(range(len(masters)))
+            plane = (
+                GossipPlane(
+                    backends[rank], len(masters),
+                    compression=compression, error_feedback=True,
+                )
+                if mode == "gossip" else None
+            )
+            start.wait()
+            for r in range(rounds):
+                t0 = time.perf_counter()
+                c0 = time.thread_time()
+                if plane is None:
+                    backends[rank].all_reduce(
+                        pgs, timeout=600.0, tag="bench", epoch=r
+                    )
+                else:
+                    res = plane.exchange(
+                        epoch=r, frag_id=0, idxs=idxs, masters=masters,
+                        bufs=bufs, pgs=pgs, timeout=600.0,
+                    )
+                    if res is None:
+                        drops[rank] += 1
+                    else:
+                        wire[rank] += backends[rank].last_round_health.get(
+                            "wire_bytes", 0
+                        )
+                cpu[rank].append(time.thread_time() - c0)
+                wall[rank].append(time.perf_counter() - t0)
+        except Exception as e:  # pragma: no cover - surfaced to the parent
+            errors.append(f"{mode} worker {rank}: {e!r}")
+            try:
+                start.abort()
+            except Exception:
+                pass
+
+    threads = [
+        threading.Thread(target=worker, args=(r,)) for r in range(n_workers)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    if errors:
+        raise SystemExit("gossip bench galaxy failed: " + "; ".join(errors))
+    return wall, cpu, wire, drops
+
+
+def gossip_main(args) -> None:
+    """Barrier-free gossip outer rounds vs the global collective, swept
+    over galaxy size on one host: N loopback worker threads per galaxy,
+    each round either ONE NoLoCo pair exchange (masters+momentum on the
+    fp16 state codec, pseudo-grads on blockwise4bit with per-partner
+    error feedback) or one global all-reduce of the same pseudo-grads
+    through the same world. Headlines: per-worker per-round CPU stays
+    ~flat for gossip while the collective grows with N, and gossip wire
+    bytes per worker per round are independent of N. Banks
+    GOSSIP_BENCH.json."""
+    if args.selftest:
+        sizes, rounds, model = (4, 6), 3, "tiny:1"
+        out_path = os.environ.get("ODTP_GOSSIP_BENCH_OUT") or os.path.join(
+            os.environ.get("TMPDIR", "/tmp"), "GOSSIP_BENCH.selftest.json"
+        )
+    else:
+        sizes, rounds, model = (8, 16, 32), 10, "tiny:4"
+        out_path = _GOSSIP_OUT
+    compression = "blockwise4bit"
+    print(
+        f"gossip bench: galaxies {sizes}, {rounds} rounds, model {model}, "
+        f"grad codec {compression} (+fp16 state sections on the pair wire)"
+    )
+    rows = []
+    for n in sizes:
+        for mode in ("gossip", "allreduce"):
+            t0 = time.time()
+            wall, cpu, wire, drops = _gossip_galaxy(
+                n, rounds, model, compression, mode
+            )
+            flat_wall = [t for ts in wall for t in ts]
+            flat_cpu = [t for ts in cpu for t in ts]
+            paired = rounds * n - sum(drops) - (rounds * (n % 2))
+            row = {
+                "mode": mode,
+                "peers": n,
+                "rounds": rounds,
+                "median_round_s": round(statistics.median(flat_wall), 4),
+                "p90_round_s": round(
+                    sorted(flat_wall)[int(0.9 * (len(flat_wall) - 1))], 4
+                ),
+                "median_round_cpu_s": round(statistics.median(flat_cpu), 4),
+                "dropped_rounds": sum(drops),
+            }
+            if mode == "gossip":
+                # self-rounds (odd N) ship zero bytes by design; average
+                # over the rounds that actually hit the wire
+                row["wire_mb_per_worker_round"] = round(
+                    sum(wire) / max(paired, 1) / 1e6, 3
+                )
+            rows.append(row)
+            print(
+                f"  n={n:3d} {mode:>9}: round {row['median_round_s'] * 1e3:7.1f} ms wall, "
+                f"{row['median_round_cpu_s'] * 1e3:7.1f} ms cpu"
+                + (
+                    f", {row.get('wire_mb_per_worker_round', 0):.3f} MB/worker/round"
+                    if mode == "gossip" else ""
+                )
+                + f"  [{time.time() - t0:.1f}s]"
+            )
+    doc = {
+        "bench": "gossip",
+        "model": model,
+        "galaxies": list(sizes),
+        "rounds": rounds,
+        "grad_codec": compression,
+        "selftest": bool(args.selftest),
+        "rows": rows,
+        "updated": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "host": {
+            "cores": os.cpu_count(), "loadavg": round(os.getloadavg()[0], 2)
+        },
+    }
+    g = {r["peers"]: r for r in rows if r["mode"] == "gossip"}
+    a = {r["peers"]: r for r in rows if r["mode"] == "allreduce"}
+    lo, hi = min(sizes), max(sizes)
+    doc["gossip_cpu_growth"] = round(
+        g[hi]["median_round_cpu_s"] / max(g[lo]["median_round_cpu_s"], 1e-9), 3
+    )
+    doc["allreduce_cpu_growth"] = round(
+        a[hi]["median_round_cpu_s"] / max(a[lo]["median_round_cpu_s"], 1e-9), 3
+    )
+    with open(out_path, "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+        f.write("\n")
+    print(
+        f"per-round cpu growth x{doc['gossip_cpu_growth']:.2f} (gossip) vs "
+        f"x{doc['allreduce_cpu_growth']:.2f} (all-reduce) from n={lo} to "
+        f"n={hi}; banked {out_path}"
+    )
+    if sum(r["dropped_rounds"] for r in rows):
+        raise SystemExit("gossip bench dropped rounds on a healthy galaxy")
+    wires = {
+        r["wire_mb_per_worker_round"] for r in rows if r["mode"] == "gossip"
+    }
+    if len(wires) > 1 and (max(wires) - min(wires)) / max(wires) > 0.01:
+        raise SystemExit(
+            f"gossip wire bytes vary with galaxy size: {sorted(wires)}"
+        )
+    if not args.selftest and doc["gossip_cpu_growth"] > 2.0:
+        raise SystemExit(
+            f"gossip per-round cpu grew x{doc['gossip_cpu_growth']:.2f} from "
+            f"n={lo} to n={hi} — not flat"
+        )
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--peers", type=int, default=2)
@@ -1403,12 +1596,21 @@ def main() -> None:
         "chaos wan_bps uplink shaping; banks HIER_BENCH.json",
     )
     ap.add_argument(
+        "--gossip", action="store_true",
+        help="barrier-free NoLoCo pair rounds vs the global collective "
+        "across growing single-host loopback galaxies; banks "
+        "GOSSIP_BENCH.json",
+    )
+    ap.add_argument(
         "--selftest", action="store_true",
-        help="with --hetero/--stream/--compress/--hier: small/fast CI "
-        "shape that checks the loop works without asserting the "
-        "speedup/overhead line",
+        help="with --hetero/--stream/--compress/--hier/--gossip: "
+        "small/fast CI shape that checks the loop works without "
+        "asserting the speedup/overhead line",
     )
     args = ap.parse_args()
+    if args.gossip:
+        gossip_main(args)
+        return
     if args.stream:
         stream_main(args)
         return

@@ -44,6 +44,13 @@ at the repo root:
 
     python scripts/chaos_soak.py [--workers 8] [--rounds 6] [--out ...]
     python scripts/chaos_soak.py --selftest   # 4-worker CI variant
+
+``--gossip`` runs the barrier-free NoLoCo pair-round leg instead: an
+in-process loopback galaxy under membership churn (one worker leaves
+mid-soak, one joins in its place) plus stale-view probe rounds against
+the departed worker, gating zero error rows and exact error-feedback
+residual conservation across every dropped round. Banked additively
+into CHAOS_SOAK.json under ``"gossip_leg"``.
 """
 import argparse
 import glob
@@ -227,6 +234,252 @@ def wait_for_midround_evidence(
     return False
 
 
+def gossip_leg(args) -> int:
+    """Barrier-free NoLoCo pair-round soak under membership churn.
+
+    An in-process loopback galaxy runs ``--rounds`` gossip epochs on the
+    4-bit + error-feedback wire. At the mid-soak boundary one worker
+    LEAVES (closes without announcing) and a new worker JOINS in its
+    place — the survivors' next schedules must simply pair over the new
+    membership view, no rendezvous, no barrier in the data plane (the
+    epoch barrier here is test scaffolding that makes the churn boundary
+    deterministic, not part of the protocol). Afterwards a survivor runs
+    probe rounds against the DEAD worker through a deliberately stale
+    membership view — the churn-outruns-view case — which must resolve
+    as dropped-round non-events.
+
+    Gates: every surviving worker (and the joiner) completes all its
+    epochs; zero error rows (drops are non-events, exceptions are not);
+    the per-partner error-feedback residual mass is EXACTLY conserved
+    across every dropped round; every round is a pair (group <= 2); the
+    pair mailbox ends empty. Banked additively into CHAOS_SOAK.json
+    under ``"gossip_leg"``.
+    """
+    import threading
+
+    from opendiloco_tpu.diloco.gossip import GossipPlane
+    from opendiloco_tpu.diloco.loopback import LoopbackBackend, LoopbackWorld
+    from opendiloco_tpu.diloco.outer_optimizer import noloco_step
+
+    n = min(args.workers, 4) if args.selftest else min(args.workers, 6)
+    n -= n % 2  # keep membership even so self-rounds stay a non-factor
+    rounds = args.rounds
+    churn_at = max(1, rounds // 2)
+    shapes = ((64, 8), (33,), (16, 4))
+    idxs = list(range(len(shapes)))
+    t0 = time.time()
+
+    # latency jitter + transient connection drops on the pair exchanges,
+    # same fault plane the TCP soak arms (seeded: runs replay)
+    prev_chaos = os.environ.get("ODTP_CHAOS")
+    os.environ["ODTP_CHAOS"] = "seed=13;drop_conn=0.05;delay_ms=1..15"
+
+    world = LoopbackWorld(n, compression="blockwise4bit")
+    backends = world.make_backends()
+    planes = [
+        GossipPlane(
+            b, len(shapes), compression="blockwise4bit", error_feedback=True
+        )
+        for b in backends
+    ]
+    leave_rank = n - 1
+    leaver_gone = threading.Event()
+    joinbox: dict = {}
+
+    def admit_joiner():
+        # barrier action at the churn epoch: runs once, after every party
+        # arrived and before any is released — so epoch ``churn_at``'s
+        # membership view is the same for every scheduler
+        leaver_gone.wait(timeout=60.0)
+        b = LoopbackBackend(world, f"peer-{n}")
+        joinbox["backend"] = b
+        joinbox["plane"] = GossipPlane(
+            b, len(shapes), compression="blockwise4bit", error_feedback=True
+        )
+
+    barriers = [
+        threading.Barrier(n, action=admit_joiner if e == churn_at else None)
+        for e in range(rounds)
+    ]
+
+    errors: list[str] = []
+    ef_violations: list[str] = []
+    dropped = [0]
+    completed: dict[str, int] = {}
+    stat_lock = threading.Lock()
+
+    def guarded_exchange(plane, **kw):
+        before = plane.residual_mass()
+        res = plane.exchange(**kw)
+        if res is None:
+            after = plane.residual_mass()
+            with stat_lock:
+                dropped[0] += 1
+                if after != before:
+                    ef_violations.append(
+                        f"{plane.backend.peer_id}: dropped round changed "
+                        f"residual mass {before!r} -> {after!r}"
+                    )
+        return res
+
+    def run_epochs(backend, plane, rank_seed, first, last, skip_first=False):
+        rng = np.random.default_rng(100 + rank_seed)
+        masters = [rng.standard_normal(s).astype(np.float32) for s in shapes]
+        bufs = [np.zeros_like(m) for m in masters]
+        done = 0
+        for e in range(first, last):
+            if not (skip_first and e == first):
+                barriers[e].wait()
+            pgs = [
+                (rng.standard_normal(s) * 0.01).astype(np.float32)
+                for s in shapes
+            ]
+            res = guarded_exchange(
+                plane, epoch=e, frag_id=0, idxs=idxs, masters=masters,
+                bufs=bufs, pgs=pgs, timeout=30.0,
+            )
+            if res is not None:
+                mix_m, mix_b, avg_g, _partner, _grp = res
+                masters, bufs = noloco_step(
+                    mix_m, mix_b, avg_g, lr=0.7, momentum=0.9, nesterov=True
+                )
+            done += 1
+        if not all(np.isfinite(m).all() for m in masters):
+            raise RuntimeError(f"{backend.peer_id}: non-finite master")
+        with stat_lock:
+            completed[backend.peer_id] = done
+
+    def original_worker(rank):
+        try:
+            last = churn_at if rank == leave_rank else rounds
+            run_epochs(backends[rank], planes[rank], rank, 0, last)
+            if rank == leave_rank:
+                backends[rank].close()  # leaves without announcing
+                leaver_gone.set()
+        except Exception as exc:  # pragma: no cover - banked as evidence
+            with stat_lock:
+                errors.append(f"{backends[rank].peer_id}: {exc!r}")
+            leaver_gone.set()
+
+    def joiner_worker():
+        try:
+            # the backend is created by the barrier action the moment the
+            # churn epoch's barrier trips; the first wait is what admits
+            # us, so the churn epoch itself is exchanged without another
+            barriers[churn_at].wait()
+            run_epochs(
+                joinbox["backend"], joinbox["plane"], n, churn_at, rounds,
+                skip_first=True,
+            )
+        except Exception as exc:  # pragma: no cover - banked as evidence
+            with stat_lock:
+                errors.append(f"joiner: {exc!r}")
+
+    threads = [
+        threading.Thread(target=original_worker, args=(r,)) for r in range(n)
+    ] + [threading.Thread(target=joiner_worker)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+    # stale-view probes: a survivor keeps scheduling against the DEAD
+    # worker (its view outran by churn) — every probe must drop,
+    # conserving the residual it already holds.
+    probe_drops = 0
+    if not errors:
+        survivor_b, survivor_p = backends[0], planes[0]
+        dead_id = backends[leave_rank].peer_id
+        orig_view = survivor_b.gossip_view
+        survivor_b.gossip_view = lambda: (
+            sorted([survivor_b.peer_id, dead_id]), None
+        )
+        try:
+            rng = np.random.default_rng(999)
+            for i in range(3):
+                pgs = [
+                    (rng.standard_normal(s) * 0.01).astype(np.float32)
+                    for s in shapes
+                ]
+                masters = [np.zeros(s, np.float32) for s in shapes]
+                res = guarded_exchange(
+                    survivor_p, epoch=10_000 + i, frag_id=0, idxs=idxs,
+                    masters=masters, bufs=None, pgs=pgs, timeout=10.0,
+                )
+                if res is None:
+                    probe_drops += 1
+        finally:
+            survivor_b.gossip_view = orig_view
+
+    if prev_chaos is None:
+        os.environ.pop("ODTP_CHAOS", None)
+    else:
+        os.environ["ODTP_CHAOS"] = prev_chaos
+
+    ledgers = [b.round_ledger for b in backends] + (
+        [joinbox["backend"].round_ledger] if "backend" in joinbox else []
+    )
+    all_pairs = all(
+        h.get("group_size", 0) <= 2 for led in ledgers for h in led
+    )
+    joiner_paired = any(
+        h.get("group_size") == 2
+        for h in (joinbox["backend"].round_ledger if "backend" in joinbox
+                  else [])
+    )
+    expected = {backends[r].peer_id: (churn_at if r == leave_rank else rounds)
+                for r in range(n)}
+    if "backend" in joinbox:
+        expected[joinbox["backend"].peer_id] = rounds - churn_at
+    residual_mass = round(
+        sum(p.residual_mass() for p in planes)
+        + (joinbox["plane"].residual_mass() if "plane" in joinbox else 0.0), 6
+    )
+    gates = {
+        "all_epochs_completed": completed == expected,
+        "zero_error_rows": not errors,
+        "every_probe_dropped_not_errored": probe_drops == 3,
+        "ef_mass_conserved_across_drops": not ef_violations,
+        "every_round_is_a_pair": all_pairs,
+        "joiner_got_paired": joiner_paired,
+        "pair_mailbox_empty": not world._pairbox,
+    }
+    ok = all(gates.values())
+    report = {
+        "bench": "gossip_chaos_leg",
+        "workers": n,
+        "rounds": rounds,
+        "churn_epoch": churn_at,
+        "left": backends[leave_rank].peer_id,
+        "joined": joinbox["backend"].peer_id if "backend" in joinbox else None,
+        "chaos": "seed=13;drop_conn=0.05;delay_ms=1..15",
+        "compression": "blockwise4bit",
+        "error_feedback": True,
+        "gates": gates,
+        "passed": ok,
+        "dropped_rounds": dropped[0],
+        "stale_view_probe_drops": probe_drops,
+        "ef_violations": ef_violations,
+        "errors": errors,
+        "completed": completed,
+        "expected": expected,
+        "final_residual_mass": residual_mass,
+        "elapsed_s": round(time.time() - t0, 1),
+    }
+    try:
+        with open(args.out) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        doc = {}
+    doc["gossip_leg"] = report
+    with open(args.out, "w") as f:
+        json.dump(doc, f, indent=2)
+        f.write("\n")
+    print(json.dumps(report, indent=2))
+    print("GOSSIP CHAOS LEG " + ("PASSED" if ok else "FAILED"))
+    return 0 if ok else 1
+
+
 _FAULT_RE = re.compile(r"chaos: injected (\w+)")
 
 
@@ -274,6 +527,12 @@ def main() -> int:
         help="small galaxy (4 workers, 4 rounds), artifacts under the "
         "workdir, same hard gates incl. blackbox dumps + postmortem (CI)",
     )
+    ap.add_argument(
+        "--gossip", action="store_true",
+        help="run the NoLoCo gossip churn leg instead (in-process pair "
+        "rounds, leave+join mid-soak, EF conservation gates); banked "
+        "additively under CHAOS_SOAK.json \"gossip_leg\"",
+    )
     args = ap.parse_args()
     if args.selftest:
         args.workers = min(args.workers, 4)
@@ -287,6 +546,9 @@ def main() -> int:
     if args.straggle_rank == kill_rank:
         args.straggle_rank = (kill_rank + 1) % args.workers
     args.obs_dir = os.path.join(args.workdir, "obs")
+    if args.gossip:
+        os.makedirs(args.workdir, exist_ok=True)
+        return gossip_leg(args)
 
     os.makedirs(args.workdir, exist_ok=True)
     shutil.rmtree(args.obs_dir, ignore_errors=True)  # stale dumps poison gates

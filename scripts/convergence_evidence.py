@@ -42,6 +42,20 @@ ARMS = {
     # one fragment per boundary, blocking (arxiv 2501.18512)
     "streaming": (2, {}),
     "gossip": (0, {"outer_mode": "gossip"}),
+    # barrier-free NoLoCo pair rounds (arxiv 2506.10911) composed with
+    # every feature the old gossip constraints rejected: streamed
+    # fragments, eager overlap, and the 4-bit wire with per-partner
+    # error-feedback residuals. The composition's curve is judged
+    # against the blocking diloco one like every other arm
+    "gossip_noloco": (
+        2,
+        {
+            "outer_mode": "gossip",
+            "overlap_comm": "eager",
+            "compression": "blockwise4bit",
+            "error_feedback": True,
+        },
+    ),
     "overlap_delayed": (0, {"overlap_comm": "delayed"}),
     "overlap_eager": (0, {"overlap_comm": "eager"}),
     # staggered in-phase fragment all-reduce with eager first-step

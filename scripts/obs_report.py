@@ -228,6 +228,59 @@ def serve_breakdown(events: list[dict]) -> dict[str, float]:
     return out
 
 
+def gossip_breakdown(events: list[dict]) -> dict[str, dict]:
+    """One worker's gossip pair-round ledger from the
+    ``outer/gossip_pair`` spans: per-partner round/dropped counts and
+    pair wall seconds. Empty when the worker never ran gossip rounds."""
+    partners: dict[str, dict] = {}
+    for ev in events:
+        if ev.get("ph") != "X" or ev.get("name") != "outer/gossip_pair":
+            continue
+        args = ev.get("args") or {}
+        pid = str(args.get("partner", "?"))
+        slot = partners.setdefault(
+            pid, {"rounds": 0, "dropped": 0, "pair_s": 0.0}
+        )
+        slot["rounds"] += 1
+        if args.get("dropped"):
+            slot["dropped"] += 1
+        slot["pair_s"] += ev.get("dur", 0) / 1e6
+    return partners
+
+
+def gossip_section(workers, counters: dict) -> dict:
+    """Gossip surface: who paired with whom (the mixing graph the NoLoCo
+    convergence story rests on), dropped-round counts, and the pair wire
+    volume — straight from the spans/counters, no bench artifact
+    needed."""
+    per_worker: dict[str, dict] = {}
+    for wid, events, _meta in workers:
+        b = gossip_breakdown(events)
+        if not b:
+            continue
+        per_worker[str(wid)] = {
+            "rounds": sum(s["rounds"] for s in b.values()),
+            "dropped": sum(s["dropped"] for s in b.values()),
+            "distinct_partners": len([p for p in b if p != str(wid)]),
+            "per_partner": {
+                p: {
+                    "rounds": b[p]["rounds"],
+                    "dropped": b[p]["dropped"],
+                    "pair_s": round(b[p]["pair_s"], 6),
+                }
+                for p in sorted(b)
+            },
+        }
+    if not per_worker:
+        return {}
+    return {
+        "rounds": sum(w["rounds"] for w in per_worker.values()),
+        "dropped": sum(w["dropped"] for w in per_worker.values()),
+        "wire_bytes": int(counters.get("gossip_wire_bytes", 0)),
+        "per_worker": {w: per_worker[w] for w in sorted(per_worker)},
+    }
+
+
 def galaxy_section(trace_dir: str) -> dict:
     """The overseer galaxy matrix as banked by the flight recorders: union
     of every ``blackbox-*.json`` dump in ``trace_dir`` keeping the freshest
@@ -487,12 +540,14 @@ def merge_report(trace_dir: str) -> tuple[dict, dict]:
 
     galaxy = galaxy_section(trace_dir)
     fleet = fleet_section(counters)
+    gossip = gossip_section(workers, counters)
 
     body = {
         "workers_traced": len(workers),
         "trace_files": [os.path.basename(p) for p in paths],
         "per_round": rounds,
         **({"per_fragment": fragments} if fragments else {}),
+        **({"gossip": gossip} if gossip else {}),
         **({"serve": serve} if serve else {}),
         **({"fleet": fleet} if fleet else {}),
         **({"wire_wan_split": wan} if wan else {}),

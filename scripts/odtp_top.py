@@ -26,9 +26,9 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 _COLS = (
-    ("worker", 10), ("round", 18), ("epoch", 5), ("loss", 8),
-    ("tok/s", 9), ("pg_norm", 9), ("wan_tx", 9), ("round_s", 8),
-    ("stale", 5), ("age_s", 6),
+    ("worker", 10), ("round", 18), ("partner", 10), ("epoch", 5),
+    ("loss", 8), ("tok/s", 9), ("pg_norm", 9), ("wan_tx", 9),
+    ("round_s", 8), ("stale", 5), ("age_s", 6),
 )
 
 
@@ -90,9 +90,13 @@ def render(matrix: dict, now: float) -> str:
         stages = vec.get("stages") or {}
         ts = float(vec.get("ts", 0) or 0)
         cells = (
-            vec.get("worker", pid), vec.get("round"), vec.get("epoch"),
+            vec.get("worker", pid), vec.get("round"),
+            # gossip rounds: who this worker mixed with last ("-" under
+            # the global collective); pair_s is their round_s analogue
+            vec.get("partner"), vec.get("epoch"),
             vec.get("loss"), vec.get("tokens_per_s"), vec.get("pg_norm"),
-            vec.get("wire_tx_bytes_wan"), stages.get("round_s"),
+            vec.get("wire_tx_bytes_wan"),
+            stages.get("round_s", stages.get("pair_s")),
             vec.get("staleness"), round(now - ts, 1) if ts else None,
         )
         lines.append(" ".join(

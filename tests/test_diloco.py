@@ -334,8 +334,8 @@ def test_streaming_fragments_sync_one_fragment_per_boundary(tiny_cfg):
 
 
 def test_streaming_fragments_config_constraints():
-    with pytest.raises(Exception, match="allreduce"):
-        DilocoConfig(streaming_fragments=2, outer_mode="gossip")
+    # streaming x gossip composes now: keyed per-fragment pair rounds
+    DilocoConfig(streaming_fragments=2, outer_mode="gossip")
     with pytest.raises(Exception, match="average_state_every"):
         DilocoConfig(streaming_fragments=2, average_state_every=4)
     with pytest.raises(Exception, match="stream_stagger"):

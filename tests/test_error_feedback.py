@@ -142,15 +142,15 @@ def test_config_rejects_unsupported_ef_combos():
             compression="none",
             error_feedback=True,
         )
-    with pytest.raises(ValidationError):
-        # gossip mixes state, not pseudo-gradients; no per-round wire error
-        DilocoConfig(
-            local_steps=3,
-            backend="loopback",
-            compression="blockwise4bit",
-            error_feedback=True,
-            outer_mode="gossip",
-        )
+    # gossip pair rounds carry the pseudo-gradient on the lossy codec and
+    # keep per-PARTNER residuals (GossipPlane) — the combo composes now
+    DilocoConfig(
+        local_steps=3,
+        backend="loopback",
+        compression="blockwise4bit",
+        error_feedback=True,
+        outer_mode="gossip",
+    )
 
 
 # ---------------------------------------------------------------------------

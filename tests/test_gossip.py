@@ -1,0 +1,413 @@
+"""NoLoCo gossip outer rounds: pair scheduling, link-aware sampling,
+pair-wire exchange parity, and dropped-round semantics (diloco/gossip.py).
+
+The scheduler tests pin the agreement-without-messaging contract: every
+worker derives the identical pairing from (members, key, seed) alone —
+including across OS processes, where hash randomization would break a
+naive seeding scheme. The exchange tests drive two real loopback
+backends from two threads through the full encode/push-pull/decode/mix
+path and assert bit-identical mixed state on both ends.
+"""
+
+import itertools
+import json
+import subprocess
+import sys
+import threading
+
+import jax
+import numpy as np
+import pytest
+
+from opendiloco_tpu.config import DilocoConfig
+from opendiloco_tpu.diloco import DiLoCoOptimizer
+from opendiloco_tpu.diloco.gossip import (
+    GossipPlane,
+    _pair_key,
+    link_pair_weights,
+    pair_bps,
+    pair_schedule,
+)
+from opendiloco_tpu.diloco.loopback import LoopbackWorld
+from opendiloco_tpu.diloco.outer_optimizer import OuterSGD, noloco_step
+from opendiloco_tpu.parallel.mesh import build_mesh
+from opendiloco_tpu.trainer import InnerTrainer, TrainerConfig
+
+
+# ---------------------------------------------------------------------------
+# pair scheduling
+# ---------------------------------------------------------------------------
+
+
+def test_pair_schedule_deterministic_symmetric_and_total():
+    members = [f"peer-{i}" for i in range(8)]
+    a = pair_schedule(members, "f0-e3", seed=5)
+    b = pair_schedule(list(reversed(members)), "f0-e3", seed=5)
+    assert a == b  # member order must not matter
+    assert set(a) == set(members)  # total: every member paired
+    for x, y in a.items():
+        assert a[y] == x  # symmetric
+        assert x != y  # even N: no self-rounds
+    # different round keys re-pair (at least one of a few keys differs)
+    assert any(
+        pair_schedule(members, f"f0-e{e}", seed=5) != a for e in range(4, 10)
+    )
+    # a different galaxy seed re-pairs too
+    assert any(
+        pair_schedule(members, "f0-e3", seed=s) != a for s in range(6, 12)
+    )
+
+
+def test_pair_schedule_agrees_across_processes():
+    """random.Random(str) hashes via sha512, NOT the per-process salted
+    str hash — so a fresh interpreter must derive the identical pairing."""
+    members = [f"peer-{i}" for i in range(9)]
+    local = pair_schedule(members, "f2-e7", seed=11)
+    code = (
+        "import json, sys\n"
+        "from opendiloco_tpu.diloco.gossip import pair_schedule\n"
+        "m = [f'peer-{i}' for i in range(9)]\n"
+        "print(json.dumps(pair_schedule(m, 'f2-e7', seed=11)))\n"
+    )
+    out = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True,
+        text=True,
+        check=True,
+        timeout=120,
+    )
+    assert json.loads(out.stdout.strip()) == local
+
+
+def test_pair_schedule_odd_galaxy_exactly_one_self_round():
+    for n in (3, 5, 9):
+        pairs = pair_schedule([f"p{i}" for i in range(n)], "f0-e0", seed=0)
+        selfs = [x for x, y in pairs.items() if x == y]
+        assert len(selfs) == 1
+        for x, y in pairs.items():
+            assert pairs[y] == x
+
+
+def test_link_bias_prefers_fast_pairs_but_never_starves(monkeypatch):
+    """a<->b is a fat link, everything touching d is thin: over many
+    rounds a draws b far more often than d, yet d is still drawn (weight
+    floor: NoLoCo mixing needs connectivity to every peer)."""
+    monkeypatch.setenv("ODTP_GOSSIP_LINK_BIAS", "3.0")
+    monkeypatch.setenv("ODTP_GOSSIP_LINK_FLOOR", "0.05")
+    members = ["a", "b", "c", "d"]
+    fast, slow = 1e9, 1e6
+    matrix = {
+        p: {"v": 1, "peers": {q: {"bps": slow} for q in members if q != p}}
+        for p in members
+    }
+    matrix["a"]["peers"]["b"]["bps"] = fast
+    matrix["b"]["peers"]["a"]["bps"] = fast
+    weights = link_pair_weights(matrix, members)
+    assert weights is not None
+    assert weights[_pair_key("a", "b")] == 1.0
+    assert weights[_pair_key("a", "d")] == pytest.approx(0.05)
+    counts = {p: 0 for p in members}
+    for e in range(400):
+        pairs = pair_schedule(members, f"f0-e{e}", weights=weights, seed=0)
+        counts[pairs["a"]] += 1
+    assert counts["b"] > counts["d"] > 0  # biased, never starved
+    assert counts["c"] > 0
+
+
+def test_link_weights_bucketing_and_unknown_links():
+    """Bucketing to powers of two makes the weight immune to EWMA wiggle
+    (two workers' snapshots differing in the last digits must agree);
+    unmeasured links weigh neutral 1.0."""
+    members = ["a", "b", "c"]
+
+    def mat(bps_ab):
+        return {
+            "a": {"v": 1, "peers": {"b": {"bps": bps_ab}}},
+            "b": {"v": 1, "peers": {}},
+            "c": {"v": 1, "peers": {}},
+        }
+
+    w1 = link_pair_weights(mat(1.00e9), members)
+    w2 = link_pair_weights(mat(1.07e9), members)  # same power-of-2 bucket
+    assert w1 == w2
+    assert w1[_pair_key("a", "c")] == 1.0  # unknown link: neutral
+    assert pair_bps(mat(1e9), "b", "a") == 1e9  # direction-agnostic
+    assert pair_bps(mat(1e9), "b", "c") is None
+    assert link_pair_weights(None, members) is None
+    assert link_pair_weights({}, members) is None
+
+
+# ---------------------------------------------------------------------------
+# NoLoCo outer step
+# ---------------------------------------------------------------------------
+
+
+def test_noloco_step_is_nesterov_on_mixed_state():
+    rng = np.random.default_rng(0)
+    mix_m = [rng.normal(size=(4, 3)).astype(np.float32) for _ in range(2)]
+    mix_b = [rng.normal(size=(4, 3)).astype(np.float32) for _ in range(2)]
+    avg_g = [rng.normal(size=(4, 3)).astype(np.float32) for _ in range(2)]
+    new_m, new_b = noloco_step(
+        mix_m, mix_b, avg_g, lr=0.7, momentum=0.9, nesterov=True
+    )
+    oracle = OuterSGD(lr=0.7, momentum=0.9, nesterov=True)
+    oracle.bufs = [b.copy() for b in mix_b]
+    want = [m.copy() for m in mix_m]
+    oracle.step(want, [g.copy() for g in avg_g])
+    for a, b in zip(new_m, want):
+        np.testing.assert_array_equal(a, b)
+    for a, b in zip(new_b, oracle.bufs):
+        np.testing.assert_array_equal(a, b)
+    # momentum off: bufs stay None
+    m2, b2 = noloco_step(mix_m, None, avg_g, lr=0.5, momentum=0.0,
+                         nesterov=False)
+    assert b2 is None
+    for a, m, g in zip(m2, mix_m, avg_g):
+        np.testing.assert_allclose(a, m - np.float32(0.5) * g, rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# pair exchange through real loopback backends
+# ---------------------------------------------------------------------------
+
+
+def _leaves(rank, shapes=((6, 4), (5,))):
+    rng = np.random.default_rng(100 + rank)
+    return [rng.normal(size=s).astype(np.float32) for s in shapes]
+
+
+def _run_pair(world, planes, epoch=0, frag_id=0, momentum=True):
+    """Drive both workers' exchange() from two threads; returns per-rank
+    (result, masters, bufs, pgs)."""
+    out = [None, None]
+    inputs = []
+    for r in range(2):
+        masters = _leaves(r)
+        bufs = _leaves(10 + r) if momentum else None
+        pgs = _leaves(20 + r)
+        inputs.append((masters, bufs, pgs))
+
+    def worker(rank):
+        m, b, g = inputs[rank]
+        out[rank] = planes[rank].exchange(
+            epoch=epoch, frag_id=frag_id, idxs=list(range(len(m))),
+            masters=m, bufs=b, pgs=g, timeout=30.0,
+        )
+
+    ts = [threading.Thread(target=worker, args=(r,)) for r in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=60)
+    return out, inputs
+
+
+def test_exchange_none_codec_pair_average_exact(monkeypatch):
+    # masters normally ride the fp16 state codec on the pair wire; force
+    # raw f32 so the expected mix is the EXACT pair average
+    monkeypatch.setenv("ODTP_STATE_CODEC", "none")
+    world = LoopbackWorld(2)
+    backends = world.make_backends()
+    planes = [GossipPlane(b, 2, compression="none") for b in backends]
+    out, inputs = _run_pair(world, planes)
+    assert all(r is not None for r in out)
+    (m0, b0, g0), (m1, b1, g1) = inputs
+    for rank, res in enumerate(out):
+        mix_m, mix_b, avg_g, partner, n = res
+        assert n == 2
+        assert partner == backends[1 - rank].peer_id
+        # codec "none": the mix IS the exact sorted-order pair average
+        for x, a, b in zip(mix_m, m0, m1):
+            np.testing.assert_array_equal(x, (a + b) * np.float32(0.5))
+        for x, a, b in zip(avg_g, g0, g1):
+            np.testing.assert_array_equal(x, (a + b) * np.float32(0.5))
+    # round health landed on the backend ledger with the pair fields
+    for rank in range(2):
+        h = backends[rank].last_round_health
+        assert h["gossip"] and h["group_size"] == 2
+        assert h["partner"] == backends[1 - rank].peer_id
+
+
+@pytest.mark.parametrize("compression", ["blockwise4bit", "topk"])
+def test_exchange_lossy_codec_bit_identical_both_sides(compression):
+    """Both sides decode BOTH wire frames and average in sorted-pair
+    operand order, so the mixed state is bit-identical on both ends even
+    under lossy sub-8-bit codecs — paired masters cannot drift."""
+    world = LoopbackWorld(2)
+    backends = world.make_backends()
+    planes = [GossipPlane(b, 2, compression=compression) for b in backends]
+    out, _ = _run_pair(world, planes)
+    assert all(r is not None for r in out)
+    for a, b in zip(out[0][0], out[1][0]):  # mix_m
+        np.testing.assert_array_equal(a, b)
+    for a, b in zip(out[0][2], out[1][2]):  # avg_g
+        np.testing.assert_array_equal(a, b)
+    for a, b in zip(out[0][1], out[1][1]):  # mix_b
+        np.testing.assert_array_equal(a, b)
+
+
+def test_exchange_partner_death_drops_round_and_keeps_residual():
+    """Partner dies mid-exchange: the round resolves as a dropped-round
+    non-event — None result, per-partner EF residual neither lost nor
+    double-counted, next epoch re-pairs."""
+    world = LoopbackWorld(2)
+    backends = world.make_backends()
+    planes = [
+        GossipPlane(b, 2, compression="blockwise4bit", error_feedback=True)
+        for b in backends
+    ]
+    out, _ = _run_pair(world, planes)  # epoch 0: successful round seeds EF
+    assert all(r is not None for r in out)
+    mass = planes[0].residual_mass()
+    assert mass > 0.0  # 4-bit codec left roundtrip error behind
+    backends[1].close()  # partner leaves the swarm...
+    # ...but worker 0's membership view is STALE (the realistic failure:
+    # churn outruns the gossiped view) — it still schedules the pair
+    backends[0].gossip_view = lambda: (
+        [b.peer_id for b in backends], None
+    )
+    m, b, g = _leaves(0), _leaves(10), _leaves(20)
+    res = planes[0].exchange(
+        epoch=1, frag_id=0, idxs=[0, 1], masters=m, bufs=b, pgs=g,
+        timeout=5.0,
+    )
+    assert res is None
+    assert planes[0].residual_mass() == pytest.approx(mass)
+    assert backends[0].last_round_health.get("dropped") is True
+    # pairbox holds no abandoned deposits (GC on the error path)
+    assert not world._pairbox
+
+
+def test_self_round_policies(monkeypatch):
+    """Galaxy of one (the odd worker's view): 'nesterov' steps on own
+    state — exact f32 copies, no codec, n=1; 'hold' drops the round."""
+    world = LoopbackWorld(1)
+    (backend,) = world.make_backends()
+    m, b, g = _leaves(0), _leaves(10), _leaves(20)
+
+    plane = GossipPlane(backend, 2, compression="blockwise4bit")
+    res = plane.exchange(
+        epoch=0, frag_id=0, idxs=[0, 1], masters=m, bufs=b, pgs=g
+    )
+    mix_m, mix_b, avg_g, partner, n = res
+    assert n == 1 and partner == backend.peer_id
+    for x, y in zip(mix_m + mix_b + avg_g, m + b + g):
+        np.testing.assert_array_equal(x, y)  # codec never touches a self-round
+
+    monkeypatch.setenv("ODTP_GOSSIP_SELF_ROUND", "hold")
+    held = GossipPlane(backend, 2, compression="none")
+    assert held.exchange(
+        epoch=1, frag_id=0, idxs=[0, 1], masters=m, bufs=b, pgs=g
+    ) is None
+    assert backend.last_round_health.get("dropped") is True
+
+
+# ---------------------------------------------------------------------------
+# full-optimizer composition: streaming x gossip, device x gossip
+# ---------------------------------------------------------------------------
+
+_next_dev = itertools.count()
+
+
+def _make_trainer(tiny_cfg):
+    tc = TrainerConfig(
+        lr=1e-3, warmup_steps=2, total_steps=200, precision="fp32",
+        remat=False,
+    )
+    # one distinct single-device mesh per threaded worker (concurrent
+    # multi-device executions deadlock on the CPU client)
+    all_dev = jax.devices()
+    dev = [all_dev[next(_next_dev) % len(all_dev)]]
+    return InnerTrainer(tiny_cfg, tc, build_mesh("NO_SHARD", devices=dev))
+
+
+def _batches(seed, vocab, n, global_bs=8, seq=16):
+    rng = np.random.default_rng(seed)
+    for _ in range(n):
+        starts = rng.integers(0, vocab, (global_bs, 1))
+        ids = ((starts + np.arange(seq)) % vocab).astype(np.int32)
+        yield ids, ids.copy()
+
+
+def _host_masters(opt):
+    if opt._plane is not None:
+        masters, _ = opt._plane.host_state()
+        return masters
+    return [m.copy() for m in opt.master]
+
+
+def _run_galaxy(tiny_cfg, n_workers, n_steps, **cfg_kw):
+    world = LoopbackWorld(n_workers)
+    backends = world.make_backends()
+    results = [None] * n_workers
+    errors = []
+
+    def worker(rank):
+        try:
+            trainer = _make_trainer(tiny_cfg)
+            state = trainer.init_state(jax.random.key(7))
+            cfg = DilocoConfig(
+                local_steps=3,
+                backend="loopback",
+                outer_mode="gossip",
+                timeout_waiting_for_peers=60.0,
+                averaging_timeout=120.0,
+                **cfg_kw,
+            )
+            opt = DiLoCoOptimizer(
+                trainer, backends[rank], cfg, state, batch_size=8
+            )
+            for ids, labels in _batches(
+                100 + rank, tiny_cfg.vocab_size, n_steps
+            ):
+                state, m = opt.step(
+                    state, trainer.shard_batch(ids, labels, accum=1)
+                )
+                assert np.isfinite(float(m["loss"]))
+            state = opt.flush(state)
+            results[rank] = (_host_masters(opt), opt)
+        except Exception as e:  # pragma: no cover - surfaced below
+            errors.append(f"worker {rank}: {e!r}")
+
+    threads = [
+        threading.Thread(target=worker, args=(r,)) for r in range(n_workers)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=300)
+    assert not errors, errors
+    assert all(r is not None for r in results)
+    return results
+
+
+def test_streaming_gossip_two_workers_masters_agree(tiny_cfg):
+    """Streaming x gossip: each fragment pairs on its own clock and both
+    sides adopt the bit-identical NoLoCo-stepped fragment, so a 2-worker
+    galaxy's master trajectories stay identical with no barrier and no
+    global collective anywhere."""
+    results = _run_galaxy(
+        tiny_cfg, 2, n_steps=9,
+        streaming_fragments=2, overlap_comm="eager",
+    )
+    (m0, opt0), (m1, opt1) = results
+    assert opt0.epoch == opt1.epoch == 3
+    for a, b in zip(m0, m1):
+        np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-7)
+    # pair rounds only, never a global group
+    for h in opt0.backend.round_ledger:
+        assert h["group_size"] <= 2
+
+
+def test_device_gossip_two_workers_masters_agree(tiny_cfg):
+    """Device placement x gossip: pair rounds fetch one fragment via
+    host_frag and land through gossip_land; masters stay identical
+    across the pair."""
+    results = _run_galaxy(
+        tiny_cfg, 2, n_steps=6, outer_placement="device",
+    )
+    (m0, opt0), (m1, opt1) = results
+    assert opt0._plane is not None and opt1._plane is not None
+    assert opt0.epoch == opt1.epoch == 2
+    for a, b in zip(m0, m1):
+        np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-7)

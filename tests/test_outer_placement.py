@@ -119,12 +119,15 @@ def test_explicit_device_resolves_device_on_cpu(tiny_cfg):
     assert opt.master == []  # no host mirror in device mode
 
 
-def test_gossip_falls_back_to_host(tiny_cfg):
+def test_gossip_honors_device_placement(tiny_cfg):
+    # gossip composes with the device plane now: pair rounds fetch only
+    # their fragment (host_frag) and land through gossip_land
     _, _, opt = _make_opt(
         tiny_cfg, outer_placement="device", outer_mode="gossip"
     )
-    assert opt.placement == "host"
-    assert opt._plane is None
+    assert opt.placement == "device"
+    assert opt._plane is not None
+    assert opt._gossip is not None
 
 
 def _make_opt(tiny_cfg, **cfg_kw):
