@@ -35,6 +35,10 @@ KNOBS: tuple[Knob, ...] = (
          "cycle in the global order graph raises immediately instead of "
          "deadlocking. Zero-cost when unset.", doc_default="off"),
     # -- bench ----------------------------------------------------------------
+    Knob("ODTP_ASYNC_BENCH_OUT", "path", "", "bench",
+         "Output path override for `bench_outer.py --async` "
+         "(default `ASYNC_BENCH.json` in the repo root).",
+         doc_default="repo artifact"),
     Knob("ODTP_BOUNDARY_BENCH_OUT", "path", "", "bench",
          "Output path override for `bench_outer.py --boundary` "
          "(default `BOUNDARY_BENCH.json` in the repo root).",
@@ -83,6 +87,19 @@ KNOBS: tuple[Knob, ...] = (
          "How many times a failed outer round re-forms before the step "
          "raises (callers may pass a different programmatic default)."),
     # -- diloco ---------------------------------------------------------------
+    Knob("ODTP_ASYNC_DECAY", "float", "0.5", "diloco",
+         "Geometric discount on an async gossip partner's mixing weight "
+         "per epoch of staleness distance (weight = 0.5 * decay^d — "
+         "exactly the pair average at distance 0)."),
+    Knob("ODTP_ASYNC_PATIENCE_S", "float", "2.0", "diloco",
+         "How long an async-gossip worker waits for ANY in-window partner "
+         "before stepping alone (self-round policy) — bounds what a fast "
+         "worker can lose to a slow galaxy per round."),
+    Knob("ODTP_ASYNC_STALENESS", "int", "0", "diloco",
+         "Bounded-staleness window (outer epochs) for fully asynchronous "
+         "gossip rounds: workers free-run their inner loops and mix with "
+         "any partner within this epoch distance. `0` keeps the lockstep "
+         "per-(epoch, fragment) pairing."),
     Knob("ODTP_GOSSIP_LINK_BIAS", "float", "1.0", "diloco",
          "Exponent on the normalized pair capacity when gossip draws "
          "partners (linkstate-aware pairing); `0` disables link awareness, "

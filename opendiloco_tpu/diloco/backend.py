@@ -115,6 +115,26 @@ class OuterBackend(abc.ABC):
             f"{type(self).__name__} does not support gossip pair exchange"
         )
 
+    def async_pair_match(
+        self,
+        *,
+        frag_id: int,
+        epoch: int,
+        window: int,
+        patience: Optional[float] = None,
+    ) -> Optional[tuple[str, int, str]]:
+        """Bounded-staleness matchmaking for free-running async gossip:
+        find ANY available partner working fragment ``frag_id`` whose
+        outer epoch is within ``window`` of ``epoch`` — no round
+        alignment. Returns ``(partner_id, partner_epoch, match_key)``
+        with both sides handed the SAME fresh ``match_key`` (the
+        subsequent ``pair_exchange`` rides it), or None when no
+        compatible partner turned up within ``patience`` seconds (the
+        caller steps alone — a fast worker never blocks on a slow one).
+        Default: async matching unsupported; callers fall back to the
+        lockstep epoch-keyed pairing."""
+        return None
+
     def barrier(self, *, timeout: Optional[float] = None) -> None:
         """Optional synchronization point (used by tests)."""
 

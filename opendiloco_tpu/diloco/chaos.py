@@ -36,6 +36,20 @@ Grammar — ``;``-separated ``key=value`` items:
                         collapses the worker's own tokens/s, the
                         asymmetric signature the straggler watchdog keys
                         on. Scoped by ``straggle_worker`` too.
+- ``straggle_inner_x=X``  sustained inner-step speed multiplier: worker
+                        ranks in scope run their inner steps X times
+                        slower (the bench/train hook stretches each
+                        measured step by (X-1) of its own duration).
+                        Scope with ``workers=w3,w7``, or give per-rank
+                        factors directly: ``straggle_inner_x=w3:2.0,w7:4.0``.
+                        Unlike the one-shot ``straggle_inner_ms`` delays
+                        this expresses a deterministic rate skew (2x/4x
+                        heterogeneous-galaxy emulation); lookups are pure
+                        (NO RNG draw), so concurrent worker threads can
+                        query their own factor without perturbing the
+                        fault stream.
+- ``workers=w3,w7``     rank scope for ``straggle_inner_x`` when given as
+                        a single scalar factor.
 - ``egress_bps=N``      cap this process's bulk/wire payload egress at N
                         bytes/second (token bucket, same machinery as
                         ``ODTP_BULK_BANDWIDTH_BPS``; when both are set the
@@ -133,6 +147,10 @@ def parse_spec(spec: str) -> dict:
         "blackout_s": 3.0,
         "straggle_ms": (0.0, 0.0),
         "straggle_inner_ms": (0.0, 0.0),
+        # rank -> sustained inner-step slowdown factor; key None holds a
+        # scalar factor scoped by "workers" (empty scope = every rank)
+        "straggle_inner_x": {},
+        "workers": [],
         "straggle_worker": None,
         "egress_bps": 0.0,
         "wan_bps": 0.0,
@@ -166,6 +184,27 @@ def _parse_item(p: dict, k: str, v: str) -> None:
         p["blackout_rdv"] = _parse_rounds(v)
     elif k == "blackout_s":
         p["blackout_s"] = float(v)
+    elif k == "straggle_inner_x":
+        table: dict = {}
+        for item in filter(None, (s.strip() for s in v.split(","))):
+            if ":" in item:
+                w, x = item.split(":", 1)
+                if w[:1] not in "wW":
+                    raise ChaosSpecError(
+                        f"bad straggle_inner_x entry {item!r} (want wW:X)")
+                table[int(w[1:])] = float(x)
+            else:
+                table[None] = float(item)
+        if any(x < 1.0 for x in table.values()):
+            raise ChaosSpecError("straggle_inner_x factors must be >= 1.0")
+        p["straggle_inner_x"] = table
+    elif k == "workers":
+        p["workers"] = sorted(
+            int(w.lstrip("wW"))
+            for w in filter(None, (s.strip() for s in v.split(",")))
+        )
+        if not p["workers"]:
+            raise ChaosSpecError("workers needs at least one rank")
     elif k == "straggle_worker":
         p["straggle_worker"] = int(v.lstrip("wW"))
     elif k in ("egress_bps", "wan_bps"):
@@ -291,6 +330,29 @@ class ChaosPlane:
         if d > 0.0:
             self._record("straggle_inner", "inner_step", ms=round(d * 1000.0, 3))
         return d
+
+    def straggle_inner_x(self, rank: Optional[int] = None) -> float:
+        """Sustained inner-step slowdown factor for ``rank`` (1.0 = full
+        speed). PURE lookup — no RNG draw, no counters: many worker
+        threads in one process (loopback benches) query their own factor
+        concurrently, and a draw here would perturb the deterministic
+        fault stream the other injectors replay. The train-loop hook
+        stretches each measured inner step by (factor - 1) of its own
+        duration, so a factor of X shows up as exactly X-times-slower
+        tokens/s and steps/s in the overseer roll-up."""
+        table = self.params["straggle_inner_x"]
+        if not table:
+            return 1.0
+        r = self.identity if rank is None else int(rank)
+        if r in table:
+            return float(table[r])
+        x = table.get(None)
+        if x is None:
+            return 1.0
+        scope = self.params["workers"]
+        if scope and r not in scope:
+            return 1.0
+        return float(x)
 
     def egress_bps(self) -> float:
         """Emulated egress cap for this process (0 = none). Consumed by

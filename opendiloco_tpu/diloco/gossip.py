@@ -32,6 +32,25 @@ Odd galaxy: exactly one worker self-pairs per round. Policy "nesterov"
 wire); "hold" skips the round entirely (master frozen, pg re-captured
 next epoch).
 
+Fully asynchronous rounds (``ODTP_ASYNC_STALENESS`` > 0): the epoch-
+keyed pairing above still rate-limits a fast worker to whoever it draws
+— both sides must reach the SAME (epoch, fragment) before either's
+round completes. The async mode drops the shared key entirely: every
+worker free-runs its inner loop and, at each of its own epoch
+boundaries, asks the backend for ANY available partner on the same
+fragment whose epoch is within the staleness window
+(``backend.async_pair_match``; availability is discovered through the
+progress/overseer gossip that already carries per-worker epochs). The
+matched pair swaps fragments under a fresh match key on the unchanged
+``pair_exchange`` wire, then mixes with a staleness-discounted weight
+(``outer_optimizer.staleness_weight`` — bit-identical to the lockstep
+pair average at distance 0). No in-window partner inside
+``ODTP_ASYNC_PATIENCE_S`` means a self-round per the policy above, so a
+fast worker pays at most patience per round while a 4x-slower worker
+keeps contributing whenever it surfaces — aggregate throughput tracks
+the SUM of per-worker rates instead of N times the slowest (banked in
+ASYNC_BENCH.json).
+
 Compression composes: masters/momentum ride the state codec (fp16
 family), pseudo-grads ride the configured codec (blockwise4bit / topk /
 ...), with per-PARTNER error-feedback residuals — each pair link keeps
@@ -57,6 +76,10 @@ from opendiloco_tpu import obs
 from opendiloco_tpu.diloco.backend import AllReduceError
 from opendiloco_tpu.diloco.compression import get_codec, record_wire
 from opendiloco_tpu.diloco.error_feedback import ErrorFeedback
+from opendiloco_tpu.diloco.outer_optimizer import (
+    staleness_mix,
+    staleness_weight,
+)
 
 log = logging.getLogger(__name__)
 
@@ -88,6 +111,30 @@ def self_round_policy() -> str:
     """ODTP_GOSSIP_SELF_ROUND: odd-worker self-pair policy — "nesterov"
     steps on own state (default), "hold" skips the round."""
     return os.environ.get("ODTP_GOSSIP_SELF_ROUND", "nesterov") or "nesterov"
+
+
+def async_staleness() -> int:
+    """ODTP_ASYNC_STALENESS: bounded-staleness window, in outer epochs,
+    for free-running async gossip — a worker finishing its inner phase
+    mixes with ANY available partner whose epoch is within this distance,
+    no round alignment. 0 (default) keeps the lockstep per-(epoch,
+    fragment) pairing."""
+    return int(os.environ.get("ODTP_ASYNC_STALENESS", "0") or 0)
+
+
+def async_decay() -> float:
+    """ODTP_ASYNC_DECAY: geometric discount on a staler partner's mixing
+    weight per epoch of distance (weight = 0.5 * decay**d — exactly the
+    symmetric pair average at distance 0)."""
+    return float(os.environ.get("ODTP_ASYNC_DECAY", "0.5") or 0.5)
+
+
+def async_patience_s() -> float:
+    """ODTP_ASYNC_PATIENCE_S: how long a worker waits for ANY in-window
+    partner before stepping alone (per the self-round policy). This bound
+    is what kills the epoch lockstep: a fast worker pays at most patience
+    per round, never a slow partner's full inner phase."""
+    return float(os.environ.get("ODTP_ASYNC_PATIENCE_S", "2.0") or 2.0)
 
 
 # -- pair scheduling -----------------------------------------------------------
@@ -332,6 +379,13 @@ class GossipPlane:
         retained, nothing adopted, next epoch re-pairs.
         """
         t0 = time.perf_counter()
+        window = async_staleness()
+        if window > 0:
+            return self._exchange_async(
+                epoch=int(epoch), frag_id=int(frag_id), idxs=idxs,
+                masters=masters, bufs=bufs, pgs=pgs, timeout=timeout,
+                t0=t0, window=window,
+            )
         key = f"f{int(frag_id)}-e{int(epoch)}"
         members, links = self.backend.gossip_view()
         own = self.backend.peer_id
@@ -363,55 +417,10 @@ class GossipPlane:
         if ef is not None:
             ef.prepare(round_key, idxs, gs)
         try:
-            m_chunks, m_metas, raw_m = _encode_leaves(self.state_codec, masters)
-            if bufs is not None:
-                b_chunks, b_metas, raw_b = _encode_leaves(self.state_codec, bufs)
-            else:
-                b_chunks, b_metas, raw_b = [], None, 0
-            g_chunks, g_metas, raw_g = _encode_leaves(self.codec, gs)
-            payload = b"".join(m_chunks + b_chunks + g_chunks)
-            meta = {
-                "gossip": 1,
-                "sections": {"m": m_metas, "b": b_metas, "g": g_metas},
-                "codec": {
-                    "state": self.state_codec.name,
-                    "grad": self.codec.name,
-                },
-            }
-            p_meta, p_payload = self.backend.pair_exchange(
-                payload,
-                meta,
-                partner_id=partner,
-                round_key=round_key,
-                timeout=timeout,
-            )
-            # decode OWN bytes too (codec roundtrip): both sides average
-            # the identical decoded operands, so the mix is bit-identical
-            mine_m, off = _decode_section(self.state_codec, m_metas, payload, 0)
-            mine_b: Optional[list[np.ndarray]] = None
-            if b_metas is not None:
-                mine_b, off = _decode_section(
-                    self.state_codec, b_metas, payload, off
-                )
-            mine_g, _ = _decode_section(self.codec, g_metas, payload, off)
-
-            p_sections = p_meta["sections"]
-            p_state = get_codec(p_meta["codec"]["state"])
-            p_grad = get_codec(p_meta["codec"]["grad"])
-            theirs_m, poff = _decode_section(
-                p_state, p_sections["m"], p_payload, 0
-            )
-            theirs_b: Optional[list[np.ndarray]] = None
-            if p_sections.get("b") is not None:
-                theirs_b, poff = _decode_section(
-                    p_state, p_sections["b"], p_payload, poff
-                )
-            theirs_g, _ = _decode_section(p_grad, p_sections["g"], p_payload, poff)
-            if len(theirs_m) != len(mine_m) or len(theirs_g) != len(mine_g):
-                raise AllReduceError(
-                    f"gossip section mismatch with {partner}: "
-                    f"{len(theirs_m)}/{len(theirs_g)} leaves vs "
-                    f"{len(mine_m)}/{len(mine_g)}"
+            (mine_m, mine_b, mine_g), (theirs_m, theirs_b, theirs_g), \
+                wire, raw = self._transfer_and_decode(
+                    partner=partner, round_key=round_key,
+                    masters=masters, bufs=bufs, gs=gs, timeout=timeout,
                 )
         except (AllReduceError, TimeoutError, asyncio.TimeoutError,
                 OSError, KeyError, ValueError) as e:
@@ -440,9 +449,184 @@ class GossipPlane:
             avg_g = _avg_sorted(theirs_g, mine_g)
         if ef is not None:
             ef.commit(round_key)
-        wire = len(payload)
-        record_wire("gossip", raw_m + raw_b + raw_g, wire)
+        record_wire("gossip", raw, wire)
         self._record(key, partner=partner, n=2, t0=t0, wire=wire)
+        return mix_m, mix_b, avg_g, partner, 2
+
+    def _transfer_and_decode(
+        self,
+        *,
+        partner: str,
+        round_key: str,
+        masters: list[np.ndarray],
+        bufs: Optional[list[np.ndarray]],
+        gs: list[np.ndarray],
+        timeout: Optional[float],
+    ):
+        """Encode own (m, b, g) sections, swap frames with ``partner``
+        under ``round_key``, decode BOTH sides through the codecs (own
+        bytes roundtrip too, so paired mixes use identical operands).
+        Returns ``((mine_m, mine_b, mine_g), (theirs_m, theirs_b,
+        theirs_g), wire_bytes, raw_bytes)``; raises on transfer failure
+        (caller aborts EF and drops the round)."""
+        m_chunks, m_metas, raw_m = _encode_leaves(self.state_codec, masters)
+        if bufs is not None:
+            b_chunks, b_metas, raw_b = _encode_leaves(self.state_codec, bufs)
+        else:
+            b_chunks, b_metas, raw_b = [], None, 0
+        g_chunks, g_metas, raw_g = _encode_leaves(self.codec, gs)
+        payload = b"".join(m_chunks + b_chunks + g_chunks)
+        meta = {
+            "gossip": 1,
+            "sections": {"m": m_metas, "b": b_metas, "g": g_metas},
+            "codec": {
+                "state": self.state_codec.name,
+                "grad": self.codec.name,
+            },
+        }
+        p_meta, p_payload = self.backend.pair_exchange(
+            payload,
+            meta,
+            partner_id=partner,
+            round_key=round_key,
+            timeout=timeout,
+        )
+        mine_m, off = _decode_section(self.state_codec, m_metas, payload, 0)
+        mine_b: Optional[list[np.ndarray]] = None
+        if b_metas is not None:
+            mine_b, off = _decode_section(
+                self.state_codec, b_metas, payload, off
+            )
+        mine_g, _ = _decode_section(self.codec, g_metas, payload, off)
+
+        p_sections = p_meta["sections"]
+        p_state = get_codec(p_meta["codec"]["state"])
+        p_grad = get_codec(p_meta["codec"]["grad"])
+        theirs_m, poff = _decode_section(
+            p_state, p_sections["m"], p_payload, 0
+        )
+        theirs_b: Optional[list[np.ndarray]] = None
+        if p_sections.get("b") is not None:
+            theirs_b, poff = _decode_section(
+                p_state, p_sections["b"], p_payload, poff
+            )
+        theirs_g, _ = _decode_section(p_grad, p_sections["g"], p_payload, poff)
+        if len(theirs_m) != len(mine_m) or len(theirs_g) != len(mine_g):
+            raise AllReduceError(
+                f"gossip section mismatch with {partner}: "
+                f"{len(theirs_m)}/{len(theirs_g)} leaves vs "
+                f"{len(mine_m)}/{len(mine_g)}"
+            )
+        return (
+            (mine_m, mine_b, mine_g),
+            (theirs_m, theirs_b, theirs_g),
+            len(payload),
+            raw_m + raw_b + raw_g,
+        )
+
+    def _exchange_async(
+        self,
+        *,
+        epoch: int,
+        frag_id: int,
+        idxs,
+        masters: list[np.ndarray],
+        bufs: Optional[list[np.ndarray]],
+        pgs: list[np.ndarray],
+        timeout: Optional[float],
+        t0: float,
+        window: int,
+    ):
+        """One FREE-RUNNING pair round under the bounded-staleness window.
+
+        No shared round key: the backend matches this worker with any
+        partner on the same fragment within ``window`` epochs (or nobody,
+        after patience — then the self-round policy applies and local
+        progress continues). The matched transfer rides the ordinary
+        ``pair_exchange`` under the match key both sides were handed, EF
+        semantics unchanged: a missed or failed match is the dropped-
+        round non-event with the residual retained exactly.
+        """
+        key = f"af{frag_id}-e{epoch}"
+        own = self.backend.peer_id
+        match = self.backend.async_pair_match(
+            frag_id=frag_id, epoch=epoch, window=window,
+            patience=async_patience_s(),
+        )
+        if match is None:
+            # nobody compatible surfaced within patience: the free-running
+            # analogue of the odd-galaxy self-round. Stepping alone here —
+            # instead of parking on an epoch-aligned key — is the bound
+            # that keeps fast workers off the slowest worker's clock.
+            if self.self_policy == "hold":
+                self._record(key, partner=own, n=0, t0=t0, dropped=True)
+                return None
+            mix_m = [np.array(m, np.float32) for m in masters]
+            mix_b = None if bufs is None else [
+                np.array(b, np.float32) for b in bufs
+            ]
+            avg_g = [np.array(g, np.float32) for g in pgs]
+            self._record(key, partner=own, n=1, t0=t0)
+            return mix_m, mix_b, avg_g, own, 1
+
+        partner, p_epoch, round_key = match
+        dist = abs(int(epoch) - int(p_epoch))
+        ef = self._ef_for(partner) if self.error_feedback else None
+        gs = [np.array(np.asarray(g, np.float32)) for g in pgs]
+        if ef is not None:
+            ef.prepare(round_key, idxs, gs)
+        try:
+            (mine_m, mine_b, mine_g), (theirs_m, theirs_b, theirs_g), \
+                wire, raw = self._transfer_and_decode(
+                    partner=partner, round_key=round_key,
+                    masters=masters, bufs=bufs, gs=gs, timeout=timeout,
+                )
+        except (AllReduceError, TimeoutError, asyncio.TimeoutError,
+                OSError, KeyError, ValueError) as e:
+            if ef is not None:
+                ef.abort(round_key)
+            log.warning(
+                "async gossip round dropped (frag %s epoch %s partner %s "
+                "lag %s): %s", frag_id, epoch, partner, dist, e,
+            )
+            self._record(
+                key, partner=partner, n=0, t0=t0, dropped=True, lag=dist
+            )
+            return None
+
+        if dist == 0:
+            # distance 0 IS the lockstep pair mix: route through the
+            # sorted-pair average so it stays bit-identical on both ends
+            # (and bit-identical to the epoch-aligned rounds)
+            if own == min(own, partner):
+                mix_m = _avg_sorted(mine_m, theirs_m)
+                mix_b = (
+                    None if mine_b is None or theirs_b is None
+                    else _avg_sorted(mine_b, theirs_b)
+                )
+                avg_g = _avg_sorted(mine_g, theirs_g)
+            else:
+                mix_m = _avg_sorted(theirs_m, mine_m)
+                mix_b = (
+                    None if mine_b is None or theirs_b is None
+                    else _avg_sorted(theirs_b, mine_b)
+                )
+                avg_g = _avg_sorted(theirs_g, mine_g)
+        else:
+            # staleness-discounted convex mix; both sides computed the
+            # same distance (epochs rode the match), so the two updates
+            # still sum to the pair's sum — galaxy mean preserved
+            wgt = staleness_weight(dist, async_decay())
+            mix_m = staleness_mix(mine_m, theirs_m, wgt)
+            mix_b = (
+                None if mine_b is None or theirs_b is None
+                else staleness_mix(mine_b, theirs_b, wgt)
+            )
+            avg_g = staleness_mix(mine_g, theirs_g, wgt)
+        if ef is not None:
+            ef.commit(round_key)
+        record_wire("gossip", raw, wire)
+        self._record(key, partner=partner, n=2, t0=t0, wire=wire, lag=dist)
         return mix_m, mix_b, avg_g, partner, 2
 
     # -- health ------------------------------------------------------------
@@ -456,6 +640,7 @@ class GossipPlane:
         t0: float,
         wire: int = 0,
         dropped: bool = False,
+        lag: Optional[int] = None,
     ) -> None:
         t1 = time.perf_counter()
         health = {
@@ -474,6 +659,10 @@ class GossipPlane:
             health["dropped"] = True
         if wire:
             health["wire_bytes"] = int(wire)
+        if lag is not None:
+            # epoch distance of an async match (0 on aligned pairs); rides
+            # the overseer roll-up so odtp_top can show live skew
+            health["pair_lag"] = int(lag)
         try:
             self.backend.last_round_health = health
             led = self.backend.round_ledger

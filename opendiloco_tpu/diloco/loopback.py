@@ -53,6 +53,11 @@ class LoopbackWorld:
         # pair-exchange mailboxes: round_key -> {peer_id: (meta, payload)}
         # (NoLoCo gossip, diloco/gossip.py); "_taken" tracks pickup for GC
         self._pairbox: dict[str, dict] = {}
+        # async-gossip offer board: frag_id -> {peer_id: offer}; an offer
+        # is claimed ATOMICALLY under this lock (claimer pops it and sets
+        # its "result"), so two claimers can never grab the same partner
+        self._offers: dict[int, dict[str, dict]] = {}
+        self._async_seq = 0  # match-key nonce (repeat matches never collide)
 
     def make_backends(self) -> list["LoopbackBackend"]:
         return [LoopbackBackend(self, f"peer-{i}") for i in range(self.n_peers)]
@@ -150,6 +155,50 @@ class LoopbackBackend(OuterBackend):
             slot["_taken"].add(self._peer_id)
             self._pairbox_gc(round_key)
         return p_meta, p_payload
+
+    def async_pair_match(self, *, frag_id, epoch, window, patience=None):
+        """Bounded-staleness matchmaking through the in-world offer board.
+
+        Claim the closest-epoch standing offer within ``window`` if one
+        exists (deterministic tie-break by peer id); otherwise post our
+        own offer and wait up to ``patience`` to be claimed. The claimer
+        mints the match key, so both sides leave with the identical key
+        and the transfer rides the ordinary ``pair_exchange`` mailbox.
+        """
+        w = self.world
+        deadline = time.monotonic() + (patience if patience else 5.0)
+        with w.cond:
+            board = w._offers.setdefault(int(frag_id), {})
+            cands = sorted(
+                (abs(int(epoch) - o["epoch"]), pid)
+                for pid, o in board.items()
+                if pid != self._peer_id and o["result"] is None
+                and pid in w.live
+                and abs(int(epoch) - o["epoch"]) <= int(window)
+            )
+            if cands:
+                _, pid = cands[0]
+                other = board.pop(pid)
+                w._async_seq += 1
+                lo, hi = sorted((self._peer_id, pid))
+                match_key = (
+                    f"async-f{int(frag_id)}:{lo}|{hi}:{w._async_seq}"
+                )
+                other["result"] = (self._peer_id, int(epoch), match_key)
+                w.cond.notify_all()
+                return pid, other["epoch"], match_key
+            offer: dict = {"epoch": int(epoch), "result": None}
+            board[self._peer_id] = offer
+            w.cond.notify_all()
+            while offer["result"] is None:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                w.cond.wait(timeout=min(remaining, 0.05))
+            # withdraw if still standing (a claimer pops matched offers)
+            if board.get(self._peer_id) is offer:
+                board.pop(self._peer_id, None)
+            return offer["result"]
 
     def _pairbox_gc(self, round_key: str) -> None:
         """Under world.lock: drop a fully-consumed (or abandoned) slot and

@@ -1584,6 +1584,11 @@ class DiLoCoOptimizer:
         if self.cfg.outer_momentum != 0.0 and bufs_np is None:
             # zeros when momentum never armed: wire shapes must be static
             bufs_np = [np.zeros_like(m) for m in masters_np]
+        # NOTE: blocking-streaming keys the fragment to the epoch, so under
+        # async bounded-staleness gossip two workers align on a fragment
+        # only when their epoch distance is a multiple of the fragment
+        # count (otherwise both self-round). The streaming-overlap path
+        # syncs EVERY fragment each epoch and matches at any distance.
         frag_id = (
             self.epoch % len(self._fragments)
             if self._fragments is not None else 0
@@ -1804,6 +1809,10 @@ class DiLoCoOptimizer:
                     else oo.bufs[i]
                     for i in idxs
                 ]
+            # under async staleness (ODTP_ASYNC_STALENESS > 0) the plane
+            # free-runs: exchange matches any in-window partner on this
+            # fragment instead of pairing per (epoch, fragment) — see the
+            # fragment-alignment note in _outer_step_device_gossip
             frag_id = (
                 self.epoch % len(self._fragments)
                 if self._fragments is not None else 0

@@ -694,7 +694,12 @@ class DeviceOuterPlane:
         through live. Streaming passes ``base`` (the retained pre-round
         master copies) and gets the device delta (new - base) for
         _apply_frag_delta; the base copies are donated. Caller holds
-        self.lock when it needs the rebind atomic with a params update."""
+        self.lock when it needs the rebind atomic with a params update.
+
+        Round cadence is not this plane's concern: lockstep pair rounds,
+        async bounded-staleness matches, and async self-rounds all land
+        through the same two shapes above (the staleness-weighted mix
+        happened host-side in gossip.py before noloco_step)."""
         with self.lock:
             new_m = [
                 jax.device_put(np.asarray(m, np.float32), s)

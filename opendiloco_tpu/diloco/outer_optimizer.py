@@ -126,6 +126,37 @@ class OuterSGD:
         self.bufs = None if bufs is None else [np.asarray(b).copy() for b in bufs]
 
 
+def staleness_weight(distance: int, decay: float = 0.5) -> float:
+    """Partner mixing weight under bounded-staleness async gossip.
+
+    ``0.5 * decay**d`` for epoch distance ``d``: exactly the symmetric
+    pair average at distance 0, geometrically discounting a staler
+    partner's contribution. Both sides of a match compute the same
+    ``d`` (the epochs ride the match handshake), so the mix stays
+    mean-preserving: A' + B' = (1-w)A + wB + (1-w)B + wA = A + B.
+    """
+    return 0.5 * float(decay) ** max(0, int(distance))
+
+
+def staleness_mix(
+    mine: list[np.ndarray],
+    theirs: list[np.ndarray],
+    weight: float,
+) -> list[np.ndarray]:
+    """Convex per-leaf mix ``(1-w)*mine + w*theirs`` (fresh f32 arrays).
+
+    The async analogue of gossip's ``_avg_sorted``; callers route the
+    distance-0 case through the sorted-pair average instead so the
+    in-window fast path stays bit-identical to the lockstep mix.
+    """
+    w = np.float32(weight)
+    one_m_w = np.float32(1.0) - w
+    return [
+        np.asarray(a, np.float32) * one_m_w + np.asarray(b, np.float32) * w
+        for a, b in zip(mine, theirs)
+    ]
+
+
 def noloco_step(
     mix_m: list[np.ndarray],
     mix_b: Optional[list[np.ndarray]],

@@ -338,6 +338,12 @@ class StreamScheduler:
                         )
                     if b_np is None and opt.cfg.outer_momentum != 0.0:
                         b_np = [np.zeros_like(m) for m in m_np]
+                    # lockstep: pairs on the shared (epoch, frag) key.
+                    # Async (ODTP_ASYNC_STALENESS > 0): matches any
+                    # in-window partner on fragment k — every fragment
+                    # syncs every epoch here, so ANY epoch distance
+                    # aligns fragment-wise; a patience miss comes back
+                    # as a self-round (n=1) and lands like a pair
                     res = opt._gossip.exchange(
                         epoch=epoch,
                         frag_id=k,

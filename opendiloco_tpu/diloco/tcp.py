@@ -725,6 +725,14 @@ class TcpBackend(OuterBackend):
                         writer, "ok",
                         {"matrix": ov.matrix() if ov is not None else {}},
                     )
+                elif msg == "async_offer":
+                    # bounded-staleness matchmaking (async gossip): claim
+                    # our standing offer for the sender if compatible;
+                    # sync reply computed on the loop thread = atomic vs
+                    # our own _async_pair_match between awaits
+                    await send_frame(
+                        writer, "ok", self._async_offer_reply(meta)
+                    )
                 elif msg == "fleet":
                     # serving-fleet roll-up (publisher/router/replica view
                     # of this worker's plane; {"enabled": False} when no
@@ -1230,6 +1238,133 @@ class TcpBackend(OuterBackend):
             (round_key, "push", partner_id), deadline
         )
         return p_meta, bytes(p_payload)
+
+    # -- async bounded-staleness matchmaking (diloco/gossip.py) --------------
+
+    def _async_board(self) -> dict:
+        """frag_id -> this worker's standing offer. Owned by the asyncio
+        loop thread (handler replies and the match coroutine both run
+        there), so no lock: every read-modify-write is atomic between
+        awaits."""
+        board = getattr(self, "_async_offer_board", None)
+        if board is None:
+            board = {}
+            self._async_offer_board = board
+        return board
+
+    def _async_offer_reply(self, meta: dict) -> dict:
+        """Respond to a peer's "async_offer" frame: claim our standing
+        offer for the sender when compatible. Role split by peer id —
+        offers are only ACCEPTED from larger ids (and only SENT to
+        smaller ids), so two workers can never claim each other
+        concurrently and deadlock both transfers."""
+        src = str(meta.get("from", ""))
+        frag = int(meta.get("frag", -1))
+        offer = self._async_board().get(frag)
+        if (
+            offer is None or offer["busy"] or offer["fut"].done()
+            or not src or src <= self._peer_id
+        ):
+            return {"match": 0}
+        d = abs(int(offer["epoch"]) - int(meta.get("epoch", 0)))
+        if d > min(int(offer["window"]), int(meta.get("window", 0))):
+            return {"match": 0}
+        self._async_seq = getattr(self, "_async_seq", 0) + 1
+        lo, hi = sorted((self._peer_id, src))
+        key = f"async-f{frag}:{lo}|{hi}:{self._async_seq}"
+        offer["fut"].set_result((src, int(meta.get("epoch", 0)), key))
+        return {"match": 1, "epoch": int(offer["epoch"]), "key": key}
+
+    def async_pair_match(self, *, frag_id, epoch, window, patience=None):
+        """Free-running matchmaking on the control plane: post a standing
+        offer claimable by larger-id peers, while sweeping smaller-id
+        candidates — whose epochs already ride the progress gossip — with
+        "async_offer" RPCs. The responder re-checks its LIVE offer, so a
+        stale progress view only costs a "no" reply, never a bad match.
+        Any transport failure resolves to None (the caller's self-round):
+        matching is best-effort by design."""
+        patience = float(patience) if patience else 5.0
+        # refresh the candidate epochs from the rendezvous HERE — the
+        # sync refresh path uses _run and would deadlock on the loop
+        self.peer_progress()
+        try:
+            return self._run(
+                self._async_pair_match(
+                    int(frag_id), int(epoch), int(window), patience
+                ),
+                timeout=patience + 10.0,
+            )
+        except (AllReduceError, OSError, ConnectionError, EOFError,
+                asyncio.TimeoutError) as e:
+            log.debug(
+                "async match failed (frag %s epoch %s): %s",
+                frag_id, epoch, e,
+            )
+            return None
+
+    async def _async_pair_match(self, frag_id, epoch, window, patience):
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + patience
+        board = self._async_board()
+        offer = {
+            "epoch": epoch, "window": window,
+            "fut": loop.create_future(), "busy": False,
+        }
+        board[frag_id] = offer
+        try:
+            while True:
+                if offer["fut"].done():
+                    return offer["fut"].result()
+                cands = sorted(
+                    (abs(epoch - p.epoch), p.peer_id)
+                    for p in self._progress_cache
+                    if p.peer_id < self._peer_id
+                    and abs(epoch - p.epoch) <= window
+                )
+                for _, pid in cands:
+                    peer = self._peers_view.get(pid)
+                    if not peer or not peer.get("host"):
+                        continue
+                    # mid-RPC our offer must not be claimable: a claim
+                    # racing a successful sweep would double-match us
+                    offer["busy"] = True
+                    try:
+                        msg, p_meta, _ = await self._peer_request(
+                            peer["host"], int(peer["port"]), "async_offer",
+                            {
+                                "frag": frag_id, "epoch": epoch,
+                                "window": window, "from": self._peer_id,
+                            },
+                            timeout=min(
+                                5.0, max(1.0, deadline - loop.time())
+                            ),
+                        )
+                    except (OSError, ConnectionError, EOFError,
+                            asyncio.TimeoutError, WireError) as e:
+                        log.debug("async offer to %s failed: %s", pid, e)
+                        continue
+                    finally:
+                        offer["busy"] = False
+                    if msg == "ok" and p_meta.get("match"):
+                        return (
+                            pid,
+                            int(p_meta["epoch"]),
+                            str(p_meta["key"]),
+                        )
+                remaining = deadline - loop.time()
+                if remaining <= 0:
+                    return None
+                try:
+                    await asyncio.wait_for(
+                        asyncio.shield(offer["fut"]),
+                        min(remaining, 0.25),
+                    )
+                    return offer["fut"].result()
+                except asyncio.TimeoutError:
+                    pass
+        finally:
+            if board.get(frag_id) is offer:
+                del board[frag_id]
 
     def _checkout_buf(self, count: int) -> np.ndarray:
         with self._pool_lock:

@@ -13,6 +13,10 @@ Four detectors, each sourced from telemetry that already exists:
   seconds (0 = off), checked by one low-frequency daemon thread;
 - **dead peer** — an elastic round is missing a worker that completed
   earlier rounds (the overseer saw it in a group before);
+- **stale worker** — under async bounded-staleness gossip
+  (``ODTP_ASYNC_STALENESS`` > 0), a worker's epoch lags the galaxy's
+  front-runner by more than the window: it can no longer be matched, so
+  its progress stops mixing into the galaxy;
 - **serve staleness breach** — the serving plane's adopted snapshot is
   older than its own ``max_stale_rounds`` bound.
 
@@ -40,6 +44,7 @@ _ENV = "ODTP_OBS"
 _STALL_ENV = "ODTP_WATCHDOG_STALL_S"
 _STRAGGLER_ENV = "ODTP_WATCHDOG_STRAGGLER_X"
 _DIVERGE_ENV = "ODTP_WATCHDOG_DIVERGE_Z"
+_ASYNC_WINDOW_ENV = "ODTP_ASYNC_STALENESS"
 _DEFAULT_STALL_S = 0.0
 _DEFAULT_STRAGGLER_X = 3.0
 _DEFAULT_DIVERGE_Z = 6.0
@@ -73,6 +78,10 @@ class Watchdog:
         self.straggler_x = float(
             os.environ.get(_STRAGGLER_ENV, _DEFAULT_STRAGGLER_X))
         self.diverge_z = float(os.environ.get(_DIVERGE_ENV, _DEFAULT_DIVERGE_Z))
+        # async gossip's bounded-staleness window: a worker whose epoch
+        # lag exceeds it can no longer be matched, which is worth an
+        # anomaly even though training proceeds without it
+        self.async_window = int(os.environ.get(_ASYNC_WINDOW_ENV, "0") or 0)
         self._lock = threading.Lock()
         self._last_progress: Optional[float] = None
         self._last_trip: dict[tuple, float] = {}
@@ -121,6 +130,7 @@ class Watchdog:
         self._check_straggler(matrix)
         self._check_divergence(health, matrix, own_id)
         self._check_dead_peers(health, members)
+        self._check_stale_worker(matrix)
 
     def _check_straggler(self, matrix: dict) -> None:
         """Two signals, same threshold factor. Round wall time catches a
@@ -176,6 +186,37 @@ class Watchdog:
                             galaxy_median_tokens_per_s=round(med, 1),
                             factor=round(med / t, 2),
                         )
+
+    def _check_stale_worker(self, matrix: dict) -> None:
+        """Async bounded-staleness gossip only (window > 0): a worker
+        whose epoch lags the galaxy's front-runner by MORE than the
+        staleness window has fallen out of matchable range — nobody will
+        mix with it until it catches up (or desync-onboards), so its
+        local progress stops reaching the galaxy. Epochs ride the same
+        overseer roll-ups odtp_top renders; stale vectors are skipped the
+        same way the straggler detector skips them."""
+        if self.async_window <= 0:
+            return
+        fresh_ts = max(
+            (float(v["ts"]) for v in matrix.values()
+             if isinstance(v.get("ts"), (int, float))), default=0.0)
+        epochs = {
+            pid: int(v["epoch"]) for pid, v in matrix.items()
+            if isinstance(v.get("epoch"), (int, float))
+            and isinstance(v.get("ts"), (int, float))
+            and fresh_ts - float(v["ts"]) <= _STRAGGLER_FRESH_S
+        }
+        if len(epochs) < 2:
+            return
+        front = max(epochs.values())
+        for pid, e in epochs.items():
+            lag = front - e
+            if lag > self.async_window:
+                self._trip(
+                    "stale_worker", subject=pid,
+                    epoch=e, galaxy_front_epoch=front,
+                    lag=lag, window=self.async_window,
+                )
 
     def _check_divergence(self, health: dict, matrix: dict,
                           own_id: Optional[str]) -> None:

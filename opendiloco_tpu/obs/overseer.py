@@ -36,6 +36,7 @@ HEALTH_VEC_VERSION = 1
 _GAUGE_FIELDS = (
     ("inner_loss", "loss"),
     ("inner_tokens_per_second", "tokens_per_s"),
+    ("inner_steps_per_second", "steps_per_s"),
     ("pseudo_grad_norm", "pg_norm"),
     ("outer_epoch", "epoch"),
     ("serve_snapshot_staleness", "staleness"),
@@ -52,8 +53,9 @@ _COUNTER_FIELDS = (
 _HEALTH_FIELDS = (
     "round", "group_size", "expected", "elastic", "retries",
     # gossip pair rounds (diloco/gossip.py): who this worker mixed with
-    # last round, and whether the round was a pair round at all
-    "gossip", "partner",
+    # last round, and whether the round was a pair round at all; pair_lag
+    # is the epoch distance of an async bounded-staleness match
+    "gossip", "partner", "pair_lag",
 )
 _STAGE_SUFFIX = "_s"
 

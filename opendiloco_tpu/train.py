@@ -345,6 +345,10 @@ def train(config: Config, backend: Optional[OuterBackend] = None) -> dict:
             tr.gauge("inner_loss", loss)
             tr.gauge("inner_grad_norm", row["grad_norm"])
             tr.gauge("inner_tokens_per_second", row["tokens_per_second"])
+            # per-worker inner-step rate: the roll-up field odtp_top's
+            # step/s column reads (async skew shows here even when batch
+            # shapes differ across the galaxy and tokens/s doesn't divide)
+            tr.gauge("inner_steps_per_second", 1.0 / dt if dt > 0 else 0.0)
             tr.gauge("inner_step_s", dt)
             if "mfu" in row:
                 tr.gauge("inner_mfu", row["mfu"])
@@ -397,6 +401,13 @@ def train(config: Config, backend: Optional[OuterBackend] = None) -> dict:
                 state, metrics = diloco_opt.step(state, batch)
             else:
                 state, metrics = trainer.train_step(state, batch)
+            if cp is not None:
+                x = cp.straggle_inner_x()
+                if x > 1.0:
+                    # sustained rate skew: stretch THIS step by (x-1) of
+                    # its own measured duration, so the worker runs at
+                    # exactly 1/x speed whatever the hardware is doing
+                    time.sleep((x - 1.0) * (time.perf_counter() - t0))
 
             # the prior step's results are certainly ready now: flush them
             # while this step runs on device

@@ -78,6 +78,14 @@ _HIER_OUT = os.environ.get("ODTP_HIER_BENCH_OUT") or os.path.join(
 _GOSSIP_OUT = os.environ.get("ODTP_GOSSIP_BENCH_OUT") or os.path.join(
     REPO, "GOSSIP_BENCH.json"
 )
+# --async mode banks here: lockstep vs bounded-staleness async gossip
+# rounds on a heterogeneous (2x/4x inner-step skewed) loopback galaxy, the
+# artifact the free-running round clock (ODTP_ASYNC_STALENESS) is judged
+# against: lockstep aggregate tokens/s degrades toward the slowest worker,
+# async holds near the sum of per-worker standalone rates
+_ASYNC_OUT = os.environ.get("ODTP_ASYNC_BENCH_OUT") or os.path.join(
+    REPO, "ASYNC_BENCH.json"
+)
 
 
 def expected_group(peers: int, group_cap: int) -> int:
@@ -1536,6 +1544,259 @@ def gossip_main(args) -> None:
         )
 
 
+def _async_galaxy(
+    n: int, epochs: list[int], local_steps: int, base_dt: float,
+    tok_per_step: int, model: str, gossip: bool,
+) -> list[dict]:
+    """One leg over the inner-step-skewed loopback galaxy: each of ``n``
+    worker threads runs its epoch budget, every inner step priced at
+    ``base_dt * straggle_inner_x(rank)`` (the chaos plane's skew table —
+    a pure lookup, so concurrent threads share one plane safely), with an
+    outer gossip exchange at every epoch boundary when ``gossip`` is on
+    (lockstep or async per the ambient ODTP_ASYNC_* env; off = the
+    standalone inner-only baseline). Returns per-worker rows; a worker
+    exception becomes an ``error`` row — the acceptance gate requires
+    zero of them."""
+    from opendiloco_tpu.diloco import chaos
+    from opendiloco_tpu.diloco.gossip import GossipPlane
+    from opendiloco_tpu.diloco.loopback import LoopbackWorld
+
+    compression = "blockwise4bit"
+    world = LoopbackWorld(n, compression=compression)
+    backends = world.make_backends()
+    rows: list = [None] * n
+    start = threading.Barrier(n)
+
+    def worker(rank: int) -> None:
+        try:
+            cp = chaos.plane()
+            x = cp.straggle_inner_x(rank=rank) if cp is not None else 1.0
+            masters = make_leaves(model, rank)
+            bufs = make_leaves(model, 100 + rank)
+            pgs = make_leaves(model, 200 + rank)
+            idxs = list(range(len(masters)))
+            plane = (
+                GossipPlane(
+                    backends[rank], len(masters),
+                    compression=compression, error_feedback=True,
+                )
+                if gossip else None
+            )
+            start.wait()
+            paired = selfed = dropped = 0
+            lags: list[int] = []
+            t0 = time.perf_counter()
+            for e in range(epochs[rank]):
+                for _ in range(local_steps):
+                    time.sleep(base_dt * x)
+                if plane is None:
+                    continue
+                res = plane.exchange(
+                    epoch=e, frag_id=0, idxs=idxs, masters=masters,
+                    bufs=bufs, pgs=pgs, timeout=120.0,
+                )
+                if res is None:
+                    dropped += 1
+                elif res[4] == 2:
+                    paired += 1
+                    lags.append(
+                        backends[rank].last_round_health.get("pair_lag", 0)
+                    )
+                else:
+                    selfed += 1
+            wall = time.perf_counter() - t0
+            tokens = epochs[rank] * local_steps * tok_per_step
+            rows[rank] = {
+                "rank": rank,
+                "skew_x": x,
+                "epochs": epochs[rank],
+                "wall_s": round(wall, 3),
+                "tokens_per_s": round(tokens / wall, 1),
+                "paired_rounds": paired,
+                "self_rounds": selfed,
+                "dropped_rounds": dropped,
+                "mean_pair_lag": (
+                    round(statistics.fmean(lags), 2) if lags else None
+                ),
+            }
+        except Exception as e:  # pragma: no cover - becomes an error row
+            rows[rank] = {"rank": rank, "error": repr(e)}
+            try:
+                start.abort()
+            except Exception:
+                pass
+
+    threads = [threading.Thread(target=worker, args=(r,)) for r in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    # close only after every thread exited: a worker that finishes its
+    # budget first must stay LIVE, or the stragglers' in-flight lockstep
+    # pairs resolve as partner-left drops (and one of them eats the full
+    # pair timeout waiting on a deposit that never comes)
+    for b in backends:
+        b.close()
+    return rows
+
+
+def async_main(args) -> None:
+    """Async outer rounds vs epoch lockstep on a heterogeneous galaxy: 8
+    loopback worker threads with 2x/4x inner-step-speed skew injected
+    through the chaos plane (straggle_inner_x). Three legs over the SAME
+    skew table: standalone (inner-only per-worker ceilings), lockstep
+    gossip (PR-15 epoch-aligned pair keys — every pair waits for its
+    slower member), and async gossip (ODTP_ASYNC_STALENESS free-running
+    clocks — misses self-round after patience). Banks ASYNC_BENCH.json;
+    the full run exits nonzero unless the async aggregate holds >= 0.8x
+    the standalone sum while lockstep is bounded by the slowest worker,
+    or if any leg produced an error row."""
+    from opendiloco_tpu.diloco import chaos
+
+    window, decay = 2, 0.5
+    if args.selftest:
+        n, local_steps, base_dt, model = 4, 4, 0.01, "tiny:0.1"
+        skew_spec = "straggle_inner_x=w2:2.0,w3:4.0"
+        skews = [1.0, 1.0, 2.0, 4.0]
+        epochs_1x, lock_epochs, patience = 8, 3, 0.05
+        out_path = os.environ.get("ODTP_ASYNC_BENCH_OUT") or os.path.join(
+            os.environ.get("TMPDIR", "/tmp"), "ASYNC_BENCH.selftest.json"
+        )
+    else:
+        n, local_steps, base_dt, model = 8, 8, 0.02, "tiny:0.25"
+        # the ISSUE's heterogeneous galaxy: 4 full-speed workers, two at
+        # half speed, two at quarter speed (per-rank table form — the
+        # workers are threads of one process, so rank must be explicit)
+        skew_spec = "straggle_inner_x=w4:2.0,w5:2.0,w6:4.0,w7:4.0"
+        skews = [1.0, 1.0, 1.0, 1.0, 2.0, 2.0, 4.0, 4.0]
+        epochs_1x, lock_epochs, patience = 16, 6, 0.1
+        out_path = _ASYNC_OUT
+    tok_per_step = 1024  # nominal; only ratios between legs matter
+    # equal WALL budgets per worker: epoch counts inverse to the skew, so
+    # every worker is active (and matchable) for the whole leg
+    async_epochs = [max(2, round(epochs_1x / x)) for x in skews]
+    print(
+        f"async bench: {n} workers, skew {skews}, {local_steps} inner "
+        f"steps/epoch at {base_dt * 1e3:.0f} ms base, window {window}, "
+        f"patience {patience}s"
+    )
+
+    saved = {
+        k: os.environ.get(k)
+        for k in (
+            "ODTP_CHAOS", "ODTP_ASYNC_STALENESS", "ODTP_ASYNC_DECAY",
+            "ODTP_ASYNC_PATIENCE_S",
+        )
+    }
+    legs: dict[str, list] = {}
+    try:
+        os.environ["ODTP_CHAOS"] = f"seed=1;{skew_spec}"
+        chaos.reset()
+        os.environ.pop("ODTP_ASYNC_STALENESS", None)
+        t0 = time.time()
+        legs["standalone"] = _async_galaxy(
+            n, async_epochs, local_steps, base_dt, tok_per_step, model,
+            gossip=False,
+        )
+        print(f"  [standalone: {time.time() - t0:.1f}s wall]")
+        t0 = time.time()
+        legs["lockstep"] = _async_galaxy(
+            n, [lock_epochs] * n, local_steps, base_dt, tok_per_step,
+            model, gossip=True,
+        )
+        print(f"  [lockstep: {time.time() - t0:.1f}s wall]")
+        os.environ["ODTP_ASYNC_STALENESS"] = str(window)
+        os.environ["ODTP_ASYNC_DECAY"] = str(decay)
+        os.environ["ODTP_ASYNC_PATIENCE_S"] = str(patience)
+        t0 = time.time()
+        legs["async"] = _async_galaxy(
+            n, async_epochs, local_steps, base_dt, tok_per_step, model,
+            gossip=True,
+        )
+        print(f"  [async: {time.time() - t0:.1f}s wall]")
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        chaos.reset()
+
+    errors = [
+        r for rows in legs.values() for r in rows if r is None or "error" in r
+    ]
+    agg = {
+        leg: round(sum(r["tokens_per_s"] for r in rows), 1)
+        for leg, rows in legs.items()
+        if not any(r is None or "error" in r for r in rows)
+    }
+    slowest = (
+        min(r["tokens_per_s"] for r in legs["standalone"])
+        if "standalone" in agg else 0.0
+    )
+    summary = {
+        leg: {
+            "aggregate_tokens_per_s": agg.get(leg),
+            "rows": rows,
+        }
+        for leg, rows in legs.items()
+    }
+    doc = {
+        "bench": "async",
+        "workers": n,
+        "model": model,
+        "local_steps": local_steps,
+        "base_inner_step_s": base_dt,
+        "tok_per_step": tok_per_step,
+        "skew": skews,
+        "chaos_spec": skew_spec,
+        "window": window,
+        "decay": decay,
+        "patience_s": patience,
+        "selftest": bool(args.selftest),
+        "legs": summary,
+        "slowest_standalone_tokens_per_s": slowest,
+        "errors": [r for r in errors if r is not None],
+        "updated": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "host": {
+            "cores": os.cpu_count(), "loadavg": round(os.getloadavg()[0], 2)
+        },
+    }
+    if "standalone" in agg and "async" in agg and "lockstep" in agg:
+        doc["async_vs_standalone_sum"] = round(
+            agg["async"] / agg["standalone"], 3
+        )
+        doc["lockstep_vs_standalone_sum"] = round(
+            agg["lockstep"] / agg["standalone"], 3
+        )
+    with open(out_path, "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+        f.write("\n")
+    for leg in ("standalone", "lockstep", "async"):
+        print(
+            f"{leg:>11}: aggregate "
+            f"{agg.get(leg, float('nan')):10.1f} tok/s"
+        )
+    print(f"banked {out_path}")
+    if errors:
+        raise SystemExit(f"async bench produced error rows: {errors}")
+    if args.selftest:
+        return
+    # acceptance: async holds near the SUM of standalone rates; lockstep
+    # is bounded by the slowest worker's rate (x n, with drift slack for
+    # fast-fast pairs running ahead inside the matching's elasticity)
+    if agg["async"] < 0.8 * agg["standalone"]:
+        raise SystemExit(
+            f"async aggregate {agg['async']:.0f} tok/s below 0.8x the "
+            f"standalone sum {agg['standalone']:.0f}"
+        )
+    if agg["lockstep"] > 1.5 * n * slowest:
+        raise SystemExit(
+            f"lockstep aggregate {agg['lockstep']:.0f} tok/s not bounded "
+            f"by the slowest worker ({n} x {slowest:.0f} x 1.5)"
+        )
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--peers", type=int, default=2)
@@ -1602,12 +1863,21 @@ def main() -> None:
         "GOSSIP_BENCH.json",
     )
     ap.add_argument(
+        "--async", action="store_true", dest="async_bench",
+        help="lockstep vs bounded-staleness async gossip rounds on a "
+        "2x/4x inner-step-skewed loopback galaxy (chaos "
+        "straggle_inner_x); banks ASYNC_BENCH.json",
+    )
+    ap.add_argument(
         "--selftest", action="store_true",
-        help="with --hetero/--stream/--compress/--hier/--gossip: "
+        help="with --hetero/--stream/--compress/--hier/--gossip/--async: "
         "small/fast CI shape that checks the loop works without "
         "asserting the speedup/overhead line",
     )
     args = ap.parse_args()
+    if args.async_bench:
+        async_main(args)
+        return
     if args.gossip:
         gossip_main(args)
         return

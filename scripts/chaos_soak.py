@@ -480,6 +480,189 @@ def gossip_leg(args) -> int:
     return 0 if ok else 1
 
 
+def async_leg(args) -> int:
+    """Bounded-staleness ASYNC gossip soak under churn: the free-running
+    round clock (ODTP_ASYNC_STALENESS) on a skewed loopback galaxy where
+    half the workers run their inner phase at half speed — so epoch
+    clocks genuinely drift — and one worker leaves mid-soak WITHOUT
+    announcing. No barrier anywhere: workers match whoever is in-window
+    when they arrive, self-round after patience otherwise, and the
+    leaver's absence must surface only as self-rounds or dropped-round
+    non-events, never as an error.
+
+    Gates: every worker completes its full epoch budget (the leaver its
+    truncated one); zero error rows; per-partner EF residual mass is
+    EXACTLY conserved across every dropped and self round; matching
+    still paired workers (the async plane did real mixing, not a galaxy
+    of hermits); every round is a pair. Banked additively into
+    CHAOS_SOAK.json under ``"async_leg"``.
+    """
+    import threading
+
+    from opendiloco_tpu.diloco.gossip import GossipPlane
+    from opendiloco_tpu.diloco.loopback import LoopbackWorld
+    from opendiloco_tpu.diloco.outer_optimizer import noloco_step
+
+    n = 4 if args.selftest else 6
+    window, patience = 2, 0.3
+    epochs_1x = max(6, args.rounds * 2)
+    # half-speed inner phases on the odd ranks: the epoch clocks drift by
+    # construction, so matching exercises the staleness window for real
+    skews = [1 if r % 2 == 0 else 2 for r in range(n)]
+    budgets = [max(3, epochs_1x // x) for x in skews]
+    leave_rank = n - 1
+    budgets[leave_rank] = max(2, budgets[leave_rank] // 2)
+    inner_s = 0.02
+    shapes = ((64, 8), (33,), (16, 4))
+    idxs = list(range(len(shapes)))
+    t0 = time.time()
+
+    chaos_spec = "seed=17;drop_conn=0.05;delay_ms=1..15"
+    saved = {
+        k: os.environ.get(k)
+        for k in ("ODTP_CHAOS", "ODTP_ASYNC_STALENESS",
+                  "ODTP_ASYNC_PATIENCE_S")
+    }
+    os.environ["ODTP_CHAOS"] = chaos_spec
+    os.environ["ODTP_ASYNC_STALENESS"] = str(window)
+    os.environ["ODTP_ASYNC_PATIENCE_S"] = str(patience)
+
+    world = LoopbackWorld(n, compression="blockwise4bit")
+    backends = world.make_backends()
+    planes = [
+        GossipPlane(
+            b, len(shapes), compression="blockwise4bit", error_feedback=True
+        )
+        for b in backends
+    ]
+
+    errors: list[str] = []
+    ef_violations: list[str] = []
+    completed: dict[str, int] = {}
+    paired = [0] * n
+    selfed = [0] * n
+    dropped = [0] * n
+    lags: list[int] = []
+    stat_lock = threading.Lock()
+
+    def worker(rank: int) -> None:
+        try:
+            rng = np.random.default_rng(300 + rank)
+            masters = [
+                rng.standard_normal(s).astype(np.float32) for s in shapes
+            ]
+            bufs = [np.zeros_like(m) for m in masters]
+            plane = planes[rank]
+            for e in range(budgets[rank]):
+                time.sleep(inner_s * skews[rank])  # the skewed inner phase
+                pgs = [
+                    (rng.standard_normal(s) * 0.01).astype(np.float32)
+                    for s in shapes
+                ]
+                before = plane.residual_mass()
+                res = plane.exchange(
+                    epoch=e, frag_id=0, idxs=idxs, masters=masters,
+                    bufs=bufs, pgs=pgs, timeout=15.0,
+                )
+                with stat_lock:
+                    if res is None:
+                        dropped[rank] += 1
+                    elif res[4] == 1:
+                        selfed[rank] += 1
+                    else:
+                        paired[rank] += 1
+                        lag = backends[rank].last_round_health.get("pair_lag")
+                        if lag is not None:
+                            lags.append(int(lag))
+                    if res is None or res[4] == 1:
+                        # neither a drop nor a self-round may touch the
+                        # per-partner residual — conservation is exact
+                        after = plane.residual_mass()
+                        if after != before:
+                            ef_violations.append(
+                                f"{backends[rank].peer_id}: non-pair round "
+                                f"changed residual {before!r} -> {after!r}"
+                            )
+                if res is not None:
+                    mix_m, mix_b, avg_g, _partner, _grp = res
+                    masters, bufs = noloco_step(
+                        mix_m, mix_b, avg_g, lr=0.7, momentum=0.9,
+                        nesterov=True,
+                    )
+            if not all(np.isfinite(m).all() for m in masters):
+                raise RuntimeError(f"{backends[rank].peer_id}: non-finite")
+            if rank == leave_rank:
+                backends[rank].close()  # leaves without announcing
+            with stat_lock:
+                completed[backends[rank].peer_id] = budgets[rank]
+        except Exception as exc:  # pragma: no cover - banked as evidence
+            with stat_lock:
+                errors.append(f"{backends[rank].peer_id}: {exc!r}")
+
+    threads = [threading.Thread(target=worker, args=(r,)) for r in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+    for k, v in saved.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+
+    all_pairs = all(
+        h.get("group_size", 0) <= 2 for b in backends for h in b.round_ledger
+    )
+    expected = {backends[r].peer_id: budgets[r] for r in range(n)}
+    gates = {
+        "all_epochs_completed": completed == expected,
+        "zero_error_rows": not errors,
+        "ef_mass_conserved_across_drops": not ef_violations,
+        "async_matching_paired_workers": sum(paired) > 0,
+        "every_round_is_a_pair": all_pairs,
+        "pair_mailbox_empty": not world._pairbox,
+    }
+    ok = all(gates.values())
+    report = {
+        "bench": "async_chaos_leg",
+        "workers": n,
+        "window": window,
+        "patience_s": patience,
+        "inner_step_s": inner_s,
+        "skews": skews,
+        "epoch_budgets": budgets,
+        "left_early": backends[leave_rank].peer_id,
+        "chaos": chaos_spec,
+        "compression": "blockwise4bit",
+        "error_feedback": True,
+        "gates": gates,
+        "passed": ok,
+        "paired_rounds": sum(paired),
+        "self_rounds": sum(selfed),
+        "dropped_rounds": sum(dropped),
+        "pair_lags_observed": sorted(set(lags)),
+        "max_pair_lag": max(lags) if lags else None,
+        "ef_violations": ef_violations,
+        "errors": errors,
+        "completed": completed,
+        "expected": expected,
+        "elapsed_s": round(time.time() - t0, 1),
+    }
+    try:
+        with open(args.out) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        doc = {}
+    doc["async_leg"] = report
+    with open(args.out, "w") as f:
+        json.dump(doc, f, indent=2)
+        f.write("\n")
+    print(json.dumps(report, indent=2))
+    print("ASYNC CHAOS LEG " + ("PASSED" if ok else "FAILED"))
+    return 0 if ok else 1
+
+
 _FAULT_RE = re.compile(r"chaos: injected (\w+)")
 
 
@@ -529,9 +712,10 @@ def main() -> int:
     )
     ap.add_argument(
         "--gossip", action="store_true",
-        help="run the NoLoCo gossip churn leg instead (in-process pair "
-        "rounds, leave+join mid-soak, EF conservation gates); banked "
-        "additively under CHAOS_SOAK.json \"gossip_leg\"",
+        help="run the NoLoCo gossip churn legs instead (in-process pair "
+        "rounds, leave+join mid-soak, EF conservation gates, plus the "
+        "bounded-staleness async-matching leg under skew + churn); banked "
+        "additively under CHAOS_SOAK.json \"gossip_leg\"/\"async_leg\"",
     )
     args = ap.parse_args()
     if args.selftest:
@@ -548,7 +732,8 @@ def main() -> int:
     args.obs_dir = os.path.join(args.workdir, "obs")
     if args.gossip:
         os.makedirs(args.workdir, exist_ok=True)
-        return gossip_leg(args)
+        rc = gossip_leg(args)
+        return max(rc, async_leg(args))
 
     os.makedirs(args.workdir, exist_ok=True)
     shutil.rmtree(args.obs_dir, ignore_errors=True)  # stale dumps poison gates

@@ -56,6 +56,20 @@ ARMS = {
             "error_feedback": True,
         },
     ),
+    # gossip_noloco under FREE-RUNNING round clocks: identical wire and
+    # mixing composition, but pairs are matched by the bounded-staleness
+    # scheduler (ODTP_ASYNC_STALENESS via ARM_ENV) instead of the epoch-
+    # aligned key — on a healthy 2-worker galaxy every match lands at
+    # distance 0, so the curve must sit at parity with gossip_noloco
+    "async_noloco": (
+        2,
+        {
+            "outer_mode": "gossip",
+            "overlap_comm": "eager",
+            "compression": "blockwise4bit",
+            "error_feedback": True,
+        },
+    ),
     "overlap_delayed": (0, {"overlap_comm": "delayed"}),
     "overlap_eager": (0, {"overlap_comm": "eager"}),
     # staggered in-phase fragment all-reduce with eager first-step
@@ -72,6 +86,17 @@ ARMS = {
         0,
         {"compression": "blockwise4bit", "error_feedback": True},
     ),
+}
+
+# env knobs an arm needs armed for its run (set before, restored after):
+# the async scheduler is env-gated, not a DilocoConfig field
+ARM_ENV = {
+    "async_noloco": {
+        "ODTP_ASYNC_STALENESS": "2",
+        # generous patience: the parity claim needs real pair mixing, and
+        # a 2-worker CPU galaxy's threads can drift by a compile
+        "ODTP_ASYNC_PATIENCE_S": "10.0",
+    },
 }
 
 
@@ -274,6 +299,9 @@ def main(arms: str = "all"):
     # (arxiv 2502.12996), and their streaming-eager composition
     for arm in (list(ARMS) if want is None else want):
         frags, overrides = ARMS[arm]
+        arm_env = ARM_ENV.get(arm, {})
+        saved_env = {k: os.environ.get(k) for k in arm_env}
+        os.environ.update(arm_env)
         try:
             arm_l, arm_p0, doc[f"{arm}_wall_s"] = run_diloco_pair(
                 frags, **overrides
@@ -285,6 +313,12 @@ def main(arms: str = "all"):
             doc.pop("error", None)
             _flush(doc)
             continue
+        finally:
+            for k, v in saved_env.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
         if "arm_errors" in doc:  # a re-run supersedes a banked failure
             doc["arm_errors"].pop(arm, None)
             if not doc["arm_errors"]:

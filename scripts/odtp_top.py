@@ -27,7 +27,8 @@ sys.path.insert(0, REPO)
 
 _COLS = (
     ("worker", 10), ("round", 18), ("partner", 10), ("epoch", 5),
-    ("loss", 8), ("tok/s", 9), ("pg_norm", 9), ("wan_tx", 9),
+    ("lag", 4), ("loss", 8), ("tok/s", 9), ("step/s", 7),
+    ("pg_norm", 9), ("wan_tx", 9),
     ("round_s", 8), ("stale", 5), ("age_s", 6),
 )
 
@@ -86,15 +87,29 @@ def render(matrix: dict, now: float) -> str:
     header = " ".join(name.rjust(w) for name, w in _COLS)
     lines = [header, "-" * len(header)]
     rows = sorted(matrix.items(), key=lambda kv: str(kv[0]))
+    # epoch lag vs the galaxy front-runner: under async bounded-staleness
+    # gossip this is the live skew signal (a worker whose lag exceeds
+    # ODTP_ASYNC_STALENESS is out of matchable range — see the
+    # stale_worker watchdog); under lockstep modes it hovers at 0/1
+    front = max(
+        (int(v["epoch"]) for v in matrix.values()
+         if isinstance(v.get("epoch"), (int, float))), default=None)
     for pid, vec in rows:
         stages = vec.get("stages") or {}
         ts = float(vec.get("ts", 0) or 0)
+        epoch = vec.get("epoch")
+        lag = (
+            front - int(epoch)
+            if front is not None and isinstance(epoch, (int, float))
+            else None
+        )
         cells = (
             vec.get("worker", pid), vec.get("round"),
             # gossip rounds: who this worker mixed with last ("-" under
             # the global collective); pair_s is their round_s analogue
-            vec.get("partner"), vec.get("epoch"),
-            vec.get("loss"), vec.get("tokens_per_s"), vec.get("pg_norm"),
+            vec.get("partner"), epoch, lag,
+            vec.get("loss"), vec.get("tokens_per_s"),
+            vec.get("steps_per_s"), vec.get("pg_norm"),
             vec.get("wire_tx_bytes_wan"),
             stages.get("round_s", stages.get("pair_s")),
             vec.get("staleness"), round(now - ts, 1) if ts else None,

@@ -1,0 +1,329 @@
+"""Fully asynchronous outer rounds: bounded-staleness gossip matching.
+
+Pins the ISSUE-mandated guarantees for the free-running round clock:
+- a distance-0 async match mixes BIT-IDENTICALLY to the lockstep pair
+  average (same sorted-pair operand order, same codec path);
+- the staleness window is exact at the boundary: epoch distance == window
+  matches, window + 1 self-rounds;
+- the staleness-discounted mix is the documented convex combination and
+  preserves the pair sum (galaxy mean drift-free);
+- a match whose transfer fails is the dropped-round non-event: per-partner
+  EF residual retained exactly, nothing adopted;
+- a 2-worker galaxy whose workers stay epoch-aligned produces the exact
+  lockstep master trajectory under async matching (free-running rounds
+  are a strict generalisation, not a different algorithm).
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from opendiloco_tpu.diloco.gossip import GossipPlane
+from opendiloco_tpu.diloco.loopback import LoopbackWorld
+from opendiloco_tpu.diloco.outer_optimizer import (
+    noloco_step,
+    staleness_mix,
+    staleness_weight,
+)
+
+
+def _leaves(seed, shapes=((6, 4), (5,))):
+    rng = np.random.default_rng(seed)
+    return [rng.normal(size=s).astype(np.float32) for s in shapes]
+
+
+def _run_async_pair(planes, epochs, frag_id=0, inputs=None, timeout=30.0):
+    """Drive both workers' exchange() concurrently at (possibly different)
+    epochs; returns per-rank (result, inputs)."""
+    if inputs is None:
+        inputs = [
+            (_leaves(r), _leaves(10 + r), _leaves(20 + r)) for r in range(2)
+        ]
+    out = [None, None]
+    errors = []
+
+    def worker(rank):
+        try:
+            m, b, g = inputs[rank]
+            out[rank] = planes[rank].exchange(
+                epoch=epochs[rank], frag_id=frag_id,
+                idxs=list(range(len(m))),
+                masters=m, bufs=b, pgs=g, timeout=timeout,
+            )
+        except Exception as e:  # pragma: no cover - surfaced below
+            errors.append(f"rank {rank}: {e!r}")
+
+    ts = [threading.Thread(target=worker, args=(r,)) for r in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=60)
+    assert not errors, errors
+    return out, inputs
+
+
+# ---------------------------------------------------------------------------
+# staleness weight / mix algebra
+# ---------------------------------------------------------------------------
+
+
+def test_staleness_weight_decay_and_mix_mean_preserving():
+    assert staleness_weight(0) == 0.5  # distance 0 IS the pair average
+    assert staleness_weight(1, 0.5) == 0.25
+    assert staleness_weight(3, 0.5) == 0.0625
+    assert staleness_weight(2, 1.0) == 0.5  # decay 1.0: ignore staleness
+    a, b = _leaves(1), _leaves(2)
+    w = staleness_weight(2, 0.5)
+    mix_a = staleness_mix(a, b, w)
+    mix_b = staleness_mix(b, a, w)
+    for xa, xb, ra, rb in zip(mix_a, mix_b, a, b):
+        # both sides share the distance, so the two updates sum to the
+        # pair's sum — the galaxy mean never drifts under staleness
+        np.testing.assert_allclose(xa + xb, ra + rb, rtol=1e-5, atol=1e-6)
+        np.testing.assert_array_equal(
+            xa,
+            ra * (np.float32(1.0) - np.float32(w)) + rb * np.float32(w),
+        )
+
+
+# ---------------------------------------------------------------------------
+# distance-0 bit-parity with the lockstep pair average
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("compression", ["none", "blockwise4bit"])
+def test_async_distance0_bit_identical_to_lockstep(monkeypatch, compression):
+    """Same epoch on both workers: the async match must produce the exact
+    bits of the PR-15 lockstep pair round, including under lossy codecs
+    (both decode both frames, sorted-pair operand order)."""
+    inputs = [(_leaves(r), _leaves(10 + r), _leaves(20 + r)) for r in range(2)]
+
+    def run():
+        world = LoopbackWorld(2)
+        backends = world.make_backends()
+        planes = [GossipPlane(b, 2, compression=compression) for b in backends]
+        copies = [
+            tuple([a.copy() for a in part] for part in inp) for inp in inputs
+        ]
+        out, _ = _run_async_pair(planes, epochs=(4, 4), inputs=copies)
+        assert all(r is not None for r in out)
+        return out
+
+    lockstep = run()
+    monkeypatch.setenv("ODTP_ASYNC_STALENESS", "3")
+    asynced = run()
+    for rank in range(2):
+        l_m, l_b, l_g, _, l_n = lockstep[rank]
+        a_m, a_b, a_g, _, a_n = asynced[rank]
+        assert l_n == a_n == 2
+        for x, y in zip(l_m + l_b + l_g, a_m + a_b + a_g):
+            np.testing.assert_array_equal(x, y)
+    # async health records the 0 lag; lockstep rounds carry none
+    # (the ledger key also flips to the free-running af- form)
+
+
+def test_async_distance0_health_records_lag(monkeypatch):
+    monkeypatch.setenv("ODTP_ASYNC_STALENESS", "2")
+    world = LoopbackWorld(2)
+    backends = world.make_backends()
+    planes = [GossipPlane(b, 2, compression="none") for b in backends]
+    out, _ = _run_async_pair(planes, epochs=(1, 1))
+    assert all(r is not None for r in out)
+    for rank in range(2):
+        h = backends[rank].last_round_health
+        assert h["round"].startswith("gossip-af0-e1")
+        assert h["pair_lag"] == 0
+        assert h["partner"] == backends[1 - rank].peer_id
+
+
+# ---------------------------------------------------------------------------
+# window boundary: distance == window matches, window + 1 drops to self
+# ---------------------------------------------------------------------------
+
+
+def test_async_window_boundary_match(monkeypatch):
+    """Epoch distance EXACTLY the window: must match, mix with the
+    documented staleness weight, and record pair_lag == window."""
+    monkeypatch.setenv("ODTP_ASYNC_STALENESS", "2")
+    monkeypatch.setenv("ODTP_STATE_CODEC", "none")
+    world = LoopbackWorld(2)
+    backends = world.make_backends()
+    planes = [GossipPlane(b, 2, compression="none") for b in backends]
+    out, inputs = _run_async_pair(planes, epochs=(3, 5))
+    assert all(r is not None for r in out)
+    w = staleness_weight(2)  # 0.5 * 0.5**2
+    for rank, res in enumerate(out):
+        mix_m, mix_b, avg_g, partner, n = res
+        assert n == 2
+        assert partner == backends[1 - rank].peer_id
+        assert backends[rank].last_round_health["pair_lag"] == 2
+        mine, theirs = inputs[rank], inputs[1 - rank]
+        for got, want in zip(
+            mix_m + mix_b + avg_g,
+            staleness_mix(mine[0], theirs[0], w)
+            + staleness_mix(mine[1], theirs[1], w)
+            + staleness_mix(mine[2], theirs[2], w),
+        ):
+            np.testing.assert_array_equal(got, want)
+    # mean preservation across the pair, end to end through the wire
+    for i in range(2):
+        np.testing.assert_allclose(
+            out[0][0][i] + out[1][0][i],
+            inputs[0][0][i] + inputs[1][0][i],
+            rtol=1e-5, atol=1e-6,
+        )
+
+
+def test_async_beyond_window_self_rounds(monkeypatch):
+    """Epoch distance window + 1: neither worker may adopt the other's
+    state — both self-round (n=1, own exact copies) after patience."""
+    monkeypatch.setenv("ODTP_ASYNC_STALENESS", "2")
+    monkeypatch.setenv("ODTP_ASYNC_PATIENCE_S", "0.3")
+    world = LoopbackWorld(2)
+    backends = world.make_backends()
+    planes = [GossipPlane(b, 2, compression="blockwise4bit") for b in backends]
+    out, inputs = _run_async_pair(planes, epochs=(0, 3))
+    for rank, res in enumerate(out):
+        mix_m, mix_b, avg_g, partner, n = res
+        assert n == 1
+        assert partner == backends[rank].peer_id  # matched nobody
+        m, b, g = inputs[rank]
+        for x, y in zip(mix_m + mix_b + avg_g, m + b + g):
+            np.testing.assert_array_equal(x, y)  # codec never touches these
+        assert "pair_lag" not in backends[rank].last_round_health
+
+
+def test_async_self_round_hold_policy(monkeypatch):
+    monkeypatch.setenv("ODTP_ASYNC_STALENESS", "1")
+    monkeypatch.setenv("ODTP_ASYNC_PATIENCE_S", "0.2")
+    monkeypatch.setenv("ODTP_GOSSIP_SELF_ROUND", "hold")
+    world = LoopbackWorld(1)
+    (backend,) = world.make_backends()
+    plane = GossipPlane(backend, 2, compression="none")
+    m, b, g = _leaves(0), _leaves(10), _leaves(20)
+    res = plane.exchange(
+        epoch=0, frag_id=0, idxs=[0, 1], masters=m, bufs=b, pgs=g
+    )
+    assert res is None
+    assert backend.last_round_health.get("dropped") is True
+
+
+# ---------------------------------------------------------------------------
+# EF residual conservation across a failed (post-match) transfer
+# ---------------------------------------------------------------------------
+
+
+def test_async_failed_transfer_keeps_ef_residual(monkeypatch):
+    """Partner matches, then dies before the transfer: the round is the
+    dropped-round non-event — EF residual neither lost nor double-counted,
+    and the next good match replays it."""
+    monkeypatch.setenv("ODTP_ASYNC_STALENESS", "2")
+    world = LoopbackWorld(2)
+    backends = world.make_backends()
+    planes = [
+        GossipPlane(b, 2, compression="blockwise4bit", error_feedback=True)
+        for b in backends
+    ]
+    # epoch 0: a good async round seeds per-partner EF residual on rank 0
+    out, _ = _run_async_pair(planes, epochs=(0, 0))
+    assert all(r is not None and r[4] == 2 for r in out)
+    mass = planes[0].residual_mass()
+    assert mass > 0.0  # 4-bit codec left roundtrip error behind
+
+    # rank 1 posts an offer then leaves the swarm WITHOUT transferring;
+    # rank 0 claims the match and its pair_exchange hits partner-left
+    res = [None]
+
+    def flaky_partner():
+        match = backends[1].async_pair_match(
+            frag_id=0, epoch=1, window=2, patience=10.0
+        )
+        assert match is not None  # rank 0 claimed us
+        backends[1].close()  # ...and we vanish before the transfer
+
+    def survivor():
+        m, b, g = _leaves(0), _leaves(10), _leaves(20)
+        res[0] = planes[0].exchange(
+            epoch=1, frag_id=0, idxs=[0, 1], masters=m, bufs=b, pgs=g,
+            timeout=5.0,
+        )
+
+    t1 = threading.Thread(target=flaky_partner)
+    t0 = threading.Thread(target=survivor)
+    t1.start()
+    t0.start()
+    t0.join(timeout=60)
+    t1.join(timeout=60)
+    assert res[0] is None  # dropped-round non-event
+    assert planes[0].residual_mass() == pytest.approx(mass)
+    h = backends[0].last_round_health
+    assert h.get("dropped") is True
+    assert h["partner"] == backends[1].peer_id  # it DID match first
+    # no abandoned mailbox deposits (GC on the error path)
+    assert not world._pairbox
+
+
+# ---------------------------------------------------------------------------
+# 2-worker trajectory: async == lockstep when workers stay aligned
+# ---------------------------------------------------------------------------
+
+
+def _run_trajectory(n_epochs=4):
+    """K exchange+noloco_step epochs on 2 workers kept epoch-aligned by a
+    barrier; returns per-rank final (masters, bufs)."""
+    world = LoopbackWorld(2)
+    backends = world.make_backends()
+    planes = [GossipPlane(b, 2, compression="blockwise4bit") for b in backends]
+    barrier = threading.Barrier(2, timeout=60)
+    final = [None, None]
+    errors = []
+
+    def worker(rank):
+        try:
+            m = _leaves(rank)
+            b = _leaves(10 + rank)
+            for e in range(n_epochs):
+                barrier.wait()
+                g = _leaves(1000 + 10 * e + rank)
+                res = planes[rank].exchange(
+                    epoch=e, frag_id=0, idxs=[0, 1],
+                    masters=m, bufs=b, pgs=g, timeout=30.0,
+                )
+                assert res is not None
+                mix_m, mix_b, avg_g, _, n = res
+                assert n == 2
+                m, b = noloco_step(
+                    mix_m, mix_b, avg_g, lr=0.7, momentum=0.9, nesterov=True
+                )
+            final[rank] = (m, b)
+        except Exception as e:  # pragma: no cover - surfaced below
+            errors.append(f"rank {rank}: {e!r}")
+
+    ts = [threading.Thread(target=worker, args=(r,)) for r in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=120)
+    assert not errors, errors
+    assert all(f is not None for f in final)
+    return final
+
+
+def test_async_vs_lockstep_trajectory_bit_identical(monkeypatch):
+    """Aligned workers under async matching walk the EXACT lockstep
+    master trajectory: every match is distance 0 and routes through the
+    same sorted-pair average, so K epochs of NoLoCo agree to the bit."""
+    lockstep = _run_trajectory()
+    monkeypatch.setenv("ODTP_ASYNC_STALENESS", "2")
+    asynced = _run_trajectory()
+    for rank in range(2):
+        for a, b in zip(
+            lockstep[rank][0] + lockstep[rank][1],
+            asynced[rank][0] + asynced[rank][1],
+        ):
+            np.testing.assert_array_equal(a, b)
+    # and within each mode both workers agree (paired masters never drift)
+    for mode in (lockstep, asynced):
+        for a, b in zip(mode[0][0], mode[1][0]):
+            np.testing.assert_array_equal(a, b)

@@ -118,6 +118,67 @@ def test_deterministic_given_seed():
     assert decisions(c) != decisions(a)
 
 
+# -- inner-step speed skew (async outer rounds bench) -------------------------
+
+
+def test_parse_straggle_inner_x_both_forms():
+    # scalar factor scoped by workers=
+    p = chaos.parse_spec("seed=1;straggle_inner_x=2.0;workers=w3,w7")
+    assert p["straggle_inner_x"] == {None: 2.0}
+    assert p["workers"] == [3, 7]
+    # per-rank table form
+    p = chaos.parse_spec("seed=1;straggle_inner_x=w3:2.0,w7:4.0")
+    assert p["straggle_inner_x"] == {3: 2.0, 7: 4.0}
+    for bad in (
+        "straggle_inner_x=0.5",  # speed-UP is not a fault
+        "straggle_inner_x=w3:0.9",
+        "straggle_inner_x=3:2.0",  # missing the w prefix
+        "workers=",
+    ):
+        with pytest.raises(chaos.ChaosSpecError):
+            chaos.parse_spec(bad)
+
+
+def test_straggle_inner_x_scoping():
+    p = chaos.ChaosPlane("seed=1;straggle_inner_x=2.0;workers=w3,w7")
+    assert p.straggle_inner_x(rank=3) == 2.0
+    assert p.straggle_inner_x(rank=7) == 2.0
+    assert p.straggle_inner_x(rank=0) == 1.0  # out of scope: full speed
+    p.set_identity(3)
+    assert p.straggle_inner_x() == 2.0  # identity form
+    # scalar with NO workers= applies to every rank
+    q = chaos.ChaosPlane("seed=1;straggle_inner_x=1.5")
+    assert q.straggle_inner_x(rank=12) == 1.5
+    # per-rank table ignores workers= scoping
+    r = chaos.ChaosPlane("seed=1;straggle_inner_x=w3:2.0,w7:4.0")
+    assert r.straggle_inner_x(rank=7) == 4.0
+    assert r.straggle_inner_x(rank=4) == 1.0
+    # disarmed plane: neutral
+    assert chaos.ChaosPlane("seed=1").straggle_inner_x(rank=3) == 1.0
+
+
+def test_straggle_inner_x_is_pure_lookup_no_rng_draws():
+    """The skew factor must be a PURE table lookup: concurrent bench
+    threads query it every inner step, so it may neither consume RNG
+    draws (which would perturb the deterministic fault stream) nor
+    count as an injected fault."""
+    spec = "seed=9;drop_conn=0.3;delay_ms=5..50;delay_p=0.4;straggle_inner_x=w1:2.0"
+
+    def decisions(p, interleave):
+        seq = []
+        for _ in range(100):
+            if interleave:
+                for rank in (0, 1, 2):
+                    p.straggle_inner_x(rank=rank)
+            seq.append(p.drop_conn("s"))
+            seq.append(round(p.delay_s("s"), 9))
+        return seq
+
+    a, b = chaos.ChaosPlane(spec), chaos.ChaosPlane(spec)
+    assert decisions(a, interleave=True) == decisions(b, interleave=False)
+    assert dict(a.counters) == dict(b.counters)  # lookups are not faults
+
+
 # -- backoff + retry knobs ----------------------------------------------------
 
 
