@@ -39,6 +39,10 @@ KNOBS: tuple[Knob, ...] = (
          "Output path override for `bench_outer.py --async` "
          "(default `ASYNC_BENCH.json` in the repo root).",
          doc_default="repo artifact"),
+    Knob("ODTP_AUTOSCALE_BENCH_OUT", "path", "", "bench",
+         "Output path override for `scripts/fleet_autoscale_bench.py` "
+         "(default `AUTOSCALE_BENCH.json` in the repo root).",
+         doc_default="repo artifact"),
     Knob("ODTP_BOUNDARY_BENCH_OUT", "path", "", "bench",
          "Output path override for `bench_outer.py --boundary` "
          "(default `BOUNDARY_BENCH.json` in the repo root).",
@@ -133,6 +137,20 @@ KNOBS: tuple[Knob, ...] = (
     Knob("ODTP_FLEET_PUSH_INTERVAL_S", "float", "", "fleet",
          "Seconds between fleet pusher wake-ups per replica (each wake-up "
          "ships pending delta/keyframe frames or a staleness ping).",
+         doc_default="config"),
+    Knob("ODTP_FLEET_SCALE_COOLDOWN_S", "float", "", "fleet",
+         "Minimum seconds between autoscaler scaling actions (replacement "
+         "of dead replicas and spare replenishment are never "
+         "cooldown-gated).", doc_default="config"),
+    Knob("ODTP_FLEET_SLO_P99_MS", "float", "", "fleet",
+         "Serving latency SLO for the fleet autoscaler: worst-replica "
+         "decode p99 above this (or queue depth above "
+         "`fleet.slo_queue_depth`) is a breach that scales the fleet up. "
+         "0 disables the latency term.", doc_default="config"),
+    Knob("ODTP_FLEET_WARM_SPARES", "int", "", "fleet",
+         "Warm-spare pool size: replicas kept pre-keyframed on the push "
+         "channel but unregistered with the router, so scale-up is a "
+         "promotion (mailbox adoption), not a cold boot.",
          doc_default="config"),
     # -- model ----------------------------------------------------------------
     Knob("ODTP_SCAN_UNROLL", "int", "", "model",

@@ -305,6 +305,28 @@ class FleetConfig(BaseModel):
     prefill_buckets: list[int] = [32, 128]
     max_queue: int = 1024
     prefix_cache: bool = True
+    # SLO-driven autoscaling (fleet/autoscaler.py): a closed control loop
+    # that scales replica count against the declared SLO and replaces
+    # dead replicas without operator action. `replicas` becomes the
+    # initial size; the loop holds it within [min_replicas, max_replicas].
+    autoscale: bool = False
+    # declared SLO: worst ready-replica client p99 the loop defends
+    # (0 disables the latency signal) and the per-replica queue depth
+    # above which traffic is considered backlogged
+    slo_p99_ms: float = 0.0
+    slo_queue_depth: int = 8
+    min_replicas: int = 1
+    max_replicas: int = 8
+    # pre-keyframed standby replicas (push channel attached, router not):
+    # scale-up adopts one instantly instead of cold-booting
+    warm_spares: int = 0
+    # control-loop damping: seconds between scale actions, evaluation
+    # cadence, and consecutive breached/clear evaluations required before
+    # scaling up/down (hysteresis — up reacts faster than down)
+    scale_cooldown_s: float = 5.0
+    scale_eval_interval_s: float = 0.5
+    scale_up_evals: int = 2
+    scale_down_evals: int = 8
 
     @field_validator("prefill_buckets", mode="before")
     @classmethod
@@ -329,6 +351,20 @@ class FleetConfig(BaseModel):
             raise ValueError(
                 "largest fleet prefill bucket exceeds fleet.max_context"
             )
+        if self.min_replicas < 1:
+            raise ValueError("fleet.min_replicas must be >= 1")
+        if self.max_replicas < self.min_replicas:
+            raise ValueError(
+                "fleet.max_replicas must be >= fleet.min_replicas"
+            )
+        if self.warm_spares < 0:
+            raise ValueError("fleet.warm_spares must be >= 0")
+        if self.slo_p99_ms < 0:
+            raise ValueError("fleet.slo_p99_ms must be >= 0")
+        if self.slo_queue_depth < 1:
+            raise ValueError("fleet.slo_queue_depth must be >= 1")
+        if self.scale_up_evals < 1 or self.scale_down_evals < 1:
+            raise ValueError("fleet.scale_*_evals must be >= 1")
         return self
 
 

@@ -29,12 +29,14 @@ import threading
 from typing import Any, Optional
 
 from opendiloco_tpu import obs
+from opendiloco_tpu.fleet.autoscaler import FleetAutoscaler
 from opendiloco_tpu.fleet.publisher import DeltaPublisher, apply_frame  # noqa: F401
 from opendiloco_tpu.fleet.router import FleetRouter
 from opendiloco_tpu.fleet.wire import FleetWireError, recv_frame, send_frame
 
 __all__ = [
     "DeltaPublisher",
+    "FleetAutoscaler",
     "FleetManager",
     "FleetPlane",
     "FleetRouter",
@@ -69,6 +71,8 @@ class FleetManager:
         self._stops: dict[str, threading.Event] = {}
         self._threads: dict[str, threading.Thread] = {}
         self._last_reply: dict[str, dict] = {}
+        self._addrs: dict[str, tuple[str, int]] = {}
+        self._spares: set[str] = set()
         self._lock = threading.Lock()
 
     def attach(
@@ -78,9 +82,20 @@ class FleetManager:
         serve_port: int,
         push_host: str,
         push_port: int,
+        *,
+        router_register: bool = True,
     ) -> None:
+        """Register ``rid`` on the push channel. ``router_register=False``
+        makes it a warm spare: it follows keyframes/deltas like any
+        replica but takes no traffic until :meth:`promote` hands its
+        address to the router — so scale-up is a mailbox adoption, not a
+        cold boot."""
         self.publisher.register(rid)
-        if self.router is not None:
+        with self._lock:
+            self._addrs[rid] = (serve_host, int(serve_port))
+            if not router_register:
+                self._spares.add(rid)
+        if router_register and self.router is not None:
             self.router.add_replica(rid, serve_host, serve_port)
         stop = threading.Event()
         t = threading.Thread(
@@ -98,6 +113,9 @@ class FleetManager:
         with self._lock:
             stop = self._stops.pop(rid, None)
             t = self._threads.pop(rid, None)
+            self._addrs.pop(rid, None)
+            self._spares.discard(rid)
+            self._last_reply.pop(rid, None)
         if stop is not None:
             stop.set()
         if t is not None:
@@ -106,6 +124,57 @@ class FleetManager:
         if self.router is not None:
             self.router.remove_replica(rid)
 
+    # -- warm spares ---------------------------------------------------------
+
+    def spares(self) -> list:
+        with self._lock:
+            return sorted(self._spares)
+
+    def addr(self, rid: str) -> Optional[tuple]:
+        """(serve_host, serve_port) for an attached replica or spare."""
+        with self._lock:
+            return self._addrs.get(rid)
+
+    def spare_ready(self, rid: str) -> bool:
+        """A spare is adoptable once a push reply confirmed applied
+        weights (a keyframe landed) and its health says ready."""
+        with self._lock:
+            if rid not in self._spares:
+                return False
+            rmeta = self._last_reply.get(rid)
+        if not rmeta:
+            return False
+        h = rmeta.get("health") or {}
+        return bool(rmeta.get("ready", h.get("ready"))) and int(
+            rmeta.get("weights_epoch", -1)
+        ) >= 0
+
+    def promote(self, rid: str) -> bool:
+        """Hand a warm spare's address to the router: it starts taking
+        traffic with the weights it has been following all along."""
+        if self.router is None:
+            return False
+        with self._lock:
+            addr = self._addrs.get(rid)
+            if rid not in self._spares or addr is None:
+                return False
+            self._spares.discard(rid)
+        self.router.add_replica(rid, addr[0], addr[1])
+        obs.count("fleet_spare_promotions", replica=rid)
+        return True
+
+    def demote(self, rid: str) -> bool:
+        """Scale-down without losing warmth: pull ``rid`` out of the
+        router (no more traffic) but keep its push loop following
+        deltas, so it can be re-promoted instantly."""
+        with self._lock:
+            if rid in self._spares or rid not in self._addrs:
+                return False
+            self._spares.add(rid)
+        if self.router is not None:
+            self.router.remove_replica(rid)
+        return True
+
     def _note_reply(self, rid: str, rmeta: dict) -> None:
         with self._lock:
             self._last_reply[rid] = rmeta
@@ -113,11 +182,48 @@ class FleetManager:
         if st is not None:
             obs.count("fleet_staleness_rounds", 1, replica=rid, rounds=int(st))
             obs.gauge("fleet_replica_staleness", int(st), replica=rid)
+        h = rmeta.get("health")
+        if h:
+            if h.get("queue_depth") is not None:
+                obs.gauge(
+                    "fleet_replica_queue_depth", int(h["queue_depth"]),
+                    replica=rid,
+                )
+            if h.get("p99_ms") is not None:
+                obs.gauge(
+                    "fleet_replica_p99_ms", float(h["p99_ms"]), replica=rid
+                )
         vec = rmeta.get("rollup")
         if vec:
             ov = obs.overseer.plane()
             if ov is not None:
                 ov.merge(f"replica:{rid}", vec)
+
+    def health_matrix(self) -> dict:
+        """rid -> latest load/health vector. Base truth is the push-reply
+        ``health`` dict (refreshes at push cadence, works with obs
+        unarmed); overseer matrix rows overlay it when the obs plane is
+        armed, so gossip-merged fields win if fresher channels carry
+        them. This is the autoscaler's entire view of the fleet."""
+        out: dict[str, dict] = {}
+        with self._lock:
+            for rid, rmeta in self._last_reply.items():
+                h = rmeta.get("health")
+                if h:
+                    out[rid] = dict(h)
+        ov = obs.overseer.plane()
+        if ov is not None:
+            for peer, vec in ov.matrix().items():
+                if not peer.startswith("replica:"):
+                    continue
+                rid = peer.split(":", 1)[1]
+                row = out.setdefault(rid, {})
+                for k in (
+                    "queue_depth", "occupancy", "p99_ms", "staleness", "stale"
+                ):
+                    if vec.get(k) is not None:
+                        row[k] = vec[k]
+        return out
 
     def _push_loop(
         self, rid: str, host: str, port: int, stop: threading.Event
@@ -175,7 +281,10 @@ class FleetManager:
 
     def status(self) -> dict:
         with self._lock:
-            return {"replicas": dict(self._last_reply)}
+            return {
+                "replicas": dict(self._last_reply),
+                "spares": sorted(self._spares),
+            }
 
 
 def spawn_replica(
@@ -252,22 +361,28 @@ class FleetPlane:
     router: FleetRouter
     manager: FleetManager
     replicas: dict  # rid -> Replica (inprocess) or subprocess.Popen
+    autoscaler: Optional[FleetAutoscaler] = None
 
     @property
     def port(self) -> int:
         return self.router.port
 
     def status(self) -> dict:
-        return {
+        out = {
             "router": self.router.stats(),
             "publisher": self.publisher.stats(),
             "manager": self.manager.status(),
         }
+        if self.autoscaler is not None:
+            out["autoscaler"] = self.autoscaler.status()
+        return out
 
     def stop(self) -> None:
+        if self.autoscaler is not None:
+            self.autoscaler.stop()
         self.manager.stop()
         self.router.stop()
-        for rep in self.replicas.values():
+        for rep in list(self.replicas.values()):
             if hasattr(rep, "stop"):
                 rep.stop()
             else:
@@ -331,8 +446,11 @@ def build_fleet(
         "prefix_cache": fleet_cfg.prefix_cache,
     }
     replicas: dict[str, Any] = {}
-    for i in range(fleet_cfg.replicas):
-        rid = f"r{i}"
+
+    def _boot(rid: str, register: bool = True) -> None:
+        """Create one replica and attach it; ``register=False`` keeps it
+        a warm spare (push channel only). Shared by initial bring-up and
+        the autoscaler's scale-up/replacement path."""
         if fleet_cfg.inprocess:
             from opendiloco_tpu.fleet.replica import Replica
 
@@ -345,10 +463,7 @@ def build_fleet(
                 **serve_geom,
             )
             replicas[rid] = rep
-            manager.attach(
-                rid, fleet_cfg.host, rep.server.port, fleet_cfg.host,
-                rep.push_port,
-            )
+            serve_port, push_port = rep.server.port, rep.push_port
         else:
             proc, info = spawn_replica(
                 rid,
@@ -358,12 +473,49 @@ def build_fleet(
                 host=fleet_cfg.host,
             )
             replicas[rid] = proc
-            manager.attach(
-                rid, fleet_cfg.host, info["serve_port"], fleet_cfg.host,
-                info["push_port"],
-            )
+            serve_port, push_port = info["serve_port"], info["push_port"]
+        manager.attach(
+            rid, fleet_cfg.host, serve_port, fleet_cfg.host, push_port,
+            router_register=register,
+        )
+
+    def _retire(rid: str) -> None:
+        manager.detach(rid)
+        rep = replicas.pop(rid, None)
+        if rep is None:
+            return
+        if hasattr(rep, "stop"):
+            rep.stop()
+        else:
+            rep.kill()
+            rep.wait(timeout=5.0)
+
+    for i in range(fleet_cfg.replicas):
+        _boot(f"r{i}", True)
+
+    autoscaler = None
+    if fleet_cfg.autoscale or fleet_cfg.warm_spares > 0:
+        autoscaler = FleetAutoscaler(
+            manager,
+            router,
+            slo_p99_ms=fleet_cfg.slo_p99_ms,
+            slo_queue_depth=fleet_cfg.slo_queue_depth,
+            min_replicas=fleet_cfg.min_replicas,
+            max_replicas=fleet_cfg.max_replicas,
+            warm_spares=fleet_cfg.warm_spares,
+            cooldown_s=fleet_cfg.scale_cooldown_s,
+            eval_interval_s=fleet_cfg.scale_eval_interval_s,
+            up_evals=fleet_cfg.scale_up_evals,
+            down_evals=fleet_cfg.scale_down_evals,
+            boot_fn=_boot,
+            retire_fn=_retire,
+        ).start()
     plane = FleetPlane(
-        publisher=publisher, router=router, manager=manager, replicas=replicas
+        publisher=publisher,
+        router=router,
+        manager=manager,
+        replicas=replicas,
+        autoscaler=autoscaler,
     )
     register_plane(plane)
     return plane

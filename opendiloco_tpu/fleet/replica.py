@@ -95,11 +95,16 @@ class Replica:
         self.batcher = ContinuousBatcher(
             engine=self.engine, max_queue=max_queue, prefix_cache=prefix_cache
         ).start()
+        # explicit ports mean a respawn at a known address: retry the
+        # bind while the dying predecessor's listener tears down instead
+        # of falling back to an ephemeral port nobody dials
+        bind_retry_s = 3.0 if serve_port else 0.0
         self.server = ServeServer(
             self.batcher,
             host=host,
             port=serve_port,
             identity=self._identity,
+            bind_retry_s=bind_retry_s,
         )
         tr = obs.tracer()
         if tr is not None:
@@ -108,7 +113,10 @@ class Replica:
         self._push_sock: Optional[socket.socket] = None
         self.push_port = 0
         if start_push_server:
-            self._push_sock = bind_with_fallback(host, push_port, "fleet-push")
+            self._push_sock = bind_with_fallback(
+                host, push_port, "fleet-push",
+                retry_s=3.0 if push_port else 0.0,
+            )
             self._push_sock.listen(8)
             self.push_port = self._push_sock.getsockname()[1]
             threading.Thread(
@@ -193,18 +201,34 @@ class Replica:
             "completed": self.batcher.completed,
         }
 
+    def health(self) -> dict:
+        """Load/health vector the autoscaler steers on (queue depth,
+        occupancy, p99, staleness). Rides every push-channel reply, so
+        the manager's view refreshes at the push cadence even when the
+        obs plane is unarmed."""
+        return {
+            **self.batcher.health(),
+            "staleness": self.staleness(),
+            "stale": self.stale(),
+            "ready": self.ready(),
+        }
+
     def rollup(self) -> Optional[dict]:
         """Overseer health vector for this replica (None when obs is
         unarmed) — the manager merges it into the trainer's matrix."""
         ov = obs.overseer.plane()
         if ov is None:
             return None
+        h = self.batcher.health()
         return ov.rollup(
             role="fleet-replica",
             replica=self.replica_id,
             staleness=self.staleness(),
             weights_epoch=self.engine.weights_epoch,
             stale=self.stale(),
+            queue_depth=h["queue_depth"],
+            occupancy=h["occupancy"],
+            p99_ms=h["p99_ms"],
         )
 
     # -- push channel --------------------------------------------------------
@@ -244,6 +268,7 @@ class Replica:
                             "stale": self.stale(),
                             "ready": self.ready(),
                             "free_slots": self.batcher.slots.num_free,
+                            "health": self.health(),
                         }
                         vec = self.rollup()
                         if vec is not None:

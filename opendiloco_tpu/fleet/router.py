@@ -26,6 +26,7 @@ from __future__ import annotations
 import collections
 import json
 import logging
+import random
 import socket
 import threading
 import time
@@ -75,6 +76,12 @@ class _Backend:
         self.ready = True
         self.inflight = 0
         self.dispatched = 0
+        # health-probe pacing: next due time and current interval. The
+        # interval backs off exponentially while the backend stays dark
+        # and snaps back on contact; jitter on every reschedule keeps a
+        # mass revive from synchronizing into a probe thundering herd.
+        self.probe_at = 0.0
+        self.probe_backoff = 0.0
         self.lock = threading.Lock()
         self.pool: list[socket.socket] = []
         # recent prompts, newest last: the affinity signal for warm-KV
@@ -125,10 +132,18 @@ class FleetRouter:
         self.affinity_min_tokens = int(affinity_min_tokens)
         self.affinity_max_extra_inflight = int(affinity_max_extra_inflight)
         self.probe_interval_s = float(probe_interval_s)
+        # dead-backend probes back off exponentially up to this cap
+        self.probe_backoff_cap_s = max(8 * self.probe_interval_s, 10.0)
+        self._rng = random.Random(0xD15C0)
         self._backends: dict[str, _Backend] = {}
         self._lock = threading.Lock()
         self.redispatches = 0
         self.deaths = 0
+        self.shed = 0
+        # latency floor: fastest recent completed dispatch. A request
+        # whose remaining deadline budget is below even this is provably
+        # unmeetable and is shed at the edge instead of queue-timing-out.
+        self._done_lat: collections.deque = collections.deque(maxlen=128)
         self._stop = threading.Event()
         self._sock = _bind_with_fallback(host, port, "fleet-router")
         self._sock.listen(64)
@@ -144,8 +159,10 @@ class FleetRouter:
     # -- membership ----------------------------------------------------------
 
     def add_replica(self, rid: str, host: str, port: int) -> None:
+        b = _Backend(rid, host, port)
+        self._reschedule_probe(b)  # first probe one jittered interval out
         with self._lock:
-            self._backends[rid] = _Backend(rid, host, port)
+            self._backends[rid] = b
         self._publish_live()
 
     def remove_replica(self, rid: str) -> None:
@@ -225,17 +242,59 @@ class FleetRouter:
             b.release(conn)
         return out
 
+    def _latency_floor_s(self) -> Optional[float]:
+        """Fastest recent completed dispatch — the provable minimum a new
+        request could possibly take."""
+        with self._lock:
+            lats = list(self._done_lat)
+        return min(lats) if lats else None
+
+    def _shed(self, payload: dict, reason: str) -> dict:
+        """Edge rejection: the client gets a structured answer NOW (with
+        a back-off hint) instead of a doomed wait in some replica queue."""
+        self.shed += 1
+        obs.count("fleet_router_shed", reason=reason)
+        floor = self._latency_floor_s() or 0.25
+        out = {
+            "error": "shed",
+            "reason": reason,
+            "retry_after_s": round(max(0.1, min(30.0, 2 * floor)), 3),
+        }
+        if payload.get("id") is not None:
+            out["id"] = payload["id"]
+        return out
+
     def dispatch(self, payload: dict) -> dict:
         prompt = [int(t) for t in payload.get("prompt") or []]
+        deadline_ms = payload.get("deadline_ms")
+        t_deadline = None
+        if deadline_ms is not None:
+            deadline_ms = float(deadline_ms)
+            t_deadline = time.monotonic() + deadline_ms / 1e3
+            floor = self._latency_floor_s()
+            if deadline_ms <= 0.0 or (
+                floor is not None and deadline_ms / 1e3 < 0.9 * floor
+            ):
+                return self._shed(payload, "deadline unmeetable")
         tried: set = set()
         last_error = "no live replicas"
         with self._lock:
             attempts = max(1, 2 * len(self._backends))
         for _ in range(attempts):
+            if t_deadline is not None:
+                remaining = t_deadline - time.monotonic()
+                if remaining <= 0:
+                    return self._shed(payload, "deadline exhausted")
+                # the replica sees what budget is LEFT, not what the
+                # client started with — its scheduler sheds the doomed
+                payload = {
+                    **payload, "deadline_ms": round(remaining * 1e3, 3),
+                }
             b = self._pick(prompt, tried)
             if b is None:
                 break
             b.inflight += 1
+            t0 = time.monotonic()
             try:
                 out = self._forward(b, payload)
             except (OSError, ValueError) as e:
@@ -247,12 +306,16 @@ class FleetRouter:
                 continue
             finally:
                 b.inflight -= 1
+            if out.get("error") == "deadline exceeded":
+                return self._shed(payload, "deadline exceeded")
             if out.get("error") in _RETRYABLE:
                 last_error = f"replica {b.rid}: {out['error']}"
                 tried.add(b.rid)
                 self.redispatches += 1
                 obs.count("fleet_router_redispatch", replica=b.rid)
                 continue
+            if "error" not in out:
+                self._done_lat.append(time.monotonic() - t0)
             b.dispatched += 1
             b.recent.append(prompt)
             obs.count("fleet_router_dispatch", replica=b.rid)
@@ -263,9 +326,14 @@ class FleetRouter:
         return out
 
     def _mark_dead(self, b: _Backend) -> None:
-        if not b.dead:
+        # idempotent under concurrency: two dispatch threads can watch the
+        # same replica die mid-flight; exactly one performs the retire
+        with self._lock:
+            first = not b.dead
             b.dead = True
-            self.deaths += 1
+            if first:
+                self.deaths += 1
+        if first:
             b.close_pool()
             obs.count("fleet_replica_deaths", replica=b.rid)
             wd = obs.anomaly.watchdog()
@@ -313,12 +381,29 @@ class FleetRouter:
         )
         self._publish_live()
 
+    def _reschedule_probe(self, b: _Backend) -> None:
+        """Exponential backoff while dark, snap back on contact, ±25%
+        jitter always — so an autoscaler mass revive never lines every
+        probe up into a synchronized thundering herd."""
+        if b.dead:
+            base = b.probe_backoff or self.probe_interval_s
+            b.probe_backoff = min(2 * base, self.probe_backoff_cap_s)
+        else:
+            b.probe_backoff = self.probe_interval_s
+        jitter = 0.75 + 0.5 * self._rng.random()
+        b.probe_at = time.monotonic() + b.probe_backoff * jitter
+
     def _probe_loop(self) -> None:
-        while not self._stop.wait(self.probe_interval_s):
+        tick = min(0.05, self.probe_interval_s / 4) or 0.05
+        while not self._stop.wait(tick):
+            now = time.monotonic()
             with self._lock:
-                backends = list(self._backends.values())
-            for b in backends:
+                due = [
+                    b for b in self._backends.values() if b.probe_at <= now
+                ]
+            for b in due:
                 self._probe(b)
+                self._reschedule_probe(b)
 
     # -- front-end server ----------------------------------------------------
 
@@ -375,7 +460,13 @@ class FleetRouter:
                 self._respond(conn, 400, {"error": "malformed JSON body"})
                 return
             out = self.dispatch(payload)
-            self._respond(conn, 400 if "error" in out else 200, out)
+            if out.get("error") == "shed":
+                self._respond(
+                    conn, 503, out,
+                    headers={"Retry-After": str(out["retry_after_s"])},
+                )
+            else:
+                self._respond(conn, 400 if "error" in out else 200, out)
         elif method == b"GET" and path.startswith(b"/healthz"):
             with self._lock:
                 live = sum(1 for b in self._backends.values() if not b.dead)
@@ -388,14 +479,25 @@ class FleetRouter:
         else:
             self._respond(conn, 404, {"error": "unknown route"})
 
-    def _respond(self, conn: socket.socket, status: int, obj: dict) -> None:
+    def _respond(
+        self,
+        conn: socket.socket,
+        status: int,
+        obj: dict,
+        headers: Optional[dict] = None,
+    ) -> None:
         body = (json.dumps(obj) + "\n").encode()
-        reason = {200: "OK", 400: "Bad Request", 404: "Not Found"}.get(
-            status, "Error"
-        )
+        reason = {
+            200: "OK",
+            400: "Bad Request",
+            404: "Not Found",
+            503: "Service Unavailable",
+        }.get(status, "Error")
+        extra = "".join(f"{k}: {v}\r\n" for k, v in (headers or {}).items())
         head = (
             f"HTTP/1.0 {status} {reason}\r\n"
             "Content-Type: application/json\r\n"
+            f"{extra}"
             f"Content-Length: {len(body)}\r\n\r\n"
         ).encode()
         conn.sendall(head + body)
@@ -421,6 +523,16 @@ class FleetRouter:
 
     # -- introspection -------------------------------------------------------
 
+    def dead_replicas(self) -> list:
+        """Registered replicas currently marked dead (autoscaler input:
+        these are replacement candidates, not scaling signals)."""
+        with self._lock:
+            return [rid for rid, b in self._backends.items() if b.dead]
+
+    def live_replicas(self) -> list:
+        with self._lock:
+            return [rid for rid, b in self._backends.items() if not b.dead]
+
     def stats(self) -> dict:
         with self._lock:
             backends = dict(self._backends)
@@ -428,6 +540,7 @@ class FleetRouter:
             "port": self.port,
             "redispatches": self.redispatches,
             "deaths": self.deaths,
+            "shed": self.shed,
             "replicas": {
                 rid: {
                     "host": b.host,

@@ -72,6 +72,7 @@ class FlightRecorder:
         self.snapshots: deque = deque(maxlen=16)
         self.faults: deque = deque(maxlen=128)
         self.anomalies: deque = deque(maxlen=64)
+        self.decisions: deque = deque(maxlen=128)
         self.dumps = 0
         self._last_dump = 0.0
         self._last_reason: Optional[str] = None
@@ -106,6 +107,15 @@ class FlightRecorder:
                 **detail,
             })
         self.autodump(f"chaos:{kind}")
+
+    def note_decision(self, rec: dict) -> None:
+        """One control-plane decision (autoscaler scale/replace/shed
+        policy change): ring-recorded and rate-limit-dumped, so a
+        postmortem can line fleet actions up against the health rows
+        that drove them."""
+        with self._lock:
+            self.decisions.append({"wall": round(time.time(), 3), **rec})
+        self.autodump(f"decision:{rec.get('action', '?')}")
 
     def note_anomaly(self, rec: dict) -> None:
         """A watchdog trip: recorded and dumped immediately (no rate limit
@@ -192,6 +202,7 @@ class FlightRecorder:
                 "snapshots": list(self.snapshots),
                 "faults": list(self.faults),
                 "anomalies": list(self.anomalies),
+                "decisions": list(self.decisions),
                 "metrics": self._flat_metrics(),
                 "galaxy": galaxy,
             }

@@ -37,6 +37,11 @@ class Request:
     max_new_tokens: int
     eos_id: Optional[int] = None
     id: int = 0
+    # admission control: lower tier = more important (0 interactive);
+    # t_deadline is absolute time.monotonic() — a queued request past it
+    # is doomed (its client gave up) and is shed instead of decoded
+    priority: int = 0
+    t_deadline: Optional[float] = None
     t_submit: float = 0.0
     t_first: Optional[float] = None
     t_done: Optional[float] = None
@@ -105,7 +110,11 @@ class ContinuousBatcher:
         self.rejected = 0
         self.failed = 0
         self.cancelled = 0
+        self.shed = 0  # deadline-doomed requests dropped unserved
         self.total_new_tokens = 0
+        # EWMA of completed-request latency: the wait estimate behind
+        # Retry-After hints and the router's admission floor
+        self._lat_ewma: Optional[float] = None
         self._latencies: collections.deque = collections.deque(maxlen=4096)
         self._ttfts: collections.deque = collections.deque(maxlen=4096)
         self.staleness_hist: collections.Counter = collections.Counter()
@@ -125,15 +134,33 @@ class ContinuousBatcher:
         prompt: Sequence[int],
         max_new_tokens: int = 16,
         eos_id: Optional[int] = None,
+        priority: int = 0,
+        deadline_ms: Optional[float] = None,
     ) -> Request:
         """Queue a prompt; returns a Request whose ``wait()`` unblocks when
-        generation completes (or it was rejected — check ``error``)."""
+        generation completes (or it was rejected — check ``error``).
+
+        ``deadline_ms`` is the remaining client budget: the scheduler
+        orders the queue by (priority, deadline) and sheds a request
+        whose deadline expires before it reaches a slot — the doomed
+        never delay the in-SLO."""
         req = Request(
             prompt=[int(t) for t in prompt],
             max_new_tokens=int(max_new_tokens),
             eos_id=eos_id,
+            priority=int(priority),
+            t_deadline=(
+                None
+                if deadline_ms is None
+                else time.monotonic() + float(deadline_ms) / 1e3
+            ),
             t_submit=time.perf_counter(),
         )
+        if req.t_deadline is not None and float(deadline_ms) <= 0:
+            self.shed += 1
+            obs.count("serve_shed", reason="deadline")
+            req.finish("deadline exceeded")
+            return req
         if not req.prompt:
             self.rejected += 1
             req.finish("empty prompt")
@@ -234,27 +261,47 @@ class ContinuousBatcher:
                 req.finish(self.loop_error)
 
     def _sweep_cancelled(self) -> None:
-        """Retire cancelled requests: queued ones finish immediately,
-        active ones free their slot before the next decode step."""
+        """Retire cancelled and deadline-expired requests: queued ones
+        finish immediately, active ones free their slot before the next
+        decode step (a client past its deadline is gone — decoding its
+        remaining tokens only starves the in-SLO batch)."""
+        now = time.monotonic()
+
+        def expired(req: Request) -> bool:
+            return req.t_deadline is not None and now > req.t_deadline
+
         with self._cond:
-            if any(r.cancelled for r in self._queue):
+            if any(r.cancelled or expired(r) for r in self._queue):
                 keep: collections.deque = collections.deque()
                 for req in self._queue:
                     if req.cancelled:
                         self.cancelled += 1
                         req.finish("cancelled")
                         obs.count("serve_cancelled")
+                    elif expired(req):
+                        self.shed += 1
+                        req.finish("deadline exceeded")
+                        obs.count("serve_shed", reason="deadline")
                     else:
                         keep.append(req)
                 self._queue = keep
-        gone = [s for s, st in self._active.items() if st.req.cancelled]
+        gone = [
+            s
+            for s, st in self._active.items()
+            if st.req.cancelled or expired(st.req)
+        ]
         for slot in gone:
             st = self._active.pop(slot)
             self.slots.free(slot)
-            self.cancelled += 1
             st.req.epoch = self.engine.weights_epoch
-            st.req.finish("cancelled")
-            obs.count("serve_cancelled")
+            if st.req.cancelled:
+                self.cancelled += 1
+                st.req.finish("cancelled")
+                obs.count("serve_cancelled")
+            else:
+                self.shed += 1
+                st.req.finish("deadline exceeded")
+                obs.count("serve_shed", reason="deadline")
 
     def _find_prefix(self, prompt: list) -> tuple[Optional[int], int]:
         """Longest usable shared prompt prefix among the live slots.
@@ -279,13 +326,31 @@ class ContinuousBatcher:
             return best_src, best
         return None, 0
 
+    def _pop_next(self) -> Optional[Request]:
+        """Most urgent queued request: lowest priority tier first, then
+        earliest deadline (deadline-free requests after deadlined ones of
+        the same tier), then submit order. Linear scan — the queue is
+        bounded and admit runs once per freed slot."""
+        with self._cond:
+            if not self._queue:
+                return None
+            best = min(
+                self._queue,
+                key=lambda r: (
+                    r.priority,
+                    r.t_deadline if r.t_deadline is not None else float("inf"),
+                    r.id,
+                ),
+            )
+            self._queue.remove(best)
+            return best
+
     def _admit(self) -> bool:
         admitted = False
         while self.slots.num_free:
-            with self._cond:
-                if not self._queue:
-                    break
-                req = self._queue.popleft()
+            req = self._pop_next()
+            if req is None:
+                break
             slot = self.slots.alloc()
             src, plen = (
                 self._find_prefix(req.prompt)
@@ -393,11 +458,27 @@ class ContinuousBatcher:
         if error is None:
             self.completed += 1
             self._latencies.append(req.latency_s)
+            ewma = self._lat_ewma
+            self._lat_ewma = (
+                req.latency_s
+                if ewma is None
+                else 0.8 * ewma + 0.2 * req.latency_s
+            )
             if req.ttft_s is not None:
                 self._ttfts.append(req.ttft_s)
             obs.count("serve_requests_completed")
         else:
             self.failed += 1
+
+    def estimate_wait_s(self) -> float:
+        """Rough time a new request spends queued: queue length over slot
+        parallelism, paced by the completed-latency EWMA. Feeds the 503
+        Retry-After hint and the router's admission estimate — a hint,
+        not a promise."""
+        ewma = self._lat_ewma if self._lat_ewma is not None else 0.25
+        with self._cond:
+            depth = len(self._queue)
+        return (depth / max(1, self.slots.num_slots)) * ewma
 
     # -- metrics -----------------------------------------------------------
 
@@ -431,6 +512,28 @@ class ContinuousBatcher:
         with self._cond:
             obs.gauge("serve_queue_depth", len(self._queue))
 
+    def health(self) -> dict:
+        """Compact load vector for the fleet health plane (push replies,
+        overseer roll-ups, autoscaler): cheap enough to compute on every
+        push-channel reply."""
+        lat = np.asarray(self._latencies, np.float64)
+        with self._cond:
+            depth = len(self._queue)
+        return {
+            "queue_depth": depth,
+            "occupancy": round(
+                self.slots.num_active / self.slots.num_slots, 4
+            ),
+            "p99_ms": (
+                round(float(np.percentile(lat, 99)) * 1e3, 3)
+                if lat.size
+                else None
+            ),
+            "wait_estimate_s": round(self.estimate_wait_s(), 4),
+            "completed": self.completed,
+            "shed": self.shed,
+        }
+
     def stats(self) -> dict:
         """Point-in-time summary for the bench / health endpoint."""
         lat = np.asarray(self._latencies, np.float64) * 1e3
@@ -444,6 +547,7 @@ class ContinuousBatcher:
             "rejected": self.rejected,
             "failed": self.failed,
             "cancelled": self.cancelled,
+            "shed": self.shed,
             "queued": len(self._queue),
             "active": self.slots.num_active,
             "decode_steps": self.decode_steps,

@@ -35,27 +35,47 @@ log = logging.getLogger(__name__)
 
 _HTTP_VERBS = (b"GET ", b"POST", b"PUT ", b"HEAD", b"DELE", b"OPTI", b"PATC")
 
+# scheduler rejects that are the server's load, not the request's fault:
+# answered as structured 503 + Retry-After so clients back off cleanly
+_OVERLOAD_ERRORS = ("queue full", "deadline exceeded")
 
-def bind_with_fallback(host: str, port: int, what: str) -> socket.socket:
+
+def bind_with_fallback(
+    host: str, port: int, what: str, retry_s: float = 0.0
+) -> socket.socket:
     """Bind (host, port), falling back to an ephemeral port when the
     requested one is taken — a shared-process serving plane must never
-    take down training over a port clash."""
+    take down training over a port clash.
+
+    ``retry_s`` keeps retrying the EXPLICIT port with bounded backoff
+    before falling back: a replica respawned at its old address races the
+    dying process's listener teardown, and an ephemeral fallback there
+    would strand the router/manager dialing the address they know."""
     sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
     sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-    try:
-        sock.bind((host, port))
-    except OSError as e:
-        if port == 0:
-            sock.close()
-            raise
-        log.warning(
-            "%s port %d unavailable (%s); falling back to an ephemeral port",
-            what,
-            port,
-            e,
-        )
-        sock.bind((host, 0))
-    return sock
+    deadline = time.monotonic() + max(0.0, retry_s)
+    pause = 0.05
+    while True:
+        try:
+            sock.bind((host, port))
+            return sock
+        except OSError as e:
+            if port == 0:
+                sock.close()
+                raise
+            if time.monotonic() + pause <= deadline:
+                time.sleep(pause)
+                pause = min(pause * 2, 0.5)
+                continue
+            log.warning(
+                "%s port %d unavailable (%s); falling back to an "
+                "ephemeral port",
+                what,
+                port,
+                e,
+            )
+            sock.bind((host, 0))
+            return sock
 
 
 class ServeServer:
@@ -67,15 +87,17 @@ class ServeServer:
         port: int = 0,
         request_timeout: float = 300.0,
         identity: Optional[Union[dict, Callable[[], dict]]] = None,
+        bind_retry_s: float = 0.0,
     ):
         self.batcher = batcher
         self.request_timeout = float(request_timeout)
+        self.rejected_total = 0  # structured 503 rejects served
         # who this serving process is (worker/replica id, staleness, ...):
         # a dict, or a callable re-evaluated per request so dynamic fields
         # like staleness stay live. Folded into /healthz and /stats so a
         # fleet router (or odtp_top) can tell replicas apart.
         self._identity = identity
-        self._sock = bind_with_fallback(host, port, "serve")
+        self._sock = bind_with_fallback(host, port, "serve", bind_retry_s)
         self._sock.listen(32)
         self.host = host
         self.port = self._sock.getsockname()[1]
@@ -136,13 +158,22 @@ class ServeServer:
         except (OSError, ValueError):
             return True
 
+    def _retry_after_s(self) -> float:
+        """Backpressure hint for structured 503 rejects: the scheduler's
+        current queue-drain estimate, clamped to something a client can
+        reasonably sleep on."""
+        return round(min(30.0, max(0.1, self.batcher.estimate_wait_s())), 3)
+
     def _generate(
         self, payload: dict, conn: Optional[socket.socket] = None
     ) -> Optional[dict]:
+        deadline_ms = payload.get("deadline_ms")
         req = self.batcher.submit(
             payload.get("prompt") or [],
             max_new_tokens=int(payload.get("max_new_tokens", 16)),
             eos_id=payload.get("eos_id"),
+            priority=int(payload.get("priority", 0)),
+            deadline_ms=None if deadline_ms is None else float(deadline_ms),
         )
         # wait in slices, watching the client socket: a disconnect
         # mid-generation retires the slot immediately instead of decoding
@@ -164,6 +195,11 @@ class ServeServer:
         }
         if req.error is not None:
             out["error"] = req.error
+            if req.error in _OVERLOAD_ERRORS:
+                # structured backpressure: the client learns when to come
+                # back instead of watching its connection error out
+                out["retry_after_s"] = self._retry_after_s()
+                self.rejected_total += 1
         if payload.get("id") is not None:
             out["id"] = payload["id"]
         return out
@@ -197,7 +233,15 @@ class ServeServer:
                 return
             out = self._generate(payload, conn)
             if out is not None:
-                self._respond(conn, 400 if "error" in out else 200, out)
+                if out.get("error") in _OVERLOAD_ERRORS:
+                    self._respond(
+                        conn,
+                        503,
+                        out,
+                        headers={"Retry-After": str(out["retry_after_s"])},
+                    )
+                else:
+                    self._respond(conn, 400 if "error" in out else 200, out)
         elif method == b"GET" and path.startswith(b"/healthz"):
             self._respond(
                 conn,
@@ -212,6 +256,7 @@ class ServeServer:
             )
         elif method == b"GET" and path.startswith(b"/stats"):
             stats = self.batcher.stats()
+            stats["rejected_total"] = self.rejected_total
             ident = self.identity()
             if ident:
                 stats["identity"] = ident
@@ -219,14 +264,25 @@ class ServeServer:
         else:
             self._respond(conn, 404, {"error": "unknown route"})
 
-    def _respond(self, conn: socket.socket, status: int, obj: dict) -> None:
+    def _respond(
+        self,
+        conn: socket.socket,
+        status: int,
+        obj: dict,
+        headers: Optional[dict] = None,
+    ) -> None:
         body = (json.dumps(obj) + "\n").encode()
-        reason = {200: "OK", 400: "Bad Request", 404: "Not Found"}.get(
-            status, "Error"
-        )
+        reason = {
+            200: "OK",
+            400: "Bad Request",
+            404: "Not Found",
+            503: "Service Unavailable",
+        }.get(status, "Error")
+        extra = "".join(f"{k}: {v}\r\n" for k, v in (headers or {}).items())
         head = (
             f"HTTP/1.0 {status} {reason}\r\n"
             "Content-Type: application/json\r\n"
+            f"{extra}"
             f"Content-Length: {len(body)}\r\n\r\n"
         ).encode()
         conn.sendall(head + body)

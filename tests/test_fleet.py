@@ -620,3 +620,201 @@ def test_server_identity_on_health_and_stats(tiny_cfg):
     finally:
         srv.stop()
         batcher.stop()
+
+
+# ---------------------------------------------------------------------------
+# admission control + probe pacing (PR 17)
+# ---------------------------------------------------------------------------
+
+
+class HangingReplica:
+    """Accepts generate lines but never answers: requests pile up
+    in-flight until :meth:`kill` drops every connection at once — the
+    worst-case shape of a replica dying with multiple dispatches live."""
+
+    def __init__(self):
+        self.arrived = threading.Semaphore(0)
+        self._stop = threading.Event()
+        self._conns = set()
+        self._sock = socket.socket()
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind(("127.0.0.1", 0))
+        self._sock.listen(16)
+        self.port = self._sock.getsockname()[1]
+        threading.Thread(target=self._accept, daemon=True).start()
+
+    def _accept(self):
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            self._conns.add(conn)
+            threading.Thread(
+                target=self._serve, args=(conn,), daemon=True
+            ).start()
+
+    def _serve(self, conn):
+        try:
+            if conn.recv(65536):
+                self.arrived.release()
+            self._stop.wait()
+        except OSError:
+            pass
+
+    def kill(self):
+        self._stop.set()
+        for s in [self._sock, *list(self._conns)]:
+            try:
+                s.close()
+            except OSError:
+                pass
+
+
+def test_router_mark_dead_under_concurrent_dispatch():
+    """Two threads are in-flight on the same replica when it dies: both
+    must re-dispatch (zero drops), and the death is retired exactly once
+    — no double-counting, no double watchdog trip."""
+    hang = HangingReplica()
+    good = FakeReplica("b")
+    router = FleetRouter(port=0, probe_interval_s=30.0, request_timeout=10.0)
+    try:
+        router.add_replica("a", "127.0.0.1", hang.port)
+        outs = [None, None]
+
+        def go(i):
+            outs[i] = router.dispatch(
+                {"prompt": [1, 2, 3], "max_new_tokens": 3, "id": i}
+            )
+
+        threads = [
+            threading.Thread(target=go, args=(i,), daemon=True)
+            for i in range(2)
+        ]
+        for t in threads:
+            t.start()
+        # both requests are live on the doomed replica before it dies
+        assert hang.arrived.acquire(timeout=5.0)
+        assert hang.arrived.acquire(timeout=5.0)
+        router.add_replica("b", "127.0.0.1", good.port)
+        hang.kill()
+        for t in threads:
+            t.join(timeout=10.0)
+        assert all(o is not None and o.get("tokens") == [1, 2, 3]
+                   for o in outs)
+        assert sorted(o["id"] for o in outs) == [0, 1]
+        st = router.stats()
+        assert st["deaths"] == 1  # idempotent retire under the race
+        assert st["redispatches"] == 2
+        assert st["replicas"]["a"]["dead"]
+        assert good.served == 2
+    finally:
+        router.stop()
+        hang.kill()
+        good.kill()
+
+
+def test_mark_dead_idempotent_many_threads():
+    """_mark_dead from N racing threads counts one death."""
+    router = FleetRouter(port=0, probe_interval_s=30.0)
+    try:
+        router.add_replica("a", "127.0.0.1", 1)
+        b = router._backends["a"]
+        threads = [
+            threading.Thread(target=router._mark_dead, args=(b,))
+            for _ in range(8)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert router.stats()["deaths"] == 1
+    finally:
+        router.stop()
+
+
+def test_router_sheds_unmeetable_deadline():
+    """A request whose budget is provably below the fastest observed
+    dispatch is answered 'shed' at the edge — never queued to die."""
+    rep = FakeReplica("a")
+    router = FleetRouter(port=0, probe_interval_s=30.0, request_timeout=10.0)
+    try:
+        router.add_replica("a", "127.0.0.1", rep.port)
+        # warm the latency floor with successful dispatches
+        for i in range(3):
+            out = router.dispatch({"prompt": [1, 2, 3], "id": i})
+            assert out.get("tokens") == [1, 2, 3]
+        assert router._latency_floor_s() is not None
+        out = router.dispatch(
+            {"prompt": [1, 2, 3], "deadline_ms": 0, "id": 99}
+        )
+        assert out["error"] == "shed"
+        assert out["reason"] == "deadline unmeetable"
+        assert out["retry_after_s"] > 0 and out["id"] == 99
+        # a generous deadline sails through, with the remaining budget
+        # forwarded to the replica
+        out = router.dispatch({"prompt": [1, 2, 3], "deadline_ms": 60000})
+        assert out.get("tokens") == [1, 2, 3]
+        assert router.stats()["shed"] == 1
+    finally:
+        router.stop()
+        rep.kill()
+
+
+def test_router_http_shed_is_503_with_retry_after():
+    rep = FakeReplica("a")
+    router = FleetRouter(port=0, probe_interval_s=30.0, request_timeout=10.0)
+    try:
+        router.add_replica("a", "127.0.0.1", rep.port)
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{router.port}/generate",
+            data=json.dumps(
+                {"prompt": [1, 2, 3], "deadline_ms": 0}
+            ).encode(),
+            method="POST",
+        )
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=10)
+        assert ei.value.code == 503
+        assert float(ei.value.headers["Retry-After"]) > 0
+        body = json.loads(ei.value.read())
+        assert body["error"] == "shed"
+    finally:
+        router.stop()
+        rep.kill()
+
+
+def test_probe_backoff_doubles_jitters_and_snaps_back():
+    """Dead-backend probes back off exponentially to the cap with ±25%
+    jitter (no thundering herd on mass revive) and snap back to the base
+    interval the moment the replica answers."""
+    router = FleetRouter(port=0, probe_interval_s=1.0)
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()  # nothing listens here now
+    try:
+        router.add_replica("a", "127.0.0.1", port)
+        b = router._backends["a"]
+        assert b.probe_backoff == 1.0  # alive: base interval
+        cap = router.probe_backoff_cap_s
+        seen = []
+        for _ in range(6):
+            t0 = time.monotonic()
+            router._probe(b)  # connection refused -> dead
+            router._reschedule_probe(b)
+            seen.append(b.probe_backoff)
+            lo, hi = 0.75 * b.probe_backoff, 1.25 * b.probe_backoff
+            delay = b.probe_at - t0
+            assert lo - 0.05 <= delay <= hi + 0.05
+        assert seen == [2.0, 4.0, 8.0, cap, cap, cap]
+        # replica comes back on the same port: contact snaps the pace back
+        rep = FakeReplica("a", port=port)
+        try:
+            router._probe(b)
+            router._reschedule_probe(b)
+            assert not b.dead and b.probe_backoff == 1.0
+        finally:
+            rep.kill()
+    finally:
+        router.stop()

@@ -836,3 +836,122 @@ def test_build_serving_with_diloco_swaps_live(tiny_cfg):
     assert opt.epoch == 2
     assert plane.engine.swap_count >= 1
     assert plane.batcher.failed == 0
+
+
+# ---------------------------------------------------------------------------
+# admission control: priority tiers, deadlines, structured backpressure
+# ---------------------------------------------------------------------------
+
+
+def test_queue_orders_by_priority_then_deadline(tiny_cfg):
+    """_pop_next: lower tier first; within a tier, earliest deadline;
+    deadline-free requests after deadlined ones; submit order last."""
+    engine, _ = make_engine(tiny_cfg)
+    batcher = ContinuousBatcher(engine=engine)  # loop never started
+    r_bulk = batcher.submit([1, 2, 3], priority=1)
+    r_slow = batcher.submit([1, 2, 3], priority=0, deadline_ms=60000)
+    r_soon = batcher.submit([1, 2, 3], priority=0, deadline_ms=5000)
+    r_free = batcher.submit([1, 2, 3], priority=0)
+    order = [batcher._pop_next() for _ in range(4)]
+    assert order == [r_soon, r_slow, r_free, r_bulk]
+    assert batcher._pop_next() is None
+
+
+def test_submit_sheds_spent_deadline(tiny_cfg):
+    """deadline_ms <= 0 means the client's budget is already gone: shed
+    at submit, never queued, never decoded."""
+    engine, _ = make_engine(tiny_cfg)
+    batcher = ContinuousBatcher(engine=engine)
+    req = batcher.submit([1, 2, 3], deadline_ms=0)
+    assert req.wait(0) and req.error == "deadline exceeded"
+    assert batcher.shed == 1 and len(batcher._queue) == 0
+
+
+def test_sweep_sheds_expired_queued_request(tiny_cfg):
+    """A queued request whose deadline lapses is retired by the sweep
+    with 'deadline exceeded' — it never occupies a slot."""
+    engine, _ = make_engine(tiny_cfg)
+    batcher = ContinuousBatcher(engine=engine)
+    doomed = batcher.submit([1, 2, 3], deadline_ms=10)
+    safe = batcher.submit([1, 2, 3], deadline_ms=60000)
+    time.sleep(0.05)
+    batcher._sweep_cancelled()
+    assert doomed.wait(0) and doomed.error == "deadline exceeded"
+    assert not safe.wait(0)
+    assert batcher.shed == 1 and list(batcher._queue) == [safe]
+
+
+def test_health_vector_and_wait_estimate(tiny_cfg):
+    engine, _ = make_engine(tiny_cfg, num_slots=2)
+    batcher = ContinuousBatcher(engine=engine)
+    h = batcher.health()
+    assert h["queue_depth"] == 0 and h["p99_ms"] is None
+    assert h["occupancy"] == 0.0 and h["shed"] == 0
+    for _ in range(8):
+        batcher.submit([1, 2, 3])
+    # 8 queued over 2 slots at the 0.25s default EWMA -> 1s estimate
+    assert batcher.estimate_wait_s() == pytest.approx(1.0)
+    assert batcher.health()["queue_depth"] == 8
+
+
+def test_server_queue_full_is_structured_503(tiny_cfg):
+    """A full batcher queue answers HTTP 503 + Retry-After with a JSON
+    body carrying retry_after_s, and /stats counts the reject."""
+    engine, _ = make_engine(tiny_cfg)
+    batcher = ContinuousBatcher(engine=engine, max_queue=0)  # always full
+    srv = ServeServer(batcher, port=0)
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/generate",
+            data=json.dumps({"prompt": [1, 2, 3]}).encode(),
+            method="POST",
+        )
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=10)
+        assert ei.value.code == 503
+        assert float(ei.value.headers["Retry-After"]) >= 0.1
+        body = json.loads(ei.value.read())
+        assert body["error"] == "queue full"
+        assert body["retry_after_s"] >= 0.1
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/stats", timeout=10
+        ) as r:
+            stats = json.loads(r.read())
+        assert stats["rejected_total"] == 1
+    finally:
+        srv.stop()
+
+
+def test_bind_retry_takes_over_released_port():
+    """Satellite: a respawn at a known address retries the explicit bind
+    while the dying predecessor tears down, instead of falling back to
+    an ephemeral port nobody dials."""
+    from opendiloco_tpu.serve.server import bind_with_fallback
+
+    holder = socket.socket()
+    holder.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    holder.bind(("127.0.0.1", 0))
+    holder.listen(1)
+    port = holder.getsockname()[1]
+
+    threading.Timer(0.3, holder.close).start()
+    sock = bind_with_fallback("127.0.0.1", port, "test", retry_s=5.0)
+    try:
+        assert sock.getsockname()[1] == port  # same address, not ephemeral
+    finally:
+        sock.close()
+
+    # without retry budget the old behavior stands: immediate fallback
+    holder2 = socket.socket()
+    holder2.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    holder2.bind(("127.0.0.1", 0))
+    holder2.listen(1)
+    port2 = holder2.getsockname()[1]
+    try:
+        sock2 = bind_with_fallback("127.0.0.1", port2, "test", retry_s=0.0)
+        try:
+            assert sock2.getsockname()[1] != port2
+        finally:
+            sock2.close()
+    finally:
+        holder2.close()
