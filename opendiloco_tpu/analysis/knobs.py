@@ -178,6 +178,17 @@ KNOBS: tuple[Knob, ...] = (
     Knob("ODTP_OBS_PROM_PORT", "int", "", "obs",
          "Serve Prometheus 0.0.4 text at `:PORT/metrics`.",
          doc_default="no endpoint"),
+    Knob("ODTP_REQTRACE_CAP", "int", "256", "obs",
+         "Completed request traces kept per process in the reqtrace ring "
+         "(oldest evicted); inflight traces are unbounded by this."),
+    Knob("ODTP_REQTRACE_EXPORT", "path", "", "obs",
+         "Write the reqtrace ring (report + full traces) here at exit; "
+         "unset falls back to `ODTP_OBS_DIR/reqtrace-<worker>-<pid>.json` "
+         "when a dir is set.", doc_default="no export"),
+    Knob("ODTP_REQTRACE_SAMPLE", "float", "1.0", "obs",
+         "Fraction of requests traced at the minting edge (deterministic "
+         "1-in-N thinning); adopted upstream contexts are always "
+         "honored."),
     Knob("ODTP_ROOFLINE", "path", "", "obs",
          "Path override for the banked roofline JSON backing MFU gauges.",
          doc_default="auto-discover"),

@@ -113,6 +113,59 @@ FLEET_DELTA_META_FIELDS = (
 )
 FLEET_LEAF_META_FIELDS = ("i", "shape", "off", "len", "meta")
 
+# -- per-request trace context ------------------------------------------------
+#
+# A request entering the serving plane (router or server edge) may carry a
+# compact trace context as an OPTIONAL top-level field of its JSON payload
+# (HTTP body and JSONL line alike). The field is additive on the existing
+# wire: peers that predate it ignore unknown payload fields, so a mixed
+# fleet interoperates unchanged — the same version-gating posture as the
+# wire v2 meta fields above. The context is a flat dict:
+#
+#   {"id": trace id (string, globally unique), "o": origin worker/router}
+#
+# Each hop that records spans for the request keys them by "id" in its own
+# process-local request-trace ring (obs/reqtrace.py); cross-process merge
+# happens offline (scripts/obs_report.py --reqtrace) by trace id.
+#
+# "reqtrace" is a pull frame on the control plane: the training worker's
+# control port (diloco/tcp.py) and the replica push port (fleet/replica.py)
+# both answer it with an "ok" frame whose meta carries the local ring's
+# snapshot (per-stage p50/p99 decomposition + inflight/recent traces).
+# Old peers answer "error" for the unknown kind; pollers treat that as
+# "no reqtrace plane" rather than a failure.
+
+TRACE_CTX_KEY = "trace"
+TRACE_CTX_FIELDS = ("id", "o")
+REQTRACE_FRAME_KIND = "reqtrace"
+
+# Canonical stage names a request's spans may use, in causal order across
+# the serving path. Reports and the odtp_top --requests columns key on
+# these; free-form attrs ride each span's "attrs" dict.
+#
+#   admit       router edge: parse + admission control + candidate choice
+#   shed        terminal: rejected at the edge or swept past its deadline
+#   forward     one router->replica dispatch round trip (attrs: replica)
+#   redispatch  zero-width: the previous forward's replica died mid-flight
+#   queue       replica scheduler: submit -> slot admission wait
+#   prefill     engine prompt prefill (attrs: bucket, tokens)
+#   decode      one batched decode step touching this request (attrs:
+#               batch occupancy; spec path adds proposed/accepted)
+#   swap        weight hot-swap pause overlapping this request
+#   retire      terminal: slot retired (done / failed / cancelled)
+
+REQTRACE_STAGES = (
+    "admit",
+    "shed",
+    "forward",
+    "redispatch",
+    "queue",
+    "prefill",
+    "decode",
+    "swap",
+    "retire",
+)
+
 # -- codec wire-record geometry ----------------------------------------------
 #
 # chunk_align: chunk element offsets must be multiples of this (blockwise

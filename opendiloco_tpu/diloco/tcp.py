@@ -725,6 +725,25 @@ class TcpBackend(OuterBackend):
                         writer, "ok",
                         {"matrix": ov.matrix() if ov is not None else {}},
                     )
+                elif msg == "reqtrace":
+                    # this process's request-trace ring snapshot, for
+                    # odtp_top --requests and the tail-latency report
+                    # (None when ODTP_OBS is unset); old peers answer
+                    # "error" for the unknown kind — callers treat both
+                    # as "no reqtrace plane here"
+                    rt = obs.reqtrace.ring()
+                    await send_frame(
+                        writer, "ok",
+                        {
+                            "reqtrace": (
+                                rt.snapshot(
+                                    recent=int(meta.get("recent", 32))
+                                )
+                                if rt is not None
+                                else None
+                            )
+                        },
+                    )
                 elif msg == "async_offer":
                     # bounded-staleness matchmaking (async gossip): claim
                     # our standing offer for the sender if compatible;

@@ -171,6 +171,29 @@ class FleetAutoscaler:
             if self.manager.spare_ready(rid)
         ]
 
+    def _breach_evidence(self, active: list) -> tuple:
+        """(worst-p99 replica, exemplar trace ids) behind a breach.
+
+        Exemplars are the breaching replicas' slowest recent request
+        traces (``slo_exemplars`` riding their health rows), worst
+        replica first, falling back to this process's own reqtrace ring
+        (in-process fleets share one ring) — so every scale-up decision
+        names requests whose traces show *where* the latency went."""
+        matrix = self.manager.health_matrix()
+        rows = [(rid, matrix.get(rid) or {}) for rid in active]
+        rows.sort(key=lambda kv: -(kv[1].get("p99_ms") or 0.0))
+        worst = rows[0][0] if rows else ""
+        exemplars: list = []
+        for _, row in rows:
+            for tid in row.get("slo_exemplars") or []:
+                if tid not in exemplars:
+                    exemplars.append(tid)
+        if not exemplars:
+            rt = obs.reqtrace.ring()
+            if rt is not None:
+                exemplars = [ex["id"] for ex in rt.exemplars()]
+        return worst, exemplars[:5]
+
     # -- act -----------------------------------------------------------------
 
     def _record(self, action: str, **detail) -> dict:
@@ -296,6 +319,23 @@ class FleetAutoscaler:
         self._up_streak = self._up_streak + 1 if breach else 0
         self._down_streak = self._down_streak + 1 if clear else 0
 
+        exemplars: list = []
+        if breach:
+            worst, exemplars = self._breach_evidence(active)
+            if (
+                self.slo_p99_ms > 0
+                and p99 is not None
+                and p99 > self.slo_p99_ms
+            ):
+                wd = obs.anomaly.watchdog()
+                if wd is not None:
+                    # the breach record carries the offending trace ids:
+                    # a p99 alarm resolves to actual request timelines
+                    wd.slo_breach(
+                        p99, self.slo_p99_ms, subject=worst,
+                        exemplars=exemplars,
+                    )
+
         now = time.monotonic()
         cooled = now - self._last_scale >= self.cooldown_s
         with self._lock:
@@ -312,7 +352,7 @@ class FleetAutoscaler:
                 self._up_streak = 0
                 self._record(
                     "scale_up", p99_ms=p99, queue_depth=depth,
-                    replicas=len(active) + 1, **sub,
+                    replicas=len(active) + 1, exemplars=exemplars, **sub,
                 )
         elif (
             clear

@@ -109,6 +109,9 @@ class Replica:
         tr = obs.tracer()
         if tr is not None:
             tr.set_identity(worker=self.replica_id, role="fleet-replica")
+        rt = obs.reqtrace.ring()
+        if rt is not None:
+            rt.set_identity(self.replica_id)
         self._stop = threading.Event()
         self._push_sock: Optional[socket.socket] = None
         self.push_port = 0
@@ -257,6 +260,23 @@ class Replica:
                             "replica": self.replica_id,
                             "epoch": self._epoch,
                             "weights_epoch": self.engine.weights_epoch,
+                        }
+                    elif kind == "reqtrace":
+                        # request-trace pull: snapshot of this replica's
+                        # ring (odtp_top --requests, obs_report merge).
+                        # Empty when the plane is unarmed; old peers that
+                        # predate the frame kind answer "error", which
+                        # callers treat as "no reqtrace plane".
+                        rt = obs.reqtrace.ring()
+                        reply = {
+                            "replica": self.replica_id,
+                            "reqtrace": (
+                                rt.snapshot(
+                                    recent=int(meta.get("recent", 32))
+                                )
+                                if rt is not None
+                                else None
+                            ),
                         }
                     else:
                         epoch = self.apply(meta, payload)

@@ -33,6 +33,7 @@ import time
 from typing import Optional
 
 from opendiloco_tpu import obs
+from opendiloco_tpu.obs import reqtrace
 from opendiloco_tpu.serve.kvcache import common_prefix_len
 
 log = logging.getLogger(__name__)
@@ -265,6 +266,22 @@ class FleetRouter:
         return out
 
     def dispatch(self, payload: dict) -> dict:
+        # trace context: adopt one minted upstream, else mint at this edge
+        # (the sampler may decline). The SAME context rides every forward
+        # attempt — a replica SIGKILL mid-flight re-dispatches the request
+        # with its history intact, so one request yields ONE trace
+        # spanning both replicas instead of losing the first leg.
+        rt = reqtrace.ring()
+        tid = None
+        if rt is not None:
+            ctx = reqtrace.ctx_of(payload)
+            if ctx is not None:
+                tid = rt.adopt(ctx, at="router")
+            else:
+                ctx = rt.mint(at="router", req_id=payload.get("id"))
+                tid = ctx["id"] if ctx else None
+            payload = reqtrace.attach(payload, ctx)
+        t_admit = time.perf_counter()
         prompt = [int(t) for t in payload.get("prompt") or []]
         deadline_ms = payload.get("deadline_ms")
         t_deadline = None
@@ -275,7 +292,9 @@ class FleetRouter:
             if deadline_ms <= 0.0 or (
                 floor is not None and deadline_ms / 1e3 < 0.9 * floor
             ):
-                return self._shed(payload, "deadline unmeetable")
+                return self._traced_shed(
+                    payload, "deadline unmeetable", rt, tid
+                )
         tried: set = set()
         last_error = "no live replicas"
         with self._lock:
@@ -284,7 +303,9 @@ class FleetRouter:
             if t_deadline is not None:
                 remaining = t_deadline - time.monotonic()
                 if remaining <= 0:
-                    return self._shed(payload, "deadline exhausted")
+                    return self._traced_shed(
+                        payload, "deadline exhausted", rt, tid
+                    )
                 # the replica sees what budget is LEFT, not what the
                 # client started with — its scheduler sheds the doomed
                 payload = {
@@ -293,8 +314,15 @@ class FleetRouter:
             b = self._pick(prompt, tried)
             if b is None:
                 break
+            if rt is not None and tid is not None:
+                rt.span(
+                    tid, "admit", t_admit, time.perf_counter(),
+                    replica=b.rid, candidates=len(self._backends) - len(tried),
+                    prompt_tokens=len(prompt),
+                )
             b.inflight += 1
             t0 = time.monotonic()
+            tf0 = time.perf_counter()
             try:
                 out = self._forward(b, payload)
             except (OSError, ValueError) as e:
@@ -303,26 +331,65 @@ class FleetRouter:
                 self._mark_dead(b)
                 self.redispatches += 1
                 obs.count("fleet_router_redispatch", replica=b.rid)
+                if rt is not None and tid is not None:
+                    rt.span(tid, "forward", tf0, time.perf_counter(),
+                            replica=b.rid, error=str(e))
+                    rt.event(tid, "redispatch", from_replica=b.rid,
+                             cause="connection")
+                t_admit = time.perf_counter()  # re-admission for the retry
                 continue
             finally:
                 b.inflight -= 1
             if out.get("error") == "deadline exceeded":
-                return self._shed(payload, "deadline exceeded")
+                if rt is not None and tid is not None:
+                    rt.span(tid, "forward", tf0, time.perf_counter(),
+                            replica=b.rid, error="deadline exceeded")
+                return self._traced_shed(
+                    payload, "deadline exceeded", rt, tid
+                )
             if out.get("error") in _RETRYABLE:
                 last_error = f"replica {b.rid}: {out['error']}"
                 tried.add(b.rid)
                 self.redispatches += 1
                 obs.count("fleet_router_redispatch", replica=b.rid)
+                if rt is not None and tid is not None:
+                    rt.span(tid, "forward", tf0, time.perf_counter(),
+                            replica=b.rid, error=out["error"])
+                    rt.event(tid, "redispatch", from_replica=b.rid,
+                             cause=out["error"])
+                t_admit = time.perf_counter()
                 continue
             if "error" not in out:
                 self._done_lat.append(time.monotonic() - t0)
             b.dispatched += 1
             b.recent.append(prompt)
             obs.count("fleet_router_dispatch", replica=b.rid)
+            if rt is not None and tid is not None:
+                rt.span(tid, "forward", tf0, time.perf_counter(),
+                        replica=b.rid)
+                rt.finish(
+                    tid,
+                    "failed" if "error" in out else "done",
+                    replica=b.rid,
+                    tokens=len(out.get("tokens") or []),
+                    redispatches=len(tried),
+                )
             return out
         out = {"error": last_error}
         if payload.get("id") is not None:
             out["id"] = payload["id"]
+        if rt is not None and tid is not None:
+            rt.finish(tid, "failed", error=last_error,
+                      redispatches=len(tried))
+        return out
+
+    def _traced_shed(
+        self, payload: dict, reason: str, rt, tid
+    ) -> dict:
+        out = self._shed(payload, reason)
+        if rt is not None and tid is not None:
+            rt.event(tid, "shed", reason=reason)
+            rt.finish(tid, "shed", reason=reason)
         return out
 
     def _mark_dead(self, b: _Backend) -> None:

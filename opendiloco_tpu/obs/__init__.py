@@ -26,15 +26,23 @@ from opendiloco_tpu.obs.trace import (  # noqa: F401
     span,
     tracer,
 )
-from opendiloco_tpu.obs import anomaly, blackbox, export, mfu, overseer  # noqa: F401
+from opendiloco_tpu.obs import (  # noqa: F401
+    anomaly,
+    blackbox,
+    export,
+    mfu,
+    overseer,
+    reqtrace,
+)
 from opendiloco_tpu.obs import trace as _trace
 
 
 def reset() -> None:
     """Drop every cached obs singleton (tests / env changes): tracer,
-    flight recorder, overseer, and watchdogs."""
+    flight recorder, request-trace ring, overseer, and watchdogs."""
     anomaly.reset()
     blackbox.reset()
+    reqtrace.reset()
     overseer.reset()
     _trace.reset()
 
@@ -50,6 +58,7 @@ __all__ = [
     "gauge",
     "mfu",
     "overseer",
+    "reqtrace",
     "reset",
     "span",
     "tracer",

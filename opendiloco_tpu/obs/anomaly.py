@@ -18,7 +18,10 @@ Four detectors, each sourced from telemetry that already exists:
   front-runner by more than the window: it can no longer be matched, so
   its progress stops mixing into the galaxy;
 - **serve staleness breach** — the serving plane's adopted snapshot is
-  older than its own ``max_stale_rounds`` bound.
+  older than its own ``max_stale_rounds`` bound;
+- **SLO breach** — a serving replica's measured request p99 crossed the
+  fleet's declared SLO; the trip carries exemplar request-trace IDs
+  (obs/reqtrace.py) naming the offending requests.
 
 Every trip emits an ``odtp_anomaly_<kind>`` counter, an
 ``anomaly/<kind>`` instant span, and a flight-recorder dump — and
@@ -261,13 +264,36 @@ class Watchdog:
                     # again before it can be declared dead a second time
                     self._grouped.discard(pid)
 
-    def serve_staleness(self, staleness: float, bound: float) -> None:
-        """Serving-plane hook: adopted-snapshot staleness vs its bound."""
+    def serve_staleness(
+        self, staleness: float, bound: float, exemplars: Any = ()
+    ) -> None:
+        """Serving-plane hook: adopted-snapshot staleness vs its bound.
+        ``exemplars`` names recent request-trace IDs served while stale,
+        so the anomaly record points at reviewable evidence."""
         if bound > 0 and staleness > bound:
             self._trip(
                 "serve_staleness", staleness=round(float(staleness), 3),
-                bound=float(bound),
+                bound=float(bound), exemplars=list(exemplars),
             )
+
+    def slo_breach(
+        self,
+        p99_ms: float,
+        bound_ms: float,
+        subject: str = "",
+        exemplars: Any = (),
+    ) -> bool:
+        """Serving-fleet hook: measured request p99 crossed the declared
+        SLO. ``exemplars`` carries the offending trace IDs (reqtrace
+        ring exemplars) so every breach — and the scale-up it triggers —
+        is explainable from recorded evidence."""
+        if bound_ms > 0 and p99_ms > bound_ms:
+            return self._trip(
+                "slo_breach", subject=subject,
+                p99_ms=round(float(p99_ms), 3), bound_ms=float(bound_ms),
+                exemplars=list(exemplars),
+            )
+        return False
 
     def fleet_replica_dead(self, replica_id: str) -> bool:
         """Fleet-router hook: a serving replica stopped answering. Same

@@ -183,6 +183,15 @@ class FlightRecorder:
                 galaxy = ov.matrix()
         except Exception:
             pass
+        reqtrace_report: dict = {}
+        try:
+            from opendiloco_tpu.obs import reqtrace as _reqtrace
+
+            rt = _reqtrace.ring()
+            if rt is not None:
+                reqtrace_report = rt.report()
+        except Exception:
+            pass
         with self._lock:
             self.dumps += 1
             self._last_dump = time.monotonic()
@@ -205,6 +214,7 @@ class FlightRecorder:
                 "decisions": list(self.decisions),
                 "metrics": self._flat_metrics(),
                 "galaxy": galaxy,
+                "reqtrace": reqtrace_report,
             }
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         tmp = f"{path}.tmp.{self.pid}"

@@ -22,8 +22,13 @@ from typing import Optional, Sequence
 import numpy as np
 
 from opendiloco_tpu import obs
+from opendiloco_tpu.obs import reqtrace
 from opendiloco_tpu.serve.engine import ServeEngine
-from opendiloco_tpu.serve.kvcache import SlotAllocator, common_prefix_len
+from opendiloco_tpu.serve.kvcache import (
+    SlotAllocator,
+    common_prefix_len,
+    pick_bucket,
+)
 
 # a reused prefix must be worth the copy: below this many shared tokens
 # the batcher prefills cold (the suffix pass would cover ~the whole
@@ -49,6 +54,8 @@ class Request:
     error: Optional[str] = None
     epoch: Optional[int] = None  # weights epoch that finished the request
     cancelled: bool = False
+    # request-trace id in this process's reqtrace ring (None = untraced)
+    trace: Optional[str] = None
     _done: threading.Event = dataclasses.field(default_factory=threading.Event)
 
     def wait(self, timeout: Optional[float] = None) -> bool:
@@ -105,6 +112,7 @@ class ContinuousBatcher:
         self._thread: Optional[threading.Thread] = None
         self._next_id = 0
         self.decode_steps = 0
+        self._t_step_end: Optional[float] = None
         # stats (mutated only by the loop thread; read racily for gauges)
         self.completed = 0
         self.rejected = 0
@@ -136,6 +144,7 @@ class ContinuousBatcher:
         eos_id: Optional[int] = None,
         priority: int = 0,
         deadline_ms: Optional[float] = None,
+        trace: Optional[dict] = None,
     ) -> Request:
         """Queue a prompt; returns a Request whose ``wait()`` unblocks when
         generation completes (or it was rejected — check ``error``).
@@ -143,7 +152,12 @@ class ContinuousBatcher:
         ``deadline_ms`` is the remaining client budget: the scheduler
         orders the queue by (priority, deadline) and sheds a request
         whose deadline expires before it reaches a slot — the doomed
-        never delay the in-SLO."""
+        never delay the in-SLO.
+
+        ``trace`` is an optional request-trace context (schema
+        TRACE_CTX_KEY shape) adopted into this process's reqtrace ring;
+        every lifecycle stage the request passes — queue wait, prefill,
+        decode steps, swaps, terminal — is recorded under it."""
         req = Request(
             prompt=[int(t) for t in prompt],
             max_new_tokens=int(max_new_tokens),
@@ -156,39 +170,63 @@ class ContinuousBatcher:
             ),
             t_submit=time.perf_counter(),
         )
+        rt = reqtrace.ring()
+        if rt is not None and trace is not None:
+            req.trace = rt.adopt(
+                trace, priority=req.priority, deadline_ms=deadline_ms
+            )
         if req.t_deadline is not None and float(deadline_ms) <= 0:
             self.shed += 1
             obs.count("serve_shed", reason="deadline")
             req.finish("deadline exceeded")
+            self._trace_terminal(req, "shed", "shed", reason="deadline")
             return req
         if not req.prompt:
             self.rejected += 1
             req.finish("empty prompt")
+            self._trace_terminal(req, "retire", "failed", error=req.error)
             return req
         if not self.engine.prompt_fits(len(req.prompt)):
             self.rejected += 1
             req.finish(
                 f"prompt length {len(req.prompt)} exceeds max prefill bucket"
             )
+            self._trace_terminal(req, "retire", "failed", error=req.error)
             return req
         if req.max_new_tokens < 1:
             self.rejected += 1
             req.finish("max_new_tokens must be >= 1")
+            self._trace_terminal(req, "retire", "failed", error=req.error)
             return req
         with self._cond:
             if self._stop.is_set():
                 self.rejected += 1
                 req.finish("server stopped")
+                self._trace_terminal(req, "shed", "shed", reason="stopped")
                 return req
             if len(self._queue) >= self.max_queue:
                 self.rejected += 1
                 req.finish("queue full")
+                self._trace_terminal(req, "shed", "shed", reason="queue_full")
                 return req
             req.id = self._next_id
             self._next_id += 1
             self._queue.append(req)
             self._cond.notify()
         return req
+
+    @staticmethod
+    def _trace_terminal(
+        req: Request, stage: str, status: str, **attrs
+    ) -> None:
+        """Close ``req``'s trace with a zero-width terminal stage event."""
+        if req.trace is None:
+            return
+        rt = reqtrace.ring()
+        if rt is None:
+            return
+        rt.event(req.trace, stage, **attrs)
+        rt.finish(req.trace, status, tokens=len(req.tokens), **attrs)
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -212,9 +250,11 @@ class ContinuousBatcher:
         for req in pending:
             self.failed += 1
             req.finish("server stopped")
+            self._trace_terminal(req, "retire", "failed", error=req.error)
         for st in self._active.values():
             self.failed += 1
             st.req.finish("server stopped")
+            self._trace_terminal(st.req, "retire", "failed", error=st.req.error)
         self._active.clear()
 
     def drain(self, timeout: float = 60.0) -> bool:
@@ -231,19 +271,28 @@ class ContinuousBatcher:
 
     def _run(self) -> None:
         try:
+            t_carry = None
             while not self._stop.is_set():
+                # consecutive decode spans TILE: each starts where the
+                # previous iteration's accounting ended, so everything an
+                # inflight request sat through this iteration — sweeps,
+                # queue checks, a co-tenant's admission prefill, retires,
+                # gauges — is attributed to its decode residency and a
+                # trace's stage sums reconcile with its e2e latency
+                it0 = t_carry if t_carry is not None else time.perf_counter()
                 self._sweep_cancelled()
                 admitted = self._admit()
-                stepped = self._decode()
+                stepped = self._decode(it0)
                 if stepped:
                     self.decode_steps += 1
                     if self.decode_steps % self.swap_every_steps == 0:
-                        self.engine.maybe_swap()
+                        self._maybe_swap()
                     if self.decode_steps % self.gauge_every_steps == 0:
                         self._publish_gauges()
+                t_carry = self._t_step_end if stepped else None
                 if not admitted and not stepped:
                     # idle: still honor the staleness bound, then sleep
-                    self.engine.maybe_swap()
+                    self._maybe_swap()
                     with self._cond:
                         if not self._queue and not self._stop.is_set():
                             self._cond.wait(timeout=0.05)
@@ -259,6 +308,26 @@ class ContinuousBatcher:
             for req in pending:
                 self.failed += 1
                 req.finish(self.loop_error)
+                self._trace_terminal(req, "retire", "failed", error=req.error)
+
+    def _maybe_swap(self) -> None:
+        """Hot-swap check; a swap that actually happened is a pause every
+        in-flight request sat through, so its duration is recorded as a
+        ``swap`` span on every traced active request."""
+        t0 = time.perf_counter()
+        swapped = self.engine.maybe_swap()
+        t1 = time.perf_counter()
+        if not swapped:
+            return
+        rt = reqtrace.ring()
+        if rt is None:
+            return
+        for st in self._active.values():
+            if st.req.trace is not None:
+                rt.span(
+                    st.req.trace, "swap", t0, t1,
+                    epoch=self.engine.weights_epoch,
+                )
 
     def _sweep_cancelled(self) -> None:
         """Retire cancelled and deadline-expired requests: queued ones
@@ -278,10 +347,14 @@ class ContinuousBatcher:
                         self.cancelled += 1
                         req.finish("cancelled")
                         obs.count("serve_cancelled")
+                        self._trace_terminal(req, "retire", "cancelled")
                     elif expired(req):
                         self.shed += 1
                         req.finish("deadline exceeded")
                         obs.count("serve_shed", reason="deadline")
+                        self._trace_terminal(
+                            req, "shed", "shed", reason="deadline"
+                        )
                     else:
                         keep.append(req)
                 self._queue = keep
@@ -298,10 +371,12 @@ class ContinuousBatcher:
                 self.cancelled += 1
                 st.req.finish("cancelled")
                 obs.count("serve_cancelled")
+                self._trace_terminal(st.req, "retire", "cancelled")
             else:
                 self.shed += 1
                 st.req.finish("deadline exceeded")
                 obs.count("serve_shed", reason="deadline")
+                self._trace_terminal(st.req, "shed", "shed", reason="deadline")
 
     def _find_prefix(self, prompt: list) -> tuple[Optional[int], int]:
         """Longest usable shared prompt prefix among the live slots.
@@ -346,12 +421,14 @@ class ContinuousBatcher:
             return best
 
     def _admit(self) -> bool:
+        rt = reqtrace.ring()
         admitted = False
         while self.slots.num_free:
             req = self._pop_next()
             if req is None:
                 break
             slot = self.slots.alloc()
+            t_slot = time.perf_counter()
             src, plen = (
                 self._find_prefix(req.prompt)
                 if self.prefix_cache
@@ -368,6 +445,16 @@ class ContinuousBatcher:
             else:
                 tok, _ = self.engine.admit(slot, req.prompt)
             req.t_first = time.perf_counter()
+            if rt is not None and req.trace is not None:
+                rt.span(
+                    req.trace, "queue", req.t_submit, t_slot, slot=slot
+                )
+                rt.span(
+                    req.trace, "prefill", t_slot, req.t_first,
+                    tokens=len(req.prompt),
+                    bucket=pick_bucket(len(req.prompt), self.engine.prefill_buckets),
+                    prefix_reused=plen,
+                )
             req.tokens.append(tok)
             st = _Slot(req=req, cache_len=len(req.prompt), last_token=tok)
             if self._finished(st):
@@ -378,12 +465,18 @@ class ContinuousBatcher:
             admitted = True
         return admitted
 
-    def _decode(self) -> bool:
+    def _decode(self, t0: Optional[float] = None) -> bool:
         if not self._active:
             return False
         if self.spec_decode:
-            return self._decode_spec()
+            return self._decode_spec(t0)
         S = self.engine.num_slots
+        # the decode span covers the WHOLE step — batch assembly, the
+        # engine call, and token emit — so per-step scheduler time is
+        # attributed to the requests it served, and a trace's stage sums
+        # reconcile with its end-to-end latency
+        if t0 is None:
+            t0 = time.perf_counter()
         tokens = np.zeros((S,), np.int32)
         lens = np.zeros((S,), np.int32)
         for slot, st in self._active.items():
@@ -392,6 +485,7 @@ class ContinuousBatcher:
         next_tokens, _ = self.engine.decode_step(tokens, lens)
         self.staleness_hist[self.engine.staleness()] += 1
         obs.count("serve_tokens_generated", len(self._active))
+        batch = len(self._active)
         done_slots = []
         for slot, st in self._active.items():
             tok = int(next_tokens[slot])
@@ -401,17 +495,35 @@ class ContinuousBatcher:
             self.total_new_tokens += 1
             if self._finished(st):
                 done_slots.append(slot)
+        # the next iteration's window starts HERE, so span recording,
+        # retires, and swap/gauge checks below are attributed to the
+        # step that pays for them
+        t1 = self._t_step_end = time.perf_counter()
+        rt = reqtrace.ring()
+        if rt is not None:
+            for st in self._active.values():
+                if st.req.trace is not None:
+                    # a just-admitted slot's window starts where its own
+                    # prefill ended, never before (no self double-count)
+                    rt.span(
+                        st.req.trace, "decode", max(t0, st.req.t_first), t1,
+                        batch=batch, tokens=1,
+                    )
         for slot in done_slots:
             self.slots.free(slot)
             self._retire(self._active.pop(slot))
         return True
 
-    def _decode_spec(self) -> bool:
+    def _decode_spec(self, t0: Optional[float] = None) -> bool:
         """One speculative round: every live slot consumes its accepted
         prefix + the corrected token, so a single engine call advances a
         slot by 1..k+1 tokens — token-for-token what k+1 plain decode
         steps would have produced (engine.spec_step docstring)."""
         S = self.engine.num_slots
+        # span covers the whole round (assembly + engine + emit) — see
+        # the plain _decode comment
+        if t0 is None:
+            t0 = time.perf_counter()
         tokens = np.zeros((S,), np.int32)
         lens = np.zeros((S,), np.int32)
         for slot, st in self._active.items():
@@ -425,18 +537,33 @@ class ContinuousBatcher:
         self.spec_accepted += accepted
         obs.count("serve_spec_proposed", proposed)
         obs.count("serve_spec_accepted", accepted)
+        batch = len(self._active)
         done_slots = []
         emitted = 0
+        emitted_by_slot = {}
         for slot, st in self._active.items():
+            slot_emitted = 0
             for tok in g[slot, : int(m[slot]) + 1].tolist():
                 st.req.tokens.append(int(tok))
                 st.cache_len += 1
                 st.last_token = int(tok)
                 self.total_new_tokens += 1
                 emitted += 1
+                slot_emitted += 1
                 if self._finished(st):
                     done_slots.append(slot)
                     break
+            emitted_by_slot[slot] = slot_emitted
+        t1 = self._t_step_end = time.perf_counter()
+        rt = reqtrace.ring()
+        if rt is not None:
+            for slot, st in self._active.items():
+                if st.req.trace is not None:
+                    rt.span(
+                        st.req.trace, "decode", max(t0, st.req.t_first), t1,
+                        batch=batch, tokens=emitted_by_slot[slot],
+                        proposed=self.engine.spec_k, accepted=int(m[slot]),
+                    )
         obs.count("serve_tokens_generated", emitted)
         for slot in done_slots:
             self.slots.free(slot)
@@ -455,6 +582,13 @@ class ContinuousBatcher:
             req.tokens.pop()  # eos terminates, is not part of the text
         req.epoch = self.engine.weights_epoch
         req.finish(error)
+        self._trace_terminal(
+            req,
+            "retire",
+            "done" if error is None else "failed",
+            epoch=req.epoch,
+            **({} if error is None else {"error": error}),
+        )
         if error is None:
             self.completed += 1
             self._latencies.append(req.latency_s)
@@ -504,13 +638,28 @@ class ContinuousBatcher:
             # a breach here means maybe_swap() could NOT restore the bound
             # (e.g. the trainer stalled and no fresh snapshot exists): the
             # watchdog records it, serving continues on the stale snapshot
-            wd.serve_staleness(staleness, self.engine.max_stale_rounds)
+            wd.serve_staleness(
+                staleness,
+                self.engine.max_stale_rounds,
+                exemplars=self._slo_exemplars(),
+            )
         if self.spec_proposed:
             obs.gauge(
                 "serve_spec_acceptance", self.spec_accepted / self.spec_proposed
             )
         with self._cond:
             obs.gauge("serve_queue_depth", len(self._queue))
+
+    @staticmethod
+    def _slo_exemplars(n: int = 3) -> list:
+        """Trace ids of the slowest recently completed requests in this
+        process's reqtrace ring — the evidence attached to staleness /
+        SLO-breach watchdog trips and fleet health rows so a breach
+        names the requests that caused it."""
+        rt = reqtrace.ring()
+        if rt is None:
+            return []
+        return [ex["id"] for ex in rt.exemplars(n)]
 
     def health(self) -> dict:
         """Compact load vector for the fleet health plane (push replies,
@@ -519,7 +668,7 @@ class ContinuousBatcher:
         lat = np.asarray(self._latencies, np.float64)
         with self._cond:
             depth = len(self._queue)
-        return {
+        out = {
             "queue_depth": depth,
             "occupancy": round(
                 self.slots.num_active / self.slots.num_slots, 4
@@ -533,6 +682,10 @@ class ContinuousBatcher:
             "completed": self.completed,
             "shed": self.shed,
         }
+        exemplars = self._slo_exemplars()
+        if exemplars:
+            out["slo_exemplars"] = exemplars
+        return out
 
     def stats(self) -> dict:
         """Point-in-time summary for the bench / health endpoint."""

@@ -29,6 +29,7 @@ import threading
 import time
 from typing import Callable, Optional, Union
 
+from opendiloco_tpu.obs import reqtrace
 from opendiloco_tpu.serve.scheduler import ContinuousBatcher
 
 log = logging.getLogger(__name__)
@@ -168,12 +169,22 @@ class ServeServer:
         self, payload: dict, conn: Optional[socket.socket] = None
     ) -> Optional[dict]:
         deadline_ms = payload.get("deadline_ms")
+        # trace context: adopt one propagated from the router, else mint
+        # at this edge (standalone serve plane). Absent field = old peer
+        # or untraced request — both identical, nothing to version-check.
+        trace_ctx = None
+        rt = reqtrace.ring()
+        if rt is not None:
+            trace_ctx = reqtrace.ctx_of(payload)
+            if trace_ctx is None:
+                trace_ctx = rt.mint(at="server", req_id=payload.get("id"))
         req = self.batcher.submit(
             payload.get("prompt") or [],
             max_new_tokens=int(payload.get("max_new_tokens", 16)),
             eos_id=payload.get("eos_id"),
             priority=int(payload.get("priority", 0)),
             deadline_ms=None if deadline_ms is None else float(deadline_ms),
+            trace=trace_ctx,
         )
         # wait in slices, watching the client socket: a disconnect
         # mid-generation retires the slot immediately instead of decoding

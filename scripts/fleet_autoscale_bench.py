@@ -349,6 +349,9 @@ def main() -> None:
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     os.environ.setdefault("ODTP_OBS", "autoscale-bench")  # watchdogs armed
+    # keep breach-exemplar traces resolvable: later traffic must not
+    # evict them from the completed ring before the gates look them up
+    os.environ.setdefault("ODTP_REQTRACE_CAP", "16384")
     # replica subprocesses share one jit cache: a cold boot is a process
     # start + cache hit, not a recompile (closer to a real image pull)
     os.environ.setdefault(
@@ -361,6 +364,7 @@ def main() -> None:
     from opendiloco_tpu import fleet, obs
     from opendiloco_tpu.config import FleetConfig
     from opendiloco_tpu.models.llama import LlamaConfig, init_params
+    from opendiloco_tpu.obs import reqtrace
 
     obs.reset()
     model_cfg = LlamaConfig(
@@ -513,6 +517,21 @@ def main() -> None:
         for k, v in decisions_by_action(plane).items()
     }
     decisions = list(plane.autoscaler.decisions)
+    # every scale_up must name the requests that justified it, and the
+    # ids must resolve to actual recorded traces (the router mints ids
+    # in THIS process and replicas adopt them verbatim, so replica-
+    # reported exemplars resolve in the local ring)
+    rt = reqtrace.ring()
+    scale_up_exemplars = [
+        {
+            "exemplars": d.get("exemplars") or [],
+            "resolved": sum(
+                1 for t in d.get("exemplars") or []
+                if rt is not None and rt.has(t)
+            ),
+        }
+        for d in decisions if d["action"] == "scale_up"
+    ]
     lives = [s[1] for s in sampler.samples if isinstance(s[1], int)]
     in_slo = {
         "submitted": clients.submitted,
@@ -561,6 +580,7 @@ def main() -> None:
         },
         "decisions_by_action": by_action,
         "decision_log": decisions,
+        "scale_up_exemplars": scale_up_exemplars,
         "counters": {
             k: v for k, v in sorted(counters.items())
             if k.startswith(("fleet_", "anomaly_"))
@@ -612,6 +632,17 @@ def main() -> None:
         raise SystemExit(
             "no warm-spare adoption (spare_promotion) in the decision log"
         )
+    for i, ex in enumerate(scale_up_exemplars):
+        if not ex["exemplars"]:
+            raise SystemExit(
+                f"scale_up decision #{i} carries no breach exemplars — "
+                "an alarm that names no offending request is unactionable"
+            )
+        if not ex["resolved"]:
+            raise SystemExit(
+                f"scale_up decision #{i} exemplars {ex['exemplars']} "
+                "resolve to no recorded trace"
+            )
     if not lives or max(lives) <= min(lives):
         raise SystemExit(
             f"fleet never swung: live-replica samples {lives[:20]}"

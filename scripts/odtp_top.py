@@ -10,10 +10,18 @@ Two sources, one table:
 - ``--dir OBS_DIR``: offline mode; read the freshest flight-recorder
   dump per worker (works after the run is gone).
 
+``--requests`` switches the table to the request-trace plane: live
+inflight + recently completed request traces with their per-stage
+latency split (queue / prefill / decode / ...). Sources mirror the
+health table: ``--peer`` asks the ``reqtrace`` control frame (any
+worker control port or fleet-replica push port — both speak ODTP
+framing), ``--dir`` reads the ``reqtrace-*.json`` ring dumps.
+
 ``--watch`` re-renders every ``--interval`` seconds until Ctrl-C.
 
     python scripts/odtp_top.py --peer 127.0.0.1:31000 --watch
     python scripts/odtp_top.py --dir /tmp/obs
+    python scripts/odtp_top.py --peer 127.0.0.1:31000 --requests
 """
 import argparse
 import asyncio
@@ -71,6 +79,129 @@ def matrix_from_dir(obs_dir: str) -> dict:
                     cur.get("ts", 0) or 0):
                 matrix[pid] = vec
     return matrix
+
+
+def reqtrace_from_peer(peer: str, timeout: float = 10.0) -> dict:
+    """One process's request-trace ring snapshot via its control (or
+    fleet push) port: ``{worker: snapshot}``. A peer that predates the
+    frame kind answers "error"; a peer with the obs plane unarmed
+    answers ``None`` — both mean "no reqtrace plane there"."""
+    from opendiloco_tpu.diloco import wire
+
+    host, port = peer.rsplit(":", 1)
+
+    async def _ask():
+        msg, meta, _ = await wire.request(
+            host, int(port), "reqtrace", {"recent": 16}, timeout=timeout
+        )
+        if msg != "ok":
+            raise RuntimeError(f"peer replied {msg!r}: {meta}")
+        return meta
+
+    meta = asyncio.run(_ask())
+    snap = meta.get("reqtrace")
+    if not snap:
+        raise RuntimeError(
+            f"peer {peer} has no request-trace plane (ODTP_OBS unset, or "
+            "the peer predates the reqtrace frame)"
+        )
+    worker = meta.get("replica") or (snap.get("report") or {}).get("worker")
+    return {str(worker): snap}
+
+
+def reqtrace_from_dir(obs_dir: str) -> dict:
+    """Offline: every ``reqtrace-*.json`` ring dump in the directory,
+    reshaped to the same per-worker snapshot the live frame carries."""
+    import json
+
+    snaps: dict = {}
+    for name in sorted(os.listdir(obs_dir)):
+        if not (name.startswith("reqtrace-") and name.endswith(".json")):
+            continue
+        try:
+            with open(os.path.join(obs_dir, name)) as f:
+                body = json.load(f)
+        except (OSError, ValueError):
+            continue
+        recent = [
+            {
+                "id": t["id"],
+                "status": t["status"],
+                "e2e_ms": t.get("e2e_ms"),
+                "stages_ms": {
+                    k: round(v * 1e3, 3)
+                    for k, v in (t.get("stages_s") or {}).items()
+                },
+                "attrs": t.get("attrs") or {},
+            }
+            for t in (body.get("traces") or [])[-16:]
+        ]
+        snaps[str(body.get("worker"))] = {
+            "report": body.get("report") or {},
+            "inflight": body.get("inflight") or [],
+            "recent": recent,
+        }
+    if not snaps:
+        raise RuntimeError(f"no reqtrace-*.json dumps under {obs_dir!r}")
+    return snaps
+
+
+_REQ_COLS = (
+    ("worker", 10), ("trace", 26), ("state", 7), ("e2e_ms", 9),
+    ("last", 8), ("queue", 7), ("prefill", 8), ("decode", 8),
+    ("swap", 6), ("attrs", 24),
+)
+
+
+def _stage_ms(row: dict, stage: str):
+    v = (row.get("stages_ms") or {}).get(stage)
+    return None if v is None else round(v, 1)
+
+
+def render_requests(snaps: dict) -> str:
+    header = " ".join(name.rjust(w) for name, w in _REQ_COLS)
+    lines = [header, "-" * len(header)]
+    n_inflight = n_done = 0
+    for worker in sorted(snaps):
+        snap = snaps[worker] or {}
+        for row in snap.get("inflight") or []:
+            n_inflight += 1
+            cells = (
+                worker, row.get("id"), "live",
+                round(row.get("age_ms", 0.0), 1), row.get("last_stage"),
+                _stage_ms(row, "queue"), _stage_ms(row, "prefill"),
+                _stage_ms(row, "decode"), _stage_ms(row, "swap"), "",
+            )
+            lines.append(" ".join(
+                _fmt(c, w) for c, (_, w) in zip(cells, _REQ_COLS)))
+        for row in reversed(snap.get("recent") or []):
+            n_done += 1
+            attrs = row.get("attrs") or {}
+            attr_s = ",".join(
+                f"{k}={attrs[k]}"
+                for k in ("replica", "reason", "error", "redispatches")
+                if attrs.get(k) not in (None, "", 0)
+            )
+            e2e = row.get("e2e_ms")
+            cells = (
+                worker, row.get("id"), row.get("status"),
+                None if e2e is None else round(e2e, 1), "retire",
+                _stage_ms(row, "queue"), _stage_ms(row, "prefill"),
+                _stage_ms(row, "decode"), _stage_ms(row, "swap"), attr_s,
+            )
+            lines.append(" ".join(
+                _fmt(c, w) for c, (_, w) in zip(cells, _REQ_COLS)))
+        rep = snap.get("report") or {}
+        e2e = rep.get("e2e_ms") or {}
+        dom = rep.get("dominant_stage_p99")
+        lines.append(
+            f"  {worker}: {rep.get('completed', 0)} done / "
+            f"{rep.get('inflight', 0)} live, e2e p50 {e2e.get('p50')} ms "
+            f"p99 {e2e.get('p99')} ms"
+            + (f", p99 dominated by {dom}" if dom else "")
+        )
+    lines.append(f"{n_inflight} inflight + {n_done} recent trace(s)")
+    return "\n".join(lines)
 
 
 def _fmt(v, width: int) -> str:
@@ -131,16 +262,28 @@ def main() -> int:
         "--dir", default="",
         help="read flight-recorder dumps from this directory instead",
     )
+    ap.add_argument(
+        "--requests", action="store_true",
+        help="show the request-trace plane instead of worker health",
+    )
     ap.add_argument("--watch", action="store_true", help="refresh forever")
     ap.add_argument("--interval", type=float, default=2.0)
     args = ap.parse_args()
 
     while True:
         try:
-            matrix = (
-                matrix_from_peer(args.peer) if args.peer
-                else matrix_from_dir(args.dir)
-            )
+            if args.requests:
+                data = (
+                    reqtrace_from_peer(args.peer) if args.peer
+                    else reqtrace_from_dir(args.dir)
+                )
+                table = render_requests(data)
+            else:
+                data = (
+                    matrix_from_peer(args.peer) if args.peer
+                    else matrix_from_dir(args.dir)
+                )
+                table = render(data, time.time())
         except Exception as exc:
             print(f"fetch failed: {exc}", file=sys.stderr)
             if not args.watch:
@@ -149,9 +292,9 @@ def main() -> int:
             continue
         if args.watch:
             print("\033[2J\033[H", end="")  # clear screen, home cursor
-        print(render(matrix, time.time()))
+        print(table)
         if not args.watch:
-            return 0 if matrix else 1
+            return 0 if data else 1
         time.sleep(args.interval)
 
 

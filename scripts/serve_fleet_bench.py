@@ -404,6 +404,7 @@ def run_chaos_leg(args, procs, infos, mgr, router, monitor):
 
 def run_arm(args, model_cfg, n_replicas, with_chaos) -> dict:
     from opendiloco_tpu import obs
+    from opendiloco_tpu.obs import reqtrace
 
     obs.reset()  # counters cover this arm only
     sim, pub, router, mgr, procs, infos = spawn_fleet(
@@ -474,6 +475,22 @@ def run_arm(args, model_cfg, n_replicas, with_chaos) -> dict:
             "delta_push": _delta_accounting(pstats),
             "trainer_epochs": sim.epoch,
         }
+        rt = reqtrace.ring()
+        if rt is not None:
+            # the router runs in THIS process, so its ring holds one
+            # trace per dispatched request — including requests whose
+            # first replica was SIGKILLed (same id, redispatches >= 1)
+            traces = rt.traces()
+            arm["reqtrace"] = {
+                "completed": len(traces),
+                "evicted": rt.evicted,
+                "statuses": rt.report()["statuses"],
+                "redispatched_traces": sum(
+                    1 for t in traces
+                    if (t.get("attrs") or {}).get("redispatches", 0) > 0
+                ),
+                "dangling_inflight": rt.inflight_ids(),
+            }
         if chaos is not None:
             chaos["dead_peer_watchdog_tripped"] = any(
                 k.startswith("anomaly_dead_peer") for k in counters
@@ -557,6 +574,9 @@ def main() -> None:
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     os.environ.setdefault("ODTP_OBS", "fleet-bench")  # chaos plane armed
+    # big completed ring: post-kill traffic must not evict the SIGKILL
+    # victims' traces before the gates inspect them
+    os.environ.setdefault("ODTP_REQTRACE_CAP", "8192")
 
     from opendiloco_tpu.models.llama import LlamaConfig
 
@@ -647,6 +667,23 @@ def main() -> None:
                 f"arm {n}: delta push {ratio} of an fp16 snapshot per epoch "
                 "— acceptance is <= 0.25"
             )
+        rq = arm.get("reqtrace")
+        if rq:
+            if rq["dangling_inflight"]:
+                raise SystemExit(
+                    f"arm {n}: request traces never terminated: "
+                    f"{rq['dangling_inflight'][:5]} — every dispatch "
+                    "(served, shed, or interrupted by SIGKILL) must finish "
+                    "its trace"
+                )
+            if arm["router"]["redispatches"] > 0 and not rq[
+                    "redispatched_traces"]:
+                raise SystemExit(
+                    f"arm {n}: router redispatched "
+                    f"{arm['router']['redispatches']} request(s) but no "
+                    "trace records a redispatch — a killed request's "
+                    "history was lost across mark-dead -> re-dispatch"
+                )
     chaos = chaos_arm["chaos"]
     if not chaos["rejoined"]:
         raise SystemExit("chaos arm: SIGKILLed replica never rejoined")
