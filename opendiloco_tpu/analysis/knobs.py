@@ -205,6 +205,16 @@ KNOBS: tuple[Knob, ...] = (
          "exceeds X times the galaxy median, or whose inner tokens/s falls "
          "below 1/X of it; `0` disables."),
     # -- serve ----------------------------------------------------------------
+    Knob("ODTP_DECODE_BLOCK_T", "int", "", "serve",
+         "Ring-page tile size for the Pallas decode kernels (must divide "
+         "the slot context); unset = the shared block heuristic.",
+         doc_default="auto"),
+    Knob("ODTP_DECODE_KERNEL", "str", "", "serve",
+         "Decode-path kernel dispatch: `auto` picks the Pallas serving "
+         "kernels (paged decode attention, fused W4 dequant-matmul, fused "
+         "speculative verify) on TPU and the stock XLA ops elsewhere; "
+         "`pallas`/`xla` force a path. Token-bit-exact either way.",
+         doc_default="config"),
     Knob("ODTP_DECODE_WEIGHT_FORMAT", "str", "", "serve",
          "Replica weight residency override for the serve plane: `w4` keeps "
          "stacked matmul weights blockwise-4bit packed at rest (dequantized "

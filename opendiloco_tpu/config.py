@@ -237,6 +237,12 @@ class ServeConfig(BaseModel):
     # matmul weights blockwise-4bit packed at rest, dequantized per block
     # inside the jit'd decode; norms/embeddings/lm head stay fp32)
     weight_format: Literal["fp32", "w4"] = "fp32"
+    # decode-path kernel dispatch: "auto" picks the Pallas serving kernels
+    # (paged decode attention, fused W4 dequant-matmul, fused speculative
+    # verify) on TPU backends and the stock XLA ops elsewhere; "pallas" /
+    # "xla" force a path (forced pallas off-TPU runs interpreted — test
+    # rigs only). Token-bit-exact either way.
+    decode_kernel: Literal["auto", "pallas", "xla"] = "auto"
     # shared-prefix KV reuse: prefill a common prompt prefix once and
     # ring-copy its K/V into joining slots
     prefix_cache: bool = False

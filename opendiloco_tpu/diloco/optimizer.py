@@ -22,7 +22,7 @@ import concurrent.futures
 import functools
 import threading
 import time
-from typing import Any, Optional
+from typing import TYPE_CHECKING, Any, Optional
 
 import jax
 import numpy as np
@@ -38,7 +38,10 @@ from opendiloco_tpu.diloco.outer_device import DeviceOuterPlane
 from opendiloco_tpu.diloco.outer_optimizer import OuterSGD, noloco_step
 from opendiloco_tpu.diloco.streaming import StreamScheduler
 from opendiloco_tpu.parallel.world import HostWorld
-from opendiloco_tpu.trainer import InnerTrainer
+
+if TYPE_CHECKING:  # annotation-only: a module-level import would close the
+    # trainer -> obs -> diloco.schema -> diloco.optimizer -> trainer cycle
+    from opendiloco_tpu.trainer import InnerTrainer
 from opendiloco_tpu.utils.debug import schema_fingerprint
 from opendiloco_tpu.utils.logger import get_text_logger
 

@@ -31,6 +31,12 @@ from opendiloco_tpu.ops.attention import (
     spec_tail_attention,
     xla_attention,
 )
+from opendiloco_tpu.ops.decode_kernels import (
+    paged_decode_attention,
+    spec_tail_attention_fused,
+    w4_matmul,
+    w4_matmul_supported,
+)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -530,6 +536,24 @@ def _wleaf(w, dtype):
     return w
 
 
+def _wmul(x, w, dtype, kernel="xla"):
+    """One weight-matmul site: ``x @ materialized(w)``.
+
+    On the Pallas decode path a packed leaf routes through the fused
+    dequant-matmul kernel — nibbles dequantize in-registers per tile —
+    instead of materializing the full weight via ``_wleaf``. Dense
+    leaves and untileable packed shapes keep the XLA contraction."""
+    if (
+        kernel == "pallas"
+        and isinstance(w, PackedW4)
+        and w4_matmul_supported(w.shape)
+    ):
+        lead = x.shape[:-1]
+        out = w4_matmul(x.reshape(-1, x.shape[-1]), w.q, w.s, w.shape, dtype)
+        return out.reshape(*lead, w.shape[1])
+    return x @ _wleaf(w, dtype)
+
+
 def _cast_serving_params(params, dtype):
     """The forward-boundary cast, w4-aware: packed uint8/uint16 leaves
     stay packed (their dequant targets ``dtype`` at the matmul site)."""
@@ -571,6 +595,7 @@ def prefill_forward(
     cfg: LlamaConfig,
     *,
     compute_dtype: jnp.dtype = jnp.bfloat16,
+    decode_kernel: str = "xla",
 ):
     """Prompt prefill for serving: ids [1, P] -> (last-token logits [1, V]
     f32, per-layer K/V [L, P, Nkv, Dh] in compute dtype).
@@ -587,21 +612,23 @@ def prefill_forward(
     cparams = _cast_serving_params(params, compute_dtype)
     cos, sin = _rope_tables(positions, Dh, cfg.rope_theta)
     cd = compute_dtype
+    dkn = decode_kernel
 
     def block(h, layer):
         x = _rms_norm(h, layer["input_norm"], cfg.rms_norm_eps)
-        q = (x @ _wleaf(layer["q_proj"], cd)).reshape(B, P, Nh, Dh)
-        k = (x @ _wleaf(layer["k_proj"], cd)).reshape(B, P, Nkv, Dh)
-        v = (x @ _wleaf(layer["v_proj"], cd)).reshape(B, P, Nkv, Dh)
+        q = _wmul(x, layer["q_proj"], cd, dkn).reshape(B, P, Nh, Dh)
+        k = _wmul(x, layer["k_proj"], cd, dkn).reshape(B, P, Nkv, Dh)
+        v = _wmul(x, layer["v_proj"], cd, dkn).reshape(B, P, Nkv, Dh)
         q = _rope_apply(q, cos, sin)
         k = _rope_apply(k, cos, sin)
         attn = xla_attention(q, k, v, causal=True)
-        h = h + attn.reshape(B, P, Nh * Dh) @ _wleaf(layer["o_proj"], cd)
+        h = h + _wmul(attn.reshape(B, P, Nh * Dh), layer["o_proj"], cd, dkn)
         x = _rms_norm(h, layer["post_attn_norm"], cfg.rms_norm_eps)
-        ffn = (
-            jax.nn.silu(x @ _wleaf(layer["gate_proj"], cd))
-            * (x @ _wleaf(layer["up_proj"], cd))
-        ) @ _wleaf(layer["down_proj"], cd)
+        ffn = _wmul(
+            jax.nn.silu(_wmul(x, layer["gate_proj"], cd, dkn))
+            * _wmul(x, layer["up_proj"], cd, dkn),
+            layer["down_proj"], cd, dkn,
+        )
         return h + ffn, (k[0], v[0])
 
     h = jnp.take(cparams["embed_tokens"], input_ids, axis=0)
@@ -649,6 +676,7 @@ def decode_forward(
     cfg: LlamaConfig,
     *,
     compute_dtype: jnp.dtype = jnp.bfloat16,
+    decode_kernel: str = "xla",
 ):
     """One incremental decode step over all S slots.
 
@@ -669,24 +697,29 @@ def decode_forward(
     rows = jnp.arange(S)
     write_idx = jnp.mod(lens, T)
     cd = compute_dtype
+    dkn = decode_kernel
 
     def block(h, xs):
         layer, ck, cv = xs  # ck/cv [S, T, Nkv, Dh]
         x = _rms_norm(h, layer["input_norm"], cfg.rms_norm_eps)
-        q = (x @ _wleaf(layer["q_proj"], cd)).reshape(S, 1, Nh, Dh)
-        k = (x @ _wleaf(layer["k_proj"], cd)).reshape(S, 1, Nkv, Dh)
-        v = (x @ _wleaf(layer["v_proj"], cd)).reshape(S, 1, Nkv, Dh)
+        q = _wmul(x, layer["q_proj"], cd, dkn).reshape(S, 1, Nh, Dh)
+        k = _wmul(x, layer["k_proj"], cd, dkn).reshape(S, 1, Nkv, Dh)
+        v = _wmul(x, layer["v_proj"], cd, dkn).reshape(S, 1, Nkv, Dh)
         q = _rope_apply(q, cos, sin)
         k = _rope_apply(k, cos, sin)
         ck = ck.at[rows, write_idx].set(k[:, 0].astype(ck.dtype))
         cv = cv.at[rows, write_idx].set(v[:, 0].astype(cv.dtype))
-        attn = decode_attention(q[:, 0], ck, cv, lens)
-        h = h + attn.reshape(S, 1, Nh * Dh) @ _wleaf(layer["o_proj"], cd)
+        if dkn == "pallas":
+            attn = paged_decode_attention(q[:, 0], ck, cv, lens)
+        else:
+            attn = decode_attention(q[:, 0], ck, cv, lens)
+        h = h + _wmul(attn.reshape(S, 1, Nh * Dh), layer["o_proj"], cd, dkn)
         x = _rms_norm(h, layer["post_attn_norm"], cfg.rms_norm_eps)
-        ffn = (
-            jax.nn.silu(x @ _wleaf(layer["gate_proj"], cd))
-            * (x @ _wleaf(layer["up_proj"], cd))
-        ) @ _wleaf(layer["down_proj"], cd)
+        ffn = _wmul(
+            jax.nn.silu(_wmul(x, layer["gate_proj"], cd, dkn))
+            * _wmul(x, layer["up_proj"], cd, dkn),
+            layer["down_proj"], cd, dkn,
+        )
         return h + ffn, (ck, cv)
 
     h = jnp.take(cparams["embed_tokens"], tokens, axis=0)[:, None]  # [S, 1, D]
@@ -712,6 +745,7 @@ def verify_forward(
     cfg: LlamaConfig,
     *,
     compute_dtype: jnp.dtype = jnp.bfloat16,
+    decode_kernel: str = "xla",
 ):
     """Batched multi-token verify pass for self-speculative decode.
 
@@ -735,22 +769,27 @@ def verify_forward(
     positions = lens[:, None] + jnp.arange(K, dtype=jnp.int32)[None]  # [S, K]
     cos, sin = _rope_tables(positions, Dh, cfg.rope_theta)
     cd = compute_dtype
+    dkn = decode_kernel
 
     def block(h, xs):
         layer, ck, cv = xs  # ck/cv [S, T, Nkv, Dh]
         x = _rms_norm(h, layer["input_norm"], cfg.rms_norm_eps)
-        q = (x @ _wleaf(layer["q_proj"], cd)).reshape(S, K, Nh, Dh)
-        k = (x @ _wleaf(layer["k_proj"], cd)).reshape(S, K, Nkv, Dh)
-        v = (x @ _wleaf(layer["v_proj"], cd)).reshape(S, K, Nkv, Dh)
+        q = _wmul(x, layer["q_proj"], cd, dkn).reshape(S, K, Nh, Dh)
+        k = _wmul(x, layer["k_proj"], cd, dkn).reshape(S, K, Nkv, Dh)
+        v = _wmul(x, layer["v_proj"], cd, dkn).reshape(S, K, Nkv, Dh)
         q = _rope_apply(q, cos, sin)
         k = _rope_apply(k, cos, sin)
-        attn = spec_tail_attention(q, ck, cv, k, v, lens)
-        h = h + attn.reshape(S, K, Nh * Dh) @ _wleaf(layer["o_proj"], cd)
+        if dkn == "pallas":
+            attn = spec_tail_attention_fused(q, ck, cv, k, v, lens)
+        else:
+            attn = spec_tail_attention(q, ck, cv, k, v, lens)
+        h = h + _wmul(attn.reshape(S, K, Nh * Dh), layer["o_proj"], cd, dkn)
         x = _rms_norm(h, layer["post_attn_norm"], cfg.rms_norm_eps)
-        ffn = (
-            jax.nn.silu(x @ _wleaf(layer["gate_proj"], cd))
-            * (x @ _wleaf(layer["up_proj"], cd))
-        ) @ _wleaf(layer["down_proj"], cd)
+        ffn = _wmul(
+            jax.nn.silu(_wmul(x, layer["gate_proj"], cd, dkn))
+            * _wmul(x, layer["up_proj"], cd, dkn),
+            layer["down_proj"], cd, dkn,
+        )
         return h + ffn, (k, v)
 
     h = jnp.take(cparams["embed_tokens"], tail, axis=0)  # [S, K, D]
@@ -778,6 +817,7 @@ def draft_propose(
     k_steps: int,
     draft_layers: int,
     compute_dtype: jnp.dtype = jnp.bfloat16,
+    decode_kernel: str = "xla",
 ):
     """Self-speculative draft: propose ``k_steps`` greedy tokens per slot
     from the first ``draft_layers`` of the SAME weights (final norm and
@@ -799,6 +839,7 @@ def draft_propose(
     dlayers = jax.tree.map(lambda x: x[:Ld], cparams["layers"])
     dck, dcv = cache_k[:Ld], cache_v[:Ld]
     cd = compute_dtype
+    dkn = decode_kernel
     head = (
         cparams["embed_tokens"].T
         if cfg.tie_word_embeddings
@@ -816,20 +857,26 @@ def draft_propose(
         def block(h, xs, i=i, cos=cos, sin=sin):
             layer, ck, cv, tk, tv = xs
             x = _rms_norm(h, layer["input_norm"], cfg.rms_norm_eps)
-            q = (x @ _wleaf(layer["q_proj"], cd)).reshape(S, 1, Nh, Dh)
-            k = (x @ _wleaf(layer["k_proj"], cd)).reshape(S, 1, Nkv, Dh)
-            v = (x @ _wleaf(layer["v_proj"], cd)).reshape(S, 1, Nkv, Dh)
+            q = _wmul(x, layer["q_proj"], cd, dkn).reshape(S, 1, Nh, Dh)
+            k = _wmul(x, layer["k_proj"], cd, dkn).reshape(S, 1, Nkv, Dh)
+            v = _wmul(x, layer["v_proj"], cd, dkn).reshape(S, 1, Nkv, Dh)
             q = _rope_apply(q, cos, sin)
             k = _rope_apply(k, cos, sin)
             tk = tk.at[:, i].set(k[:, 0])
             tv = tv.at[:, i].set(v[:, 0])
-            attn = spec_tail_attention(q, ck, cv, tk, tv, lens, q_start=i)
-            h = h + attn.reshape(S, 1, Nh * Dh) @ _wleaf(layer["o_proj"], cd)
+            if dkn == "pallas":
+                attn = spec_tail_attention_fused(
+                    q, ck, cv, tk, tv, lens, q_start=i
+                )
+            else:
+                attn = spec_tail_attention(q, ck, cv, tk, tv, lens, q_start=i)
+            h = h + _wmul(attn.reshape(S, 1, Nh * Dh), layer["o_proj"], cd, dkn)
             x = _rms_norm(h, layer["post_attn_norm"], cfg.rms_norm_eps)
-            ffn = (
-                jax.nn.silu(x @ _wleaf(layer["gate_proj"], cd))
-                * (x @ _wleaf(layer["up_proj"], cd))
-            ) @ _wleaf(layer["down_proj"], cd)
+            ffn = _wmul(
+                jax.nn.silu(_wmul(x, layer["gate_proj"], cd, dkn))
+                * _wmul(x, layer["up_proj"], cd, dkn),
+                layer["down_proj"], cd, dkn,
+            )
             return h + ffn, (tk, tv)
 
         h = jnp.take(cparams["embed_tokens"], cur, axis=0)[:, None]  # [S, 1, D]

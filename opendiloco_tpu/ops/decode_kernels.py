@@ -1,0 +1,590 @@
+"""Pallas TPU serving kernels: ragged paged decode attention, fused W4
+dequant-matmul, fused speculative verify.
+
+PR 11's decode speedups were algorithmic (speculation, 4-bit residency,
+prefix reuse); the ops underneath stayed stock XLA: ``decode_attention``
+dense-masks the whole ring page per slot, ``spec_tail_attention``
+materializes full repeat-KV score tensors, and ``PackedW4`` leaves
+dequantize to full f32 weight matrices at every matmul site. These
+kernels move the decode hot path onto the MXU the way the inner loop's
+flash kernel did (PagedAttention-style cache-aware decode, arXiv
+2309.06180), token-bit-exact against the XLA paths:
+
+- :func:`paged_decode_attention` reads the slot-paged ring KV cache
+  ``[S, T, Kh, D]`` directly. The per-slot ``lens`` vector rides the
+  grid as a scalar-prefetch operand, so each slot's dead ring blocks are
+  skipped (``pl.when``) AND their DMAs elided (the BlockSpec index map
+  clamps to the last live block, an unchanged index reuses the resident
+  tile — same trick as the flash kernel's causal skip). GQA is handled
+  by block geometry: grid position (slot, kv-head) loads exactly that kv
+  head's ``rep`` query rows, never a ``_repeat_kv`` materialization.
+  Online softmax in f32 matches ``decode_attention`` row-for-row.
+- :func:`w4_matmul` fuses the blockwise-4-bit dequant into the matmul:
+  packed nibbles dequantize in-registers per ``[block_k, N]`` tile with
+  bit-for-bit the ``native._dequant4_numpy`` element order and per-4096-
+  block f16-scale math (pinned by an identity-matmul probe in tests),
+  instead of materializing the full f32 weight in HBM first. Nibble
+  interleave is resolved by splitting the output into even/odd column
+  planes (one [2, M, N/2] kernel output, re-interleaved by the caller's
+  reshape) so the kernel never needs an in-VMEM relayout.
+- :func:`spec_tail_attention_fused` implements ``spec_tail_attention``'s
+  exact ring-wrap eviction mask over cache AND in-register tail K/V in
+  one online-softmax pass — the ring blocks stream first (dead blocks
+  skipped via ``lens`` like the decode kernel), the tail block runs
+  last, and no concat-mask score tensor is ever built.
+
+Dispatch: ``ODTP_DECODE_KERNEL=auto|pallas|xla`` (``ServeConfig.
+decode_kernel``). ``auto`` — the default — selects Pallas only when the
+backend is TPU; off-TPU it always resolves to the XLA paths, so CPU rigs
+keep today's exact code. Forcing ``pallas`` off-TPU runs the kernels in
+Pallas interpret mode (slow, but semantically the kernel) — that is how
+the parity tests pin token-bit-exactness on a CPU rig. Shapes a kernel
+cannot tile (head_dim not a multiple of 8, odd N) fall back to the XLA
+path per call, mirroring ``flash_attention``'s fallback contract.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from opendiloco_tpu.ops.attention import decode_attention, spec_tail_attention
+from opendiloco_tpu.ops.pallas_util import (
+    NEG_INF,
+    compiler_params,
+    out_vma,
+    sds,
+    pick_block,
+)
+
+W4_BLOCK = 4096  # diloco.compression._BLOCK (pinned by tests)
+
+DECODE_KERNELS = ("auto", "pallas", "xla")
+
+
+def resolve_decode_kernel(spec: str | None = None) -> str:
+    """Resolve a dispatch spec to the concrete path ("pallas" | "xla").
+
+    ``spec`` is ``ServeConfig.decode_kernel`` or the ``ODTP_DECODE_KERNEL``
+    env knob (unset/empty = "auto"). ``auto`` NEVER selects Pallas off-TPU:
+    the CPU rig keeps the stock XLA decode path bit-for-bit."""
+    spec = spec or os.environ.get("ODTP_DECODE_KERNEL") or "auto"
+    if spec not in DECODE_KERNELS:
+        raise ValueError(
+            f"unknown decode kernel {spec!r}; expected one of {DECODE_KERNELS}"
+        )
+    if spec == "auto":
+        return "pallas" if jax.default_backend() == "tpu" else "xla"
+    return spec
+
+
+def _interpret(interpret: bool | None) -> bool:
+    """A forced Pallas path off-TPU runs interpreted — slow, but it is the
+    kernel's own dataflow, which is what the CPU parity tests pin."""
+    if interpret is None:
+        return jax.default_backend() != "tpu"
+    return bool(interpret)
+
+
+def _ring_block(t: int, block_t: int | None) -> int:
+    """Ring-page tile size: explicit arg > ``ODTP_DECODE_BLOCK_T`` > the
+    shared block heuristic > the whole page (always tiles)."""
+    if block_t:
+        return block_t if t % block_t == 0 else t
+    env = os.environ.get("ODTP_DECODE_BLOCK_T")
+    if env:
+        b = int(env)
+        if b > 0 and t % b == 0:
+            return b
+    return pick_block(t, 256) or t
+
+
+# ---------------------------------------------------------------------------
+# (a) ragged paged decode attention
+# ---------------------------------------------------------------------------
+
+
+def _decode_attn_kernel(
+    lens_ref, q_ref, k_ref, v_ref, o_ref, *rest,
+    scale, block_t, t, num_t, with_stats,
+):
+    if with_stats:
+        stats_ref, m_scr, l_scr, acc_scr, cnt_scr = rest
+    else:
+        (m_scr, l_scr, acc_scr), cnt_scr = rest, None
+    rep, d = q_ref.shape
+    si, ti = pl.program_id(0), pl.program_id(2)
+
+    @pl.when(ti == 0)
+    def _init():
+        m_scr[:] = jnp.full((rep, 1), NEG_INF, jnp.float32)
+        l_scr[:] = jnp.zeros((rep, 1), jnp.float32)
+        acc_scr[:] = jnp.zeros((rep, d), jnp.float32)
+        if with_stats:
+            cnt_scr[:] = jnp.zeros((1, 1), jnp.int32)
+
+    lens_s = lens_ref[si]
+    # valid cache entries are idx <= lens (whole ring once lens >= t), so
+    # blocks past min(lens, t-1) hold no live rows for this slot
+    last_live = jnp.minimum(lens_s, t - 1) // block_t
+
+    @pl.when(ti <= last_live)
+    def _step():
+        q = q_ref[:]
+        k_blk = k_ref[:]
+        v_blk = v_ref[:]
+        s = scale * jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [rep, block_t]
+        idx = ti * block_t + jax.lax.broadcasted_iota(
+            jnp.int32, (rep, block_t), 1
+        )
+        valid = (idx <= lens_s) | (lens_s >= t)
+        s = jnp.where(valid, s, NEG_INF)
+        m_prev, l_prev, acc = m_scr[:], l_scr[:], acc_scr[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m_prev - m_new)
+        m_scr[:] = m_new
+        l_scr[:] = l_prev * corr + jnp.sum(p, axis=1, keepdims=True)
+        acc_scr[:] = acc * corr + jax.lax.dot_general(
+            p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        if with_stats:
+            cnt_scr[0, 0] += 1
+
+    @pl.when(ti == num_t - 1)
+    def _finish():
+        l = l_scr[:]
+        l_safe = jnp.where(l == 0, 1.0, l)
+        o_ref[:] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
+        if with_stats:
+            stats_ref[0, 0] = cnt_scr[0, 0]
+
+
+def paged_decode_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    lens: jax.Array,
+    *,
+    block_t: int | None = None,
+    interpret: bool | None = None,
+    return_stats: bool = False,
+):
+    """Drop-in :func:`~opendiloco_tpu.ops.attention.decode_attention`:
+    q [S, H, D] over ring pages k/v [S, T, Kh, D] with per-slot ``lens``.
+
+    ``return_stats`` additionally returns the measured per-(slot, kv-head)
+    count of ring blocks the kernel actually processed — the dead-block
+    skip evidence banked by scripts/decode_kernel_bench.py."""
+    s_, t, nkv, d = k.shape
+    h = q.shape[1]
+    if d % 8 != 0 or h % nkv != 0:
+        out = decode_attention(q, k, v, lens)
+        return (out, None) if return_stats else out
+    rep = h // nkv
+    bt = _ring_block(t, block_t)
+    num_t = t // bt
+    interp = _interpret(interpret)
+
+    # Mosaic requires the last two dims of every block to be (8, 128)-
+    # aligned OR equal to the array's own dims. rep and the kv-head axis
+    # are tiny and never 8-aligned, so they must BE array dims: view the
+    # cache as [S, Kh, T, D] ([bt, d] tiles) and q as [S, Kh, rep, D]
+    # ([rep, d] tiles, rep == its array dim). Kernel ref shapes are
+    # identical to the untransposed layout — only the DMA geometry moves.
+    kt_ = k.transpose(0, 2, 1, 3)
+    vt_ = v.transpose(0, 2, 1, 3)
+    q4 = q.reshape(s_, nkv, rep, d)
+
+    def kv_map(si, hi, ti, lens_ref):
+        # clamp dead blocks to the last live one: unchanged index = no DMA
+        last = jnp.minimum(lens_ref[si], t - 1) // bt
+        return (si, hi, jnp.minimum(ti, last), 0)
+
+    def q_map(si, hi, ti, lr):
+        return (si, hi, 0, 0)
+
+    out_specs = [pl.BlockSpec((None, None, rep, d), q_map)]
+    out_shape = [sds((s_, nkv, rep, d), q.dtype, vma=out_vma(q))]
+    scratch = [
+        pltpu.VMEM((rep, 1), jnp.float32),
+        pltpu.VMEM((rep, 1), jnp.float32),
+        pltpu.VMEM((rep, d), jnp.float32),
+    ]
+    if return_stats:
+        out_specs.append(
+            pl.BlockSpec((None, None, 1, 1), lambda si, hi, ti, lr: (si, hi, 0, 0))
+        )
+        out_shape.append(sds((s_, nkv, 1, 1), jnp.int32))
+        scratch.append(pltpu.VMEM((1, 1), jnp.int32))
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(s_, nkv, num_t),
+        in_specs=[
+            pl.BlockSpec((None, None, rep, d), q_map),
+            pl.BlockSpec((None, None, bt, d), kv_map),
+            pl.BlockSpec((None, None, bt, d), kv_map),
+        ],
+        out_specs=out_specs,
+        scratch_shapes=scratch,
+    )
+    res = pl.pallas_call(
+        functools.partial(
+            _decode_attn_kernel,
+            scale=d**-0.5, block_t=bt, t=t, num_t=num_t,
+            with_stats=return_stats,
+        ),
+        grid_spec=grid_spec,
+        out_shape=out_shape,
+        compiler_params=compiler_params(
+            dimension_semantics=("parallel", "parallel", "arbitrary")
+        ),
+        interpret=interp,
+    )(lens.astype(jnp.int32), q4, kt_, vt_)
+    out = res[0].reshape(s_, h, d)
+    if return_stats:
+        return out, res[1].reshape(s_, nkv)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# (c) fused speculative verify (ring + in-register tail, one pass)
+# ---------------------------------------------------------------------------
+
+
+def _spec_tail_kernel(
+    lens_ref, q_ref, k_ref, v_ref, tk_ref, tv_ref, o_ref, *rest,
+    scale, q_start, block_t, t, num_t, rep, with_stats,
+):
+    if with_stats:
+        stats_ref, m_scr, l_scr, acc_scr, cnt_scr = rest
+    else:
+        (m_scr, l_scr, acc_scr), cnt_scr = rest, None
+    kq, _, d = q_ref.shape
+    kt = tk_ref.shape[0]
+    si, ti = pl.program_id(0), pl.program_id(2)
+
+    @pl.when(ti == 0)
+    def _init():
+        m_scr[:] = jnp.full((rep, kq, 1), NEG_INF, jnp.float32)
+        l_scr[:] = jnp.zeros((rep, kq, 1), jnp.float32)
+        acc_scr[:] = jnp.zeros((rep, kq, d), jnp.float32)
+        if with_stats:
+            cnt_scr[:] = jnp.zeros((1, 1), jnp.int32)
+
+    lens_s = lens_ref[si]
+    # pre-tail ring liveness is idx < lens (strict: the tail's own K/V is
+    # in-register, not the ring) — or the whole ring once lens >= t
+    last_ring = jnp.where(
+        lens_s >= t, num_t - 1, jnp.maximum(lens_s - 1, 0) // block_t
+    )
+    ring_on = (lens_s >= t) | ((lens_s > 0) & (ti <= last_ring))
+
+    @pl.when((ti < num_t) & ring_on)
+    def _ring_step():
+        k_blk = k_ref[:]  # [block_t, d]
+        v_blk = v_ref[:]
+        idx = ti * block_t + jax.lax.broadcasted_iota(
+            jnp.int32, (kq, block_t), 1
+        )
+        qi = jax.lax.broadcasted_iota(jnp.int32, (kq, block_t), 0)
+        j = q_start + qi
+        base = (idx < lens_s) | (lens_s >= t)
+        # disp = the i whose tail ring write ((lens+i) % T) lands on this
+        # slot; query j has evicted it when that write precedes j and wraps
+        disp = jnp.mod(idx - lens_s, t)
+        evicted = (disp <= j) & ((lens_s + disp) >= t)
+        valid = base & ~evicted  # [kq, block_t], same for every q head
+        for r in range(rep):
+            q_r = q_ref[:, r, :]  # [kq, d]
+            s = scale * jax.lax.dot_general(
+                q_r, k_blk, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            s = jnp.where(valid, s, NEG_INF)
+            m_prev, l_prev, acc = m_scr[r], l_scr[r], acc_scr[r]
+            m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+            p = jnp.exp(s - m_new)
+            corr = jnp.exp(m_prev - m_new)
+            m_scr[r] = m_new
+            l_scr[r] = l_prev * corr + jnp.sum(p, axis=1, keepdims=True)
+            acc_scr[r] = acc * corr + jax.lax.dot_general(
+                p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+        if with_stats:
+            cnt_scr[0, 0] += 1
+
+    @pl.when(ti == num_t)
+    def _tail_step():
+        tk_blk = tk_ref[:]  # [kt, d]
+        tv_blk = tv_ref[:]
+        qi = q_start + jax.lax.broadcasted_iota(jnp.int32, (kq, kt), 0)
+        ki = jax.lax.broadcasted_iota(jnp.int32, (kq, kt), 1)
+        valid = ki <= qi  # causal within the tail
+        for r in range(rep):
+            q_r = q_ref[:, r, :]
+            s = scale * jax.lax.dot_general(
+                q_r, tk_blk, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            s = jnp.where(valid, s, NEG_INF)
+            m_prev, l_prev, acc = m_scr[r], l_scr[r], acc_scr[r]
+            m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+            p = jnp.exp(s - m_new)
+            corr = jnp.exp(m_prev - m_new)
+            l_new = l_prev * corr + jnp.sum(p, axis=1, keepdims=True)
+            acc = acc * corr + jax.lax.dot_general(
+                p.astype(tv_blk.dtype), tv_blk, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            # the tail always holds at least the query's own position, so
+            # l_new > 0; the guard mirrors the flash kernel's finish
+            l_safe = jnp.where(l_new == 0, 1.0, l_new)
+            o_ref[:, r, :] = (acc / l_safe).astype(o_ref.dtype)
+        if with_stats:
+            stats_ref[0, 0] = cnt_scr[0, 0]
+
+
+def spec_tail_attention_fused(
+    q: jax.Array,
+    cache_k: jax.Array,
+    cache_v: jax.Array,
+    tail_k: jax.Array,
+    tail_v: jax.Array,
+    lens: jax.Array,
+    *,
+    q_start: int = 0,
+    block_t: int | None = None,
+    interpret: bool | None = None,
+    return_stats: bool = False,
+):
+    """Drop-in :func:`~opendiloco_tpu.ops.attention.spec_tail_attention`:
+    q [S, Kq, H, D] over ring pages plus tail K/V [S, Kt, Kh, D], one
+    online-softmax pass, exact ring-wrap eviction semantics."""
+    s_, t, nkv, d = cache_k.shape
+    kq, h = q.shape[1], q.shape[2]
+    kt = tail_k.shape[1]
+    if d % 8 != 0 or h % nkv != 0:
+        out = spec_tail_attention(
+            q, cache_k, cache_v, tail_k, tail_v, lens, q_start=q_start
+        )
+        return (out, None) if return_stats else out
+    rep = h // nkv
+    bt = _ring_block(t, block_t)
+    num_t = t // bt
+    interp = _interpret(interpret)
+
+    # same Mosaic tiling story as paged_decode_attention: kv-head and rep
+    # axes are tiny, so they must be array dims of their own — caches and
+    # tail as [S, Kh, T|Kt, D], q as [S, Kq, Kh, rep, D]. Kernel refs keep
+    # the exact shapes the untransposed layout produced.
+    ckt = cache_k.transpose(0, 2, 1, 3)
+    cvt = cache_v.transpose(0, 2, 1, 3)
+    tkt = tail_k.transpose(0, 2, 1, 3)
+    tvt = tail_v.transpose(0, 2, 1, 3)
+    q5 = q.reshape(s_, kq, nkv, rep, d)
+
+    def kv_map(si, hi, ti, lens_ref):
+        last = jnp.where(
+            lens_ref[si] >= t, num_t - 1,
+            jnp.maximum(lens_ref[si] - 1, 0) // bt,
+        )
+        return (si, hi, jnp.minimum(ti, last), 0)
+
+    def q_map(si, hi, ti, lr):
+        return (si, 0, hi, 0, 0)
+
+    def tail_map(si, hi, ti, lr):
+        return (si, hi, 0, 0)
+
+    out_specs = [pl.BlockSpec((None, kq, None, rep, d), q_map)]
+    out_shape = [sds((s_, kq, nkv, rep, d), q.dtype, vma=out_vma(q))]
+    scratch = [
+        pltpu.VMEM((rep, kq, 1), jnp.float32),
+        pltpu.VMEM((rep, kq, 1), jnp.float32),
+        pltpu.VMEM((rep, kq, d), jnp.float32),
+    ]
+    if return_stats:
+        out_specs.append(
+            pl.BlockSpec((None, None, 1, 1), lambda si, hi, ti, lr: (si, hi, 0, 0))
+        )
+        out_shape.append(sds((s_, nkv, 1, 1), jnp.int32))
+        scratch.append(pltpu.VMEM((1, 1), jnp.int32))
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(s_, nkv, num_t + 1),  # ring blocks, then the tail block
+        in_specs=[
+            pl.BlockSpec((None, kq, None, rep, d), q_map),
+            pl.BlockSpec((None, None, bt, d), kv_map),
+            pl.BlockSpec((None, None, bt, d), kv_map),
+            pl.BlockSpec((None, None, kt, d), tail_map),
+            pl.BlockSpec((None, None, kt, d), tail_map),
+        ],
+        out_specs=out_specs,
+        scratch_shapes=scratch,
+    )
+    res = pl.pallas_call(
+        functools.partial(
+            _spec_tail_kernel,
+            scale=d**-0.5, q_start=int(q_start), block_t=bt, t=t,
+            num_t=num_t, rep=rep, with_stats=return_stats,
+        ),
+        grid_spec=grid_spec,
+        out_shape=out_shape,
+        compiler_params=compiler_params(
+            dimension_semantics=("parallel", "parallel", "arbitrary")
+        ),
+        interpret=interp,
+    )(lens.astype(jnp.int32), q5, ckt, cvt, tkt, tvt)
+    out = res[0].reshape(s_, kq, h, d)
+    if return_stats:
+        return out, res[1].reshape(s_, nkv)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# (b) fused W4 dequant-matmul
+# ---------------------------------------------------------------------------
+
+
+def w4_matmul_supported(shape) -> bool:
+    """Shapes the fused kernel tiles: a 2-D weight with an even column
+    count (nibble pairs pack along rows). Others keep the XLA dequant."""
+    return len(shape) == 2 and int(shape[1]) % 2 == 0 and int(shape[1]) > 0
+
+
+def _w4_kernel(
+    x_ref, qb_ref, sarr_ref, hoff_ref, oe_ref, oo_ref, ae_scr, ao_scr,
+    *, num_k, n_sel, n_half,
+):
+    ki = pl.program_id(1)
+    bk = qb_ref.shape[0]
+
+    @pl.when(ki == 0)
+    def _init():
+        ae_scr[:] = jnp.zeros_like(ae_scr)
+        ao_scr[:] = jnp.zeros_like(ao_scr)
+
+    # [bk, N/2] packed bytes, widened to i32 — Mosaic has no u8 bitwise
+    # ops, and the values (0..255) are exact in any wider int
+    b = qb_ref[:].astype(jnp.int32)
+    # element 2j of a row is the LOW nibble of byte j (the
+    # native._dequant4_numpy order), value = (nibble - 8) * fp16(scale)/7
+    lo = (b & 0x0F).astype(jnp.float32) - 8.0
+    hi = (b >> 4).astype(jnp.float32) - 8.0
+    # scale of columns (2j, 2j+1) in row k: flat block (off_k + 2j) //
+    # 4096 == (hoff_k + j) // 2048 — a pair never straddles a boundary
+    # (offsets are even), so even/odd planes share one scale field
+    jidx = jax.lax.broadcasted_iota(jnp.int32, (bk, n_half), 1)
+    nj = (hoff_ref[:] + jidx) // (W4_BLOCK // 2)
+    scale = jnp.zeros((bk, n_half), jnp.float32)
+    for j in range(n_sel):
+        scale = jnp.where(nj == j, sarr_ref[:, j][:, None], scale)
+    x = x_ref[:]
+    we = (lo * scale).astype(x.dtype)
+    wo = (hi * scale).astype(x.dtype)
+    ae_scr[:] += jax.lax.dot_general(
+        x, we, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    ao_scr[:] += jax.lax.dot_general(
+        x, wo, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+
+    @pl.when(ki == num_k - 1)
+    def _finish():
+        oe_ref[:] = ae_scr[:].astype(oe_ref.dtype)
+        oo_ref[:] = ao_scr[:].astype(oo_ref.dtype)
+
+
+def w4_matmul(
+    x: jax.Array,
+    q: jax.Array,
+    s: jax.Array,
+    shape,
+    dtype,
+    *,
+    block_k: int | None = None,
+    block_m: int | None = None,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """``x [M, K] @ dequant(q, s, (K, N))`` without materializing the f32
+    weight: nibbles dequantize in-registers per [block_k, N] tile.
+
+    ``q`` is the per-layer packed stream ([K*N/2] uint8, row-major nibble
+    pairs) and ``s`` the [ceil(K*N/4096)] uint16 fp16-bit scales — the
+    PackedW4 leaf layout. The per-row scale candidates (each row of W
+    touches at most a couple of 4096-element flat blocks) are gathered
+    outside the kernel into a [K, n_sel] f32 side table, so the kernel
+    selects scales with a static chain of lane-wise wheres — no gather,
+    no relayout. With x = I the output is bit-for-bit ``dequant_w4``
+    (tests pin this), so the fused path inherits the codec's exactness."""
+    K, N = (int(v) for v in shape)
+    M = x.shape[0]
+    if not w4_matmul_supported(shape):
+        raise ValueError(f"w4_matmul cannot tile weight shape {shape}")
+    nb = s.shape[0]
+    n_half = N // 2
+    half_block = W4_BLOCK // 2
+    bk = block_k or pick_block(K, 256) or K
+    if K % bk:
+        bk = K
+    bm = block_m or pick_block(M, 256) or M
+    if M % bm:
+        bm = M
+    num_k, num_m = K // bk, M // bm
+    # host-side prep (tiny): per-row flat-block offsets + scale candidates
+    rows = jnp.arange(K, dtype=jnp.int32)
+    base = (rows * N) // W4_BLOCK
+    hoff = ((rows * N) % W4_BLOCK) // 2  # [K] half-offsets (pairs)
+    n_sel = (half_block - 1 + n_half - 1) // half_block + 1
+    sf = jax.lax.bitcast_convert_type(s, jnp.float16).astype(jnp.float32)
+    sf = sf / jnp.float32(7.0)
+    cand = jnp.clip(
+        base[:, None] + jnp.arange(n_sel, dtype=jnp.int32)[None], 0, nb - 1
+    )
+    sarr = sf[cand]  # [K, n_sel]
+    qb = q[: K * n_half].reshape(K, n_half)
+    x2 = x.astype(dtype)
+
+    oe, oo = pl.pallas_call(
+        functools.partial(
+            _w4_kernel, num_k=num_k, n_sel=n_sel, n_half=n_half
+        ),
+        grid=(num_m, num_k),
+        in_specs=[
+            pl.BlockSpec((bm, bk), lambda mi, ki: (mi, ki)),
+            pl.BlockSpec((bk, n_half), lambda mi, ki: (ki, 0)),
+            pl.BlockSpec((bk, n_sel), lambda mi, ki: (ki, 0)),
+            pl.BlockSpec((bk, 1), lambda mi, ki: (ki, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((bm, n_half), lambda mi, ki: (mi, 0)),
+            pl.BlockSpec((bm, n_half), lambda mi, ki: (mi, 0)),
+        ],
+        out_shape=[
+            sds((M, n_half), dtype, vma=out_vma(x)),
+            sds((M, n_half), dtype, vma=out_vma(x)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bm, n_half), jnp.float32),
+            pltpu.VMEM((bm, n_half), jnp.float32),
+        ],
+        compiler_params=compiler_params(
+            dimension_semantics=("parallel", "arbitrary")
+        ),
+        interpret=_interpret(interpret),
+    )(x2, qb, sarr, hoff[:, None])
+    # re-interleave the even/odd column planes: [M, N/2, 2] -> [M, N]
+    return jnp.stack([oe, oo], axis=-1).reshape(M, N)

@@ -35,6 +35,12 @@ import jax
 import jax.numpy as jnp
 from jax.ad_checkpoint import checkpoint_name
 
+from opendiloco_tpu.ops.pallas_util import (
+    axis_size as _axis_size,
+    pcast_varying as _pcast_varying,
+    shard_map as _shard_map,
+)
+
 _NEG_INF = float(-1e30)
 
 # mesh registry: the trainer configures this so model code can stay
@@ -103,7 +109,10 @@ def _ring_vma(axis_name: str, ref) -> frozenset:
     kernel outputs must carry the full type from step 0 or the scan's
     carry types mismatch."""
     try:
-        extra = getattr(jax.typeof(ref), "vma", frozenset()) or frozenset()
+        typeof = getattr(jax, "typeof", None)  # newer-jax only
+        extra = (
+            getattr(typeof(ref), "vma", frozenset()) if typeof else frozenset()
+        ) or frozenset()
     except Exception:  # pragma: no cover - tracing-context quirks
         extra = frozenset()
     return frozenset(extra) | {axis_name}
@@ -116,7 +125,7 @@ def _ring_forward(q, k, v, axis_name, causal):
     qg = _grouped(q, hkv)
 
     idx = jax.lax.axis_index(axis_name)
-    n = jax.lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     q_pos = idx * tl + jnp.arange(tl, dtype=jnp.int32)
     perm = [(i, (i + 1) % n) for i in range(n)]
 
@@ -140,9 +149,7 @@ def _ring_forward(q, k, v, axis_name, causal):
     # stats become device-varying after the first accumulation step; the scan
     # carry must have that type from the start (including any outer manual
     # axes when nested in the pp pipeline)
-    m0, l0, acc0 = jax.lax.pcast(
-        (m0, l0, acc0), tuple(sorted(_ring_vma(axis_name, q))), to="varying"
-    )
+    m0, l0, acc0 = _pcast_varying((m0, l0, acc0), _ring_vma(axis_name, q))
     (_, _, m, l, acc), _ = jax.lax.scan(
         step, (k, v, m0, l0, acc0), jnp.arange(n), length=n
     )
@@ -197,7 +204,7 @@ def _ring_bwd(axis_name, causal, res, dout):
     ).transpose(0, 2, 3, 1)[..., None]
 
     idx = jax.lax.axis_index(axis_name)
-    n = jax.lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     q_pos = idx * tl + jnp.arange(tl, dtype=jnp.int32)
     perm = [(i, (i + 1) % n) for i in range(n)]
 
@@ -241,9 +248,7 @@ def _ring_bwd(axis_name, causal, res, dout):
     dk0 = jnp.zeros((b, tl, hkv, d), jnp.float32)
     dv0 = jnp.zeros_like(dk0)
     dq0 = jnp.zeros((b, tl, hkv, hq // hkv, d), jnp.float32)
-    dk0, dv0, dq0 = jax.lax.pcast(
-        (dk0, dv0, dq0), tuple(sorted(_ring_vma(axis_name, q))), to="varying"
-    )
+    dk0, dv0, dq0 = _pcast_varying((dk0, dv0, dq0), _ring_vma(axis_name, q))
     (_, _, dk, dv, dq), _ = jax.lax.scan(
         step, (k, v, dk0, dv0, dq0), jnp.arange(n), length=n
     )
@@ -278,7 +283,7 @@ def _ring_flash_forward(q, k, v, axis_name, block):
     vma = _ring_vma(axis_name, q)
 
     idx = jax.lax.axis_index(axis_name)
-    n = jax.lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     perm = [(i, (i + 1) % n) for i in range(n)]
 
     # step 0: own (diagonal) chunk, standard causal flash -- guarantees a
@@ -346,7 +351,7 @@ def _ring_flash_bwd(axis_name, block, res, dout):
     delta = _delta(doT, oT)
 
     idx = jax.lax.axis_index(axis_name)
-    n = jax.lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     perm = [(i, (i + 1) % n) for i in range(n)]
 
     kwargs = dict(
@@ -408,7 +413,7 @@ def _flash_chunk_block(mesh, axis: str, q, causal: bool, local: bool = False) ->
         dev = mesh.devices.flat[0]
         if "tpu" not in getattr(dev, "device_kind", "").lower():
             return 0
-    from opendiloco_tpu.ops.flash_attention import _pick_block
+    from opendiloco_tpu.ops.pallas_util import pick_block as _pick_block
 
     n = mesh.shape[axis]
     tl = q.shape[1] // n if not local else q.shape[1]
@@ -463,7 +468,7 @@ def ring_attention_auto(
         # lower in the forward but has no jvp lowering (Shardy rejects
         # re-binding the outer axis; GSPMD check-fails)
         return body(q, k, v)
-    fn = jax.shard_map(
+    fn = _shard_map(
         body,
         mesh=mesh,
         in_specs=(spec, spec, spec),

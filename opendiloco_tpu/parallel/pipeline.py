@@ -34,6 +34,8 @@ from opendiloco_tpu.models.llama import (
     RematPolicy,
 )
 from opendiloco_tpu.ops.attention import xla_attention
+from opendiloco_tpu.ops.pallas_util import axis_size as _axis_size
+from opendiloco_tpu.ops.pallas_util import shard_map as _shard_map
 
 
 def pipeline_hidden(
@@ -93,7 +95,7 @@ def pipeline_hidden(
     pos_spec = P(None, None, sp_axis) if sp_axis else P()
 
     @functools.partial(
-        jax.shard_map,
+        _shard_map,
         mesh=mesh,
         in_specs=(layer_specs, hs_spec, pos_spec),
         out_specs=(hs_spec, P()),
@@ -101,7 +103,7 @@ def pipeline_hidden(
     )
     def _pipeline(layers_local, hs, mb_positions):
         r = jax.lax.axis_index(axis)
-        n = jax.lax.axis_size(axis)
+        n = _axis_size(axis)
         perm = [(i, i + 1) for i in range(n - 1)]  # stage r -> r+1, no wrap
 
         def stage(x, pos):
@@ -136,7 +138,10 @@ def pipeline_hidden(
         def to_varying(x):
             # only the axes x is not ALREADY varying over: zeros_like on the
             # sp-sharded hs inherits {V:sp}, and pcast rejects mixed states
-            vma = getattr(jax.typeof(x), "vma", frozenset()) or frozenset()
+            typeof = getattr(jax, "typeof", None)
+            if typeof is None:  # pre-vma jax: no varying typing to establish
+                return x
+            vma = getattr(typeof(x), "vma", frozenset()) or frozenset()
             missing = tuple(a for a in manual_axes if a not in vma)
             return jax.lax.pcast(x, missing, to="varying") if missing else x
 
@@ -156,7 +161,7 @@ def pipeline_hidden(
         if sp_axis is not None:
             # chunk-local router stats: mean over sequence chunks, and the
             # P() out_spec needs the value invariant over sp
-            aux = jax.lax.psum(aux, sp_axis) / jax.lax.axis_size(sp_axis)
+            aux = jax.lax.psum(aux, sp_axis) / _axis_size(sp_axis)
         return outs, aux
 
     outs, moe_aux = _pipeline(cparams["layers"], hs, mb_positions)

@@ -86,6 +86,8 @@ def build_serving(
     spec_k = int(env_k) if env_k else serve_cfg.spec_decode_k
     env_wf = os.environ.get("ODTP_DECODE_WEIGHT_FORMAT")
     weight_format = env_wf if env_wf else serve_cfg.weight_format
+    env_dk = os.environ.get("ODTP_DECODE_KERNEL")
+    decode_kernel = env_dk if env_dk else serve_cfg.decode_kernel
     engine = ServeEngine(
         model_cfg,
         params,
@@ -100,6 +102,7 @@ def build_serving(
         spec_k=spec_k,
         draft_layers=serve_cfg.draft_layers,
         weight_format=weight_format,
+        decode_kernel=decode_kernel,
     )
     batcher = ContinuousBatcher(
         engine,

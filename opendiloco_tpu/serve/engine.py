@@ -59,6 +59,7 @@ from opendiloco_tpu.models.llama import (
     PackedW4,
     cache_insert,
     decode_forward,
+    dequant_w4,
     draft_propose,
     init_kv_cache,
     prefill_forward,
@@ -66,6 +67,13 @@ from opendiloco_tpu.models.llama import (
     spec_cache_insert,
     suffix_insert,
     verify_forward,
+)
+from opendiloco_tpu.ops.attention import decode_attention, spec_tail_attention
+from opendiloco_tpu.ops.decode_kernels import (
+    paged_decode_attention,
+    resolve_decode_kernel,
+    spec_tail_attention_fused,
+    w4_matmul,
 )
 from opendiloco_tpu.serve.kvcache import accept_counts, pick_bucket
 
@@ -102,6 +110,7 @@ class ServeEngine:
         spec_k: int = 0,
         draft_layers: int = 0,
         weight_format: str = "fp32",
+        decode_kernel: Optional[str] = None,
     ):
         self.cfg = cfg
         self.num_slots = int(num_slots)
@@ -117,6 +126,9 @@ class ServeEngine:
         self.weight_format = str(weight_format)
         if self.weight_format not in ("fp32", "w4"):
             raise ValueError(f"unknown weight_format {weight_format!r}")
+        # "auto"/None resolves to pallas only on TPU backends; tests force
+        # "pallas" explicitly and the kernels run interpreted off-TPU
+        self.decode_kernel = resolve_decode_kernel(decode_kernel)
         self.spec_k = int(spec_k)
         if self.spec_k < 0:
             raise ValueError(f"spec_k must be >= 0, got {spec_k}")
@@ -163,9 +175,12 @@ class ServeEngine:
         self.cache_k, self.cache_v = cache["k"], cache["v"]
 
         cd = compute_dtype
+        dkn = self.decode_kernel
 
         def _prefill(p, ids, length):
-            logits, ks, vs = prefill_forward(p, ids, length, cfg, compute_dtype=cd)
+            logits, ks, vs = prefill_forward(
+                p, ids, length, cfg, compute_dtype=cd, decode_kernel=dkn
+            )
             return jnp.argmax(logits, axis=-1).astype(jnp.int32), logits, ks, vs
 
         def _insert(ck, cv, ks, vs, slot):
@@ -173,7 +188,8 @@ class ServeEngine:
 
         def _decode(p, tokens, lens, ck, cv):
             logits, ck, cv = decode_forward(
-                p, tokens, lens, ck, cv, cfg, compute_dtype=cd
+                p, tokens, lens, ck, cv, cfg, compute_dtype=cd,
+                decode_kernel=dkn,
             )
             return jnp.argmax(logits, axis=-1).astype(jnp.int32), logits, ck, cv
 
@@ -189,11 +205,13 @@ class ServeEngine:
             return draft_propose(
                 p, tokens, lens, ck, cv, cfg,
                 k_steps=kk, draft_layers=ld, compute_dtype=cd,
+                decode_kernel=dkn,
             )
 
         def _verify(p, tail, lens, ck, cv):
             logits, tks, tvs = verify_forward(
-                p, tail, lens, ck, cv, cfg, compute_dtype=cd
+                p, tail, lens, ck, cv, cfg, compute_dtype=cd,
+                decode_kernel=dkn,
             )
             return jnp.argmax(logits, axis=-1).astype(jnp.int32), tks, tvs
 
@@ -218,7 +236,8 @@ class ServeEngine:
             page_k = jnp.take(ck, slot, axis=1)[:, None]  # [L, 1, T, Kh, Dh]
             page_v = jnp.take(cv, slot, axis=1)[:, None]
             logits, tks, tvs = verify_forward(
-                p, tail, plen[None], page_k, page_v, cfg, compute_dtype=cd
+                p, tail, plen[None], page_k, page_v, cfg, compute_dtype=cd,
+                decode_kernel=dkn,
             )
             return logits[0], tks[:, 0], tvs[:, 0]
 
@@ -344,6 +363,7 @@ class ServeEngine:
         )
         tok = np.asarray(tok)
         self.stage_seconds["decode"] += time.perf_counter() - t0
+        obs.count(f"serve_decode_kernel_{self.decode_kernel}")
         return tok, logits
 
     def _propose_draft(self, tokens: np.ndarray, lens: np.ndarray) -> np.ndarray:
@@ -393,12 +413,87 @@ class ServeEngine:
         self.stage_seconds["draft"] += t1 - t0
         self.stage_seconds["verify"] += t2 - t1
         self.stage_seconds["insert"] += t3 - t2
+        obs.count(f"serve_decode_kernel_{self.decode_kernel}")
         tr = obs.tracer()
         if tr is not None:
             tr.add_span("serve_draft", t0, t1, k=self.spec_k)
             tr.add_span("serve_verify", t1, t2)
             tr.add_span("serve_spec_insert", t2, t3)
         return g, m
+
+    # -- kernel attribution -------------------------------------------------
+
+    def kernel_probe(self, iters: int = 3) -> dict:
+        """Time the decode-path kernels in isolation on the engine's live
+        shapes and publish per-kernel gauges (serve_decode_attn_us,
+        serve_verify_attn_us, serve_w4_matmul_us) so DECODE_BENCH
+        attribution shows where the kernel time went, per dispatch path.
+
+        Best-of-``iters`` steady-state timings on the resolved path
+        (``self.decode_kernel``); the w4 gauge only appears under
+        ``weight_format=w4`` (there is no dequant-matmul otherwise)."""
+        cfg, cd = self.cfg, self.compute_dtype
+        S, T = self.num_slots, self.max_context
+        Nh, Nkv, Dh = cfg.num_attention_heads, cfg.kv_heads, cfg.head_dim
+        key = jax.random.PRNGKey(0)
+        q1 = jax.random.normal(key, (S, Nh, Dh), cd)
+        ck, cv = self.cache_k[0], self.cache_v[0]  # live layer-0 ring pages
+        lens = jnp.full((S,), T // 2, jnp.int32)
+        kq = self.tail_width
+        qt = jax.random.normal(key, (S, kq, Nh, Dh), cd)
+        tk = jax.random.normal(key, (S, kq, Nkv, Dh), cd)
+        pallas = self.decode_kernel == "pallas"
+
+        def _attn(q1, ck, cv, lens):
+            if pallas:
+                return paged_decode_attention(q1, ck, cv, lens)
+            return decode_attention(q1, ck, cv, lens)
+
+        def _vattn(qt, ck, cv, tk, lens):
+            if pallas:
+                return spec_tail_attention_fused(qt, ck, cv, tk, tk, lens)
+            return spec_tail_attention(qt, ck, cv, tk, tk, lens)
+
+        def _best(fn, *argv):
+            f = jax.jit(fn)
+            f(*argv).block_until_ready()  # compile outside the timing
+            best = float("inf")
+            for _ in range(max(1, int(iters))):
+                t0 = time.perf_counter()
+                f(*argv).block_until_ready()
+                best = min(best, time.perf_counter() - t0)
+            return best * 1e6
+
+        out = {
+            "decode_attn_us": _best(_attn, q1, ck, cv, lens),
+            "verify_attn_us": _best(_vattn, qt, ck, cv, tk, lens),
+        }
+        packed = next(
+            (
+                w
+                for w in jax.tree.leaves(
+                    self.params, is_leaf=lambda x: isinstance(x, PackedW4)
+                )
+                if isinstance(w, PackedW4) and len(w.shape) == 2
+            ),
+            None,
+        )
+        if packed is not None:
+            x = jax.random.normal(key, (S, packed.shape[0]), cd)
+            if pallas:
+                def _wmm(x, q, s):
+                    return w4_matmul(x, q, s, packed.shape, cd)
+            else:
+                def _wmm(x, q, s):
+                    return x @ dequant_w4(q, s, packed.shape, cd)
+            # stacked leaf: layer 0's slice is what one scan step sees
+            out["w4_matmul_us"] = _best(_wmm, x, packed.q[0], packed.s[0])
+        for name, us in out.items():
+            obs.gauge(f"serve_{name}", us)
+        obs.gauge(
+            "serve_decode_kernel_pallas", 1.0 if pallas else 0.0
+        )
+        return out
 
     # -- weight hot-swap ---------------------------------------------------
 

@@ -104,6 +104,7 @@ class ContinuousBatcher:
         self.gauge_every_steps = max(1, int(gauge_every_steps))
         self.prefix_cache = bool(prefix_cache)
         self.spec_decode = engine.spec_k > 0
+        self._kernel_probed = False
         self.slots = SlotAllocator(engine.num_slots)
         self._active: dict[int, _Slot] = {}  # slot id -> state
         self._queue: collections.deque[Request] = collections.deque()
@@ -508,6 +509,7 @@ class ContinuousBatcher:
                     rt.span(
                         st.req.trace, "decode", max(t0, st.req.t_first), t1,
                         batch=batch, tokens=1,
+                        kernel=self.engine.decode_kernel,
                     )
         for slot in done_slots:
             self.slots.free(slot)
@@ -563,6 +565,7 @@ class ContinuousBatcher:
                         st.req.trace, "decode", max(t0, st.req.t_first), t1,
                         batch=batch, tokens=emitted_by_slot[slot],
                         proposed=self.engine.spec_k, accepted=int(m[slot]),
+                        kernel=self.engine.decode_kernel,
                     )
         obs.count("serve_tokens_generated", emitted)
         for slot in done_slots:
@@ -617,6 +620,15 @@ class ContinuousBatcher:
     # -- metrics -----------------------------------------------------------
 
     def _publish_gauges(self) -> None:
+        if not self._kernel_probed:
+            # one-time per-kernel isolation probe on the live shapes (the
+            # path and shapes are fixed per process, so once is enough);
+            # attribution only — never take down the serving loop
+            self._kernel_probed = True
+            try:
+                self.engine.kernel_probe()
+            except Exception:
+                obs.count("serve_kernel_probe_errors")
         lat = np.asarray(self._latencies, np.float64)
         if lat.size:
             obs.gauge("serve_p50_ms", float(np.percentile(lat, 50)) * 1e3)

@@ -516,6 +516,27 @@ def merge_report(trace_dir: str) -> tuple[dict, dict]:
             serve["spec_acceptance"] = round(
                 serve_counters.get("serve_spec_accepted", 0) / proposed, 4
             )
+        # decode-kernel attribution: engine steps by dispatch path plus the
+        # batcher's one-shot per-kernel isolation probe (µs on live shapes)
+        kernel_steps = {
+            k[len("serve_decode_kernel_"):]: counters[k]
+            for k in sorted(counters)
+            if k.startswith("serve_decode_kernel_")
+        }
+        probe_us: dict[str, float] = {}
+        for _wid, _events, meta in workers:
+            for k, v in (meta.get("gauges") or {}).items():
+                if k in (
+                    "serve_decode_attn_us",
+                    "serve_verify_attn_us",
+                    "serve_w4_matmul_us",
+                ):
+                    probe_us[k[len("serve_"):]] = round(float(v), 2)
+        if kernel_steps or probe_us:
+            serve["decode_kernel"] = {
+                **({"steps_by_path": kernel_steps} if kernel_steps else {}),
+                **({"probe_us": probe_us} if probe_us else {}),
+            }
 
     # WAN/intra byte split. The transport classifies every frame against the
     # round's site map (no map -> everything is WAN, conservatively), so the

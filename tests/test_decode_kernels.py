@@ -1,0 +1,301 @@
+"""Pallas serving-kernel parity tests (ops/decode_kernels.py).
+
+The dispatch contract is token-bit-exact: `ODTP_DECODE_KERNEL=pallas`
+must emit exactly the token stream the stock XLA path emits. On this
+CPU rig the kernels run in Pallas interpret mode — slower, but it is the
+kernel's own dataflow (masks, online softmax, in-register dequant), so
+parity pinned here carries to the Mosaic lowering.
+
+Oracles:
+- paged decode attention matches ``decode_attention`` over ragged lens
+  (empty slot, mid-page, last row, lens >= T sliding window) and every
+  GQA head ratio the configs use — and its stats variant proves dead
+  ring blocks are skipped, not masked
+- the fused speculative verify matches ``spec_tail_attention``'s exact
+  ring-wrap eviction mask, across ``q_start`` offsets and the draft's
+  wide-tail (Kq=1) shape
+- the fused W4 matmul with x = I is bit-for-bit ``dequant_w4`` (element
+  order + per-4096-block f16-scale math), odd-N shapes fall back to the
+  XLA dequant, and partial tail scale blocks dequantize correctly
+- ``auto`` never selects Pallas off-TPU
+- engine-level: identical token streams xla vs pallas(interpret) across
+  prefill buckets, ring wrap, w4 residency, and speculative decode —
+  including under the continuous batcher
+"""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from opendiloco_tpu.diloco.compression import pack_blockwise4_stacked
+from opendiloco_tpu.models.llama import PackedW4, _wmul, dequant_w4, init_params
+from opendiloco_tpu.ops.attention import decode_attention, spec_tail_attention
+from opendiloco_tpu.ops.decode_kernels import (
+    paged_decode_attention,
+    resolve_decode_kernel,
+    spec_tail_attention_fused,
+    w4_matmul,
+    w4_matmul_supported,
+)
+from opendiloco_tpu.serve import ContinuousBatcher, ServeEngine
+
+
+def _rng(seed=0):
+    return np.random.default_rng(seed)
+
+
+def _randn(rng, *shape):
+    return jnp.asarray(rng.standard_normal(shape), jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# (a) ragged paged decode attention
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("heads", [(8, 8), (8, 2), (4, 1), (8, 4)])
+def test_paged_decode_attention_parity(heads):
+    H, Kh = heads
+    S, T, D = 5, 32, 16
+    rng = _rng(H * 31 + Kh)
+    q, k, v = _randn(rng, S, H, D), _randn(rng, S, T, Kh, D), _randn(rng, S, T, Kh, D)
+    # ragged: empty slot, mid-page, last live row, exactly T, wrapped
+    lens = jnp.asarray([0, 5, T - 1, T, 2 * T + 3], jnp.int32)
+    ref = decode_attention(q, k, v, lens)
+    out = paged_decode_attention(q, k, v, lens, block_t=8, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-6)
+
+
+def test_paged_decode_attention_skips_dead_blocks():
+    S, T, H, Kh, D = 4, 32, 4, 2, 16
+    rng = _rng(1)
+    q, k, v = _randn(rng, S, H, D), _randn(rng, S, T, Kh, D), _randn(rng, S, T, Kh, D)
+    lens = jnp.asarray([0, 5, 17, 64], jnp.int32)
+    out, stats = paged_decode_attention(
+        q, k, v, lens, block_t=8, interpret=True, return_stats=True
+    )
+    ref = decode_attention(q, k, v, lens)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-6)
+    # processed ring blocks per slot: ceil((min(lens, T-1)+1) / block_t),
+    # the whole page only once lens covers it — dead blocks never ran
+    expected = [1, 1, 3, 4]
+    assert np.asarray(stats).tolist() == [[e] * Kh for e in expected]
+
+
+def test_paged_decode_attention_untileable_head_dim_falls_back():
+    S, T, H, Kh, D = 2, 16, 2, 2, 12  # D % 8 != 0: XLA fallback path
+    rng = _rng(2)
+    q, k, v = _randn(rng, S, H, D), _randn(rng, S, T, Kh, D), _randn(rng, S, T, Kh, D)
+    lens = jnp.asarray([3, 20], jnp.int32)
+    ref = decode_attention(q, k, v, lens)
+    out = paged_decode_attention(q, k, v, lens, interpret=True)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+# ---------------------------------------------------------------------------
+# (c) fused speculative verify
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("q_start", [0, 1, 3])
+@pytest.mark.parametrize("heads", [(8, 2), (4, 1), (4, 4)])
+def test_spec_tail_fused_parity(heads, q_start):
+    H, Kh = heads
+    S, T, Kq, D = 5, 32, 3, 16
+    Kt = Kq + q_start  # tail holds earlier draft rows before the queries
+    rng = _rng(q_start * 17 + H)
+    q = _randn(rng, S, Kq, H, D)
+    ck, cv = _randn(rng, S, T, Kh, D), _randn(rng, S, T, Kh, D)
+    tk, tv = _randn(rng, S, Kt, Kh, D), _randn(rng, S, Kt, Kh, D)
+    lens = jnp.asarray([0, 5, T - 2, T, 2 * T + 1], jnp.int32)
+    ref = spec_tail_attention(q, ck, cv, tk, tv, lens, q_start=q_start)
+    out = spec_tail_attention_fused(
+        q, ck, cv, tk, tv, lens, q_start=q_start, block_t=8, interpret=True
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-6)
+
+
+def test_spec_tail_fused_draft_shape():
+    # the draft calls with one query against a k_steps-wide tail buffer
+    S, T, H, Kh, D, k_steps = 3, 16, 4, 2, 16, 3
+    rng = _rng(7)
+    q = _randn(rng, S, 1, H, D)
+    ck, cv = _randn(rng, S, T, Kh, D), _randn(rng, S, T, Kh, D)
+    tk, tv = _randn(rng, S, k_steps, Kh, D), _randn(rng, S, k_steps, Kh, D)
+    lens = jnp.asarray([0, 9, 2 * T], jnp.int32)
+    for i in range(k_steps):
+        ref = spec_tail_attention(q, ck, cv, tk, tv, lens, q_start=i)
+        out = spec_tail_attention_fused(
+            q, ck, cv, tk, tv, lens, q_start=i, block_t=8, interpret=True
+        )
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-6)
+
+
+# ---------------------------------------------------------------------------
+# (b) fused W4 dequant-matmul
+# ---------------------------------------------------------------------------
+
+
+def _pack2d(rng, K, N):
+    w = rng.standard_normal((1, K, N)).astype(np.float32)
+    q, s = pack_blockwise4_stacked(w)
+    return jnp.asarray(q[0]), jnp.asarray(s[0])
+
+
+@pytest.mark.parametrize(
+    "shape",
+    [
+        (64, 64),     # single scale block
+        (64, 66),     # partial tail scale block (K*N % 4096 != 0)
+        (32, 4128),   # row straddles scale blocks (N > 4096)
+        (8, 8192),    # multiple whole blocks per row
+    ],
+)
+def test_w4_matmul_parity(shape):
+    K, N = shape
+    rng = _rng(K + N)
+    q, s = _pack2d(rng, K, N)
+    x = _randn(rng, 8, K)
+    ref = x @ dequant_w4(q, s, (K, N), jnp.float32)
+    out = w4_matmul(x, q, s, (K, N), jnp.float32, interpret=True)
+    scale = float(jnp.max(jnp.abs(ref))) or 1.0
+    np.testing.assert_allclose(
+        np.asarray(out) / scale, np.asarray(ref) / scale, atol=1e-6
+    )
+
+
+def test_w4_matmul_identity_is_bitwise_dequant():
+    # x = I makes the fused kernel AN implementation of dequant_w4: every
+    # element order / scale-math divergence would show as a bit flip
+    for K, N in [(64, 64), (64, 66), (32, 4128)]:
+        rng = _rng(K * N)
+        q, s = _pack2d(rng, K, N)
+        ref = dequant_w4(q, s, (K, N), jnp.float32)
+        out = w4_matmul(jnp.eye(K, dtype=jnp.float32), q, s, (K, N),
+                        jnp.float32, interpret=True)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_w4_odd_tail_falls_back_to_xla_dequant():
+    # odd N leaves a half-used tail byte; the kernel cannot split such a
+    # weight into even/odd nibble planes, so _wmul keeps the XLA dequant
+    K, N = 16, 7
+    assert not w4_matmul_supported((K, N))
+    rng = _rng(3)
+    w = rng.standard_normal((1, K, N)).astype(np.float32)
+    q, s = pack_blockwise4_stacked(w)
+    leaf = PackedW4(jnp.asarray(q[0]), jnp.asarray(s[0]), (K, N))
+    x = _randn(rng, 4, K)
+    ref = x @ dequant_w4(leaf.q, leaf.s, (K, N), jnp.float32)
+    out = _wmul(x, leaf, jnp.float32, "pallas")
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+# ---------------------------------------------------------------------------
+# dispatch
+# ---------------------------------------------------------------------------
+
+
+def test_auto_never_selects_pallas_off_tpu(monkeypatch):
+    assert jax.default_backend() != "tpu"
+    assert resolve_decode_kernel() == "xla"
+    assert resolve_decode_kernel("auto") == "xla"
+    assert resolve_decode_kernel("xla") == "xla"
+    assert resolve_decode_kernel("pallas") == "pallas"
+    monkeypatch.setenv("ODTP_DECODE_KERNEL", "pallas")
+    assert resolve_decode_kernel() == "pallas"  # env wins when arg unset
+    assert resolve_decode_kernel("xla") == "xla"  # explicit arg wins
+    with pytest.raises(ValueError):
+        resolve_decode_kernel("mosaic")
+
+
+# ---------------------------------------------------------------------------
+# engine-level token parity
+# ---------------------------------------------------------------------------
+
+
+def _make_engine(tiny_cfg, decode_kernel, **kw):
+    params = init_params(jax.random.PRNGKey(0), tiny_cfg)
+    kw.setdefault("num_slots", 2)
+    kw.setdefault("max_context", 24)
+    kw.setdefault("prefill_buckets", (8, 16))
+    kw.setdefault("compute_dtype", jnp.float32)
+    return ServeEngine(tiny_cfg, params, decode_kernel=decode_kernel, **kw)
+
+
+def _generate(engine, prompt, n, slot=0):
+    tok, _ = engine.admit(slot, prompt)
+    toks = [tok]
+    cache_len = len(prompt)
+    S = engine.num_slots
+    for _ in range(n - 1):
+        tokens = np.zeros((S,), np.int32)
+        lens = np.zeros((S,), np.int32)
+        tokens[slot], lens[slot] = toks[-1], cache_len
+        nxt, _ = engine.decode_step(tokens, lens)
+        toks.append(int(nxt[slot]))
+        cache_len += 1
+    return toks
+
+
+@pytest.mark.parametrize("weight_format", ["fp32", "w4"])
+def test_engine_token_streams_identical(tiny_cfg, weight_format):
+    rng = _rng(11)
+    # both prefill buckets, and enough new tokens to wrap the T=24 ring
+    prompts = [rng.integers(1, 256, 5).tolist(), rng.integers(1, 256, 12).tolist()]
+    e_x = _make_engine(tiny_cfg, "xla", weight_format=weight_format)
+    e_p = _make_engine(tiny_cfg, "pallas", weight_format=weight_format)
+    assert (e_x.decode_kernel, e_p.decode_kernel) == ("xla", "pallas")
+    for slot, prompt in enumerate(prompts):
+        tx = _generate(e_x, prompt, 20, slot=slot)
+        tp = _generate(e_p, prompt, 20, slot=slot)
+        assert tx == tp
+
+
+def test_engine_spec_streams_identical(tiny_cfg):
+    e_x = _make_engine(tiny_cfg, "xla", spec_k=2, draft_layers=1)
+    e_p = _make_engine(tiny_cfg, "pallas", spec_k=2, draft_layers=1)
+    rng = _rng(13)
+    prompt = rng.integers(1, 256, 6).tolist()
+    streams = []
+    for eng in (e_x, e_p):
+        tok, _ = eng.admit(0, prompt)
+        toks, lens = [tok], np.asarray([len(prompt), 0], np.int32)
+        cur = np.asarray([tok, 0], np.int32)
+        for _ in range(5):
+            g, m = eng.spec_step(cur, lens)
+            emitted = g[0, : int(m[0]) + 1].tolist()
+            toks.extend(emitted)
+            lens = lens + len(emitted)
+            cur = np.asarray([toks[-1], 0], np.int32)
+        streams.append(toks)
+    assert streams[0] == streams[1]
+
+
+def test_batcher_token_streams_identical(tiny_cfg):
+    rng = _rng(17)
+    prompts = [rng.integers(1, 256, n).tolist() for n in (4, 9, 14)]
+    results = []
+    for kernel in ("xla", "pallas"):
+        engine = _make_engine(tiny_cfg, kernel, num_slots=4)
+        batcher = ContinuousBatcher(engine).start()
+        try:
+            reqs = []
+            for p in prompts:
+                reqs.append(batcher.submit(p, max_new_tokens=8))
+                time.sleep(0.01)
+            for r in reqs:
+                assert r.wait(120) and r.error is None
+            results.append([list(r.tokens) for r in reqs])
+        finally:
+            batcher.stop()
+    assert results[0] == results[1]
+
+
+def test_engine_kernel_probe_gauges(tiny_cfg):
+    eng = _make_engine(tiny_cfg, "xla", weight_format="w4")
+    out = eng.kernel_probe(iters=1)
+    assert set(out) == {"decode_attn_us", "verify_attn_us", "w4_matmul_us"}
+    assert all(v > 0 for v in out.values())
