@@ -134,6 +134,12 @@ KNOBS: tuple[Knob, ...] = (
          "Full-snapshot keyframe cadence override (outer epochs) for the "
          "fleet delta publisher; keyframes re-pin replica bit-exactness "
          "and onboard (re)joining replicas.", doc_default="config"),
+    Knob("ODTP_PREFIX_DIRECTORY", "bool", "", "fleet",
+         "`1` arms the fleet prefix-cache directory: replicas advertise "
+         "host-tier prefix hashes on health frames and the router routes "
+         "matching prompts to a holder (shared system prompt prefilled "
+         "once fleet-wide). Arms each replica's KV tier.",
+         doc_default="config"),
     Knob("ODTP_FLEET_PUSH_INTERVAL_S", "float", "", "fleet",
          "Seconds between fleet pusher wake-ups per replica (each wake-up "
          "ships pending delta/keyframe frames or a staleness ping).",
@@ -220,6 +226,19 @@ KNOBS: tuple[Knob, ...] = (
          "stacked matmul weights blockwise-4bit packed at rest (dequantized "
          "per block inside the jit'd decode); `fp32` restores today's layout.",
          doc_default="config"),
+    Knob("ODTP_KV_HOST_SLOTS", "int", "", "serve",
+         "Host KV-tier budget: paused slot pages + prefix-store entries it "
+         "may hold at once (page-outs beyond it are declined and the slot "
+         "stays resident).", doc_default="config"),
+    Knob("ODTP_KV_TIER", "bool", "", "serve",
+         "`1` arms the host-memory cold KV tier: the scheduler pages "
+         "evicted slot rings D2H between decode steps and time-slices more "
+         "live sequences than the device ring holds. Off = all-resident, "
+         "bit-identical.", doc_default="config"),
+    Knob("ODTP_KV_TIER_CODEC", "str", "", "serve",
+         "Cold-page codec: `none` stores f32 (evict+restore bit-exact), "
+         "`blockwise4bit` stores pages 8x smaller with a bounded, "
+         "test-pinned restore error.", doc_default="config"),
     Knob("ODTP_SPEC_K", "int", "", "serve",
          "Self-speculative decode override: draft this many tokens per slot "
          "per step and verify full-depth (token-exact vs the one-token "

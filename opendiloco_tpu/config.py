@@ -246,6 +246,16 @@ class ServeConfig(BaseModel):
     # shared-prefix KV reuse: prefill a common prompt prefix once and
     # ring-copy its K/V into joining slots
     prefix_cache: bool = False
+    # host-memory cold KV tier: evicted slot pages park D2H between decode
+    # steps so the scheduler time-slices more live sequences than the ring
+    # holds; off = today's all-resident behavior, bit-identical
+    kv_tier: bool = False
+    # cold-page codec: "none" stores f32 (evict+restore is bit-exact),
+    # "blockwise4bit" quantizes pages 8x smaller (restore error bounded,
+    # test-pinned)
+    kv_tier_codec: Literal["none", "blockwise4bit"] = "none"
+    # host tier budget: paused pages + prefix entries it may hold at once
+    kv_host_slots: int = 32
 
     @field_validator("prefill_buckets", mode="before")
     @classmethod
@@ -276,6 +286,8 @@ class ServeConfig(BaseModel):
                 "serve.spec_decode_k + 1 exceeds serve.max_context "
                 "(a speculative tail must fit the ring)"
             )
+        if self.kv_host_slots < 1:
+            raise ValueError("serve.kv_host_slots must be >= 1")
         return self
 
 
@@ -311,6 +323,12 @@ class FleetConfig(BaseModel):
     prefill_buckets: list[int] = [32, 128]
     max_queue: int = 1024
     prefix_cache: bool = True
+    # fleet prefix-cache directory: replicas advertise host-tier resident
+    # prefix hashes on their health frames and the router routes matching
+    # prompts to a holder, so a fleet-shared system prompt is prefilled
+    # once fleet-wide. Turning it on also arms each replica's host KV
+    # tier (the advertised entries must outlive slot churn).
+    prefix_directory: bool = False
     # SLO-driven autoscaling (fleet/autoscaler.py): a closed control loop
     # that scales replica count against the declared SLO and replaces
     # dead replicas without operator action. `replicas` becomes the

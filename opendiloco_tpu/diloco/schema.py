@@ -152,6 +152,10 @@ REQTRACE_FRAME_KIND = "reqtrace"
 #   decode      one batched decode step touching this request (attrs:
 #               batch occupancy; spec path adds proposed/accepted)
 #   swap        weight hot-swap pause overlapping this request
+#   page_out    KV-tier eviction: the slot's ring page copied D2H and
+#               encoded into the host tier (attrs: tokens, bytes)
+#   page_in     KV-tier restore: paused page decoded + copied H2D back
+#               into a free slot (attrs: tokens, bytes)
 #   retire      terminal: slot retired (done / failed / cancelled)
 
 REQTRACE_STAGES = (
@@ -163,6 +167,8 @@ REQTRACE_STAGES = (
     "prefill",
     "decode",
     "swap",
+    "page_out",
+    "page_in",
     "retire",
 )
 

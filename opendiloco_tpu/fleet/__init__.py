@@ -184,6 +184,11 @@ class FleetManager:
             obs.gauge("fleet_replica_staleness", int(st), replica=rid)
         h = rmeta.get("health")
         if h:
+            # prefix-cache directory feed: adopt the replica's host-tier
+            # advertisement (absent key = nothing resident = clears its
+            # directory entries; a no-op when the directory is off)
+            if self.router is not None:
+                self.router.update_prefixes(rid, h.get("prefixes") or [])
             if h.get("queue_depth") is not None:
                 obs.gauge(
                     "fleet_replica_queue_depth", int(h["queue_depth"]),
@@ -434,7 +439,15 @@ def build_fleet(
         keyframe_every=fleet_cfg.keyframe_every,
         error_feedback=fleet_cfg.error_feedback,
     )
-    router = FleetRouter(host=fleet_cfg.host, port=fleet_cfg.port)
+    env_dir = os.environ.get("ODTP_PREFIX_DIRECTORY")
+    prefix_directory = (
+        bool(int(env_dir)) if env_dir else fleet_cfg.prefix_directory
+    )
+    router = FleetRouter(
+        host=fleet_cfg.host,
+        port=fleet_cfg.port,
+        prefix_directory=prefix_directory,
+    )
     manager = FleetManager(
         publisher, router, push_interval_s=fleet_cfg.push_interval_s
     )
@@ -444,6 +457,10 @@ def build_fleet(
         "prefill_buckets": list(fleet_cfg.prefill_buckets),
         "max_queue": fleet_cfg.max_queue,
         "prefix_cache": fleet_cfg.prefix_cache,
+        # the directory advertises host-tier entries, so turning it on
+        # arms each replica's tier (live slots churn; the host store is
+        # what outlives them)
+        "kv_tier": prefix_directory,
     }
     replicas: dict[str, Any] = {}
 

@@ -57,6 +57,9 @@ class Replica:
         serve_port: int = 0,
         push_port: int = 0,
         prefix_cache: bool = True,
+        kv_tier: bool = False,
+        kv_tier_codec: str = "none",
+        kv_host_slots: int = 32,
         compute_dtype=None,
         seed: int = 0,
         start_push_server: bool = True,
@@ -66,6 +69,7 @@ class Replica:
 
         from opendiloco_tpu.models.llama import init_params
         from opendiloco_tpu.serve.engine import ServeEngine
+        from opendiloco_tpu.serve.kvcache import HostKVTier
         from opendiloco_tpu.serve.scheduler import ContinuousBatcher
         from opendiloco_tpu.serve.server import ServeServer, bind_with_fallback
 
@@ -93,7 +97,16 @@ class Replica:
             max_stale_rounds=0,  # adopt every fully-applied push eagerly
         )
         self.batcher = ContinuousBatcher(
-            engine=self.engine, max_queue=max_queue, prefix_cache=prefix_cache
+            engine=self.engine,
+            max_queue=max_queue,
+            prefix_cache=prefix_cache,
+            kv_tier=(
+                HostKVTier(
+                    host_slots=int(kv_host_slots), codec=str(kv_tier_codec)
+                )
+                if kv_tier
+                else None
+            ),
         ).start()
         # explicit ports mean a respawn at a known address: retry the
         # bind while the dying predecessor's listener tears down instead
@@ -209,12 +222,20 @@ class Replica:
         occupancy, p99, staleness). Rides every push-channel reply, so
         the manager's view refreshes at the push cadence even when the
         obs plane is unarmed."""
-        return {
+        out = {
             **self.batcher.health(),
             "staleness": self.staleness(),
             "stale": self.stale(),
             "ready": self.ready(),
         }
+        # prefix-cache directory advertisement: host-tier resident prefix
+        # hashes at the current weights epoch. A NEW dict key on the
+        # health frame — old routers/managers ignore unknown keys, so
+        # mixed fleets interoperate (pinned by tests/test_fleet interop)
+        prefixes = self.batcher.resident_prefixes()
+        if prefixes:
+            out["prefixes"] = prefixes
+        return out
 
     def rollup(self) -> Optional[dict]:
         """Overseer health vector for this replica (None when obs is
@@ -232,6 +253,13 @@ class Replica:
             queue_depth=h["queue_depth"],
             occupancy=h["occupancy"],
             p99_ms=h["p99_ms"],
+            # cold-tier load (absent when the tier is off): odtp_top's
+            # tier% column keys on this
+            **(
+                {"tier_occupancy": h["tier_occupancy"]}
+                if "tier_occupancy" in h
+                else {}
+            ),
         )
 
     # -- push channel --------------------------------------------------------
@@ -338,6 +366,9 @@ def main(argv: Optional[list] = None) -> int:
         prefill_buckets=tuple(serve.get("prefill_buckets", (16, 64))),
         max_queue=int(serve.get("max_queue", 1024)),
         prefix_cache=bool(serve.get("prefix_cache", True)),
+        kv_tier=bool(serve.get("kv_tier", False)),
+        kv_tier_codec=str(serve.get("kv_tier_codec", "none")),
+        kv_host_slots=int(serve.get("kv_host_slots", 32)),
         max_stale_rounds=int(spec.get("max_stale_rounds", 2)),
         host=spec.get("host", "127.0.0.1"),
         serve_port=int(spec.get("serve_port", 0)),

@@ -34,7 +34,11 @@ from typing import Optional
 
 from opendiloco_tpu import obs
 from opendiloco_tpu.obs import reqtrace
-from opendiloco_tpu.serve.kvcache import common_prefix_len
+from opendiloco_tpu.serve.kvcache import (
+    common_prefix_len,
+    prefix_grid_lengths,
+    prefix_key,
+)
 
 log = logging.getLogger(__name__)
 
@@ -88,6 +92,10 @@ class _Backend:
         # recent prompts, newest last: the affinity signal for warm-KV
         # routing (mirrors what the replica's prefix cache may still hold)
         self.recent: collections.deque = collections.deque(maxlen=32)
+        # prefix-directory advertisement: (key, glen) entries this replica
+        # last reported resident in its host KV tier (wholesale-replaced
+        # on every health frame — the replica is the source of truth)
+        self.prefixes: set = set()
 
     def acquire(self, timeout: float) -> socket.socket:
         with self.lock:
@@ -128,11 +136,22 @@ class FleetRouter:
         affinity_min_tokens: int = 8,
         affinity_max_extra_inflight: int = 2,
         probe_interval_s: float = 1.0,
+        prefix_directory: bool = False,
     ):
         self.request_timeout = float(request_timeout)
         self.affinity_min_tokens = int(affinity_min_tokens)
         self.affinity_max_extra_inflight = int(affinity_max_extra_inflight)
         self.probe_interval_s = float(probe_interval_s)
+        # fleet prefix-cache directory: (key, glen) -> rids holding that
+        # prompt-prefix K/V in their host tier. Fed by replica health
+        # advertisements (update_prefixes), consulted by _pick ahead of
+        # the recent-prompt heuristic — an exact content-hash match beats
+        # a guess — and invalidated on replica death/removal so a killed
+        # holder's entries re-route instead of dangling.
+        self.prefix_directory = bool(prefix_directory)
+        self._prefix_dir: dict[tuple, set] = {}
+        self.directory_hits = 0
+        self.directory_misses = 0
         # dead-backend probes back off exponentially up to this cap
         self.probe_backoff_cap_s = max(8 * self.probe_interval_s, 10.0)
         self._rng = random.Random(0xD15C0)
@@ -169,9 +188,68 @@ class FleetRouter:
     def remove_replica(self, rid: str) -> None:
         with self._lock:
             b = self._backends.pop(rid, None)
+            if b is not None:
+                self._drop_directory_locked(b)
         if b is not None:
             b.close_pool()
         self._publish_live()
+
+    # -- prefix-cache directory ----------------------------------------------
+
+    def update_prefixes(self, rid: str, entries: list) -> None:
+        """Adopt a replica's host-tier prefix advertisement (health-frame
+        ``prefixes`` field): wholesale replace — entries the replica no
+        longer reports (LRU-dropped, epoch-purged) leave the directory."""
+        if not self.prefix_directory:
+            return
+        new = {(str(k), int(g)) for k, g in entries}
+        with self._lock:
+            b = self._backends.get(rid)
+            if b is None:
+                return
+            for kk in b.prefixes - new:
+                holders = self._prefix_dir.get(kk)
+                if holders is not None:
+                    holders.discard(rid)
+                    if not holders:
+                        del self._prefix_dir[kk]
+            for kk in new - b.prefixes:
+                self._prefix_dir.setdefault(kk, set()).add(rid)
+            b.prefixes = new
+
+    def _drop_directory_locked(self, b: _Backend) -> None:
+        """Invalidate every directory entry naming ``b`` (caller holds
+        self._lock): a dead/removed holder must not attract traffic."""
+        for kk in b.prefixes:
+            holders = self._prefix_dir.get(kk)
+            if holders is not None:
+                holders.discard(b.rid)
+                if not holders:
+                    del self._prefix_dir[kk]
+        b.prefixes = set()
+
+    def _directory_pick(self, prompt: list, cands: list) -> Optional[_Backend]:
+        """Longest-prefix directory holder among ``cands`` within the
+        affinity inflight slack, or None."""
+        by_rid = {b.rid: b for b in cands}
+        least = min(cands, key=lambda b: b.inflight)
+        for glen in prefix_grid_lengths(len(prompt)):
+            kk = (prefix_key(prompt, glen), glen)
+            with self._lock:
+                holders = list(self._prefix_dir.get(kk) or ())
+            for rid in holders:
+                b = by_rid.get(rid)
+                if (
+                    b is not None
+                    and b.inflight
+                    <= least.inflight + self.affinity_max_extra_inflight
+                ):
+                    self.directory_hits += 1
+                    obs.count("fleet_directory_hits", replica=rid)
+                    return b
+        self.directory_misses += 1
+        obs.count("fleet_directory_misses")
+        return None
 
     def _publish_live(self) -> None:
         with self._lock:
@@ -194,6 +272,10 @@ class FleetRouter:
         cands = self._candidates(exclude)
         if not cands:
             return None
+        if self.prefix_directory and len(prompt) >= self.affinity_min_tokens:
+            b = self._directory_pick(prompt, cands)
+            if b is not None:
+                return b
         least = min(cands, key=lambda b: b.inflight)
         if len(prompt) >= self.affinity_min_tokens:
             best, best_p = None, 0
@@ -400,6 +482,7 @@ class FleetRouter:
             b.dead = True
             if first:
                 self.deaths += 1
+                self._drop_directory_locked(b)
         if first:
             b.close_pool()
             obs.count("fleet_replica_deaths", replica=b.rid)
@@ -603,11 +686,22 @@ class FleetRouter:
     def stats(self) -> dict:
         with self._lock:
             backends = dict(self._backends)
+        with self._lock:
+            dir_stats = (
+                {
+                    "entries": len(self._prefix_dir),
+                    "hits": self.directory_hits,
+                    "misses": self.directory_misses,
+                }
+                if self.prefix_directory
+                else None
+            )
         return {
             "port": self.port,
             "redispatches": self.redispatches,
             "deaths": self.deaths,
             "shed": self.shed,
+            "prefix_directory": dir_stats,
             "replicas": {
                 rid: {
                     "host": b.host,

@@ -64,6 +64,26 @@ def xla_attention(
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
+def ring_live_rows(cache_len: int, t: int) -> int:
+    """Physically live ring rows for a sequence of ``cache_len`` cached
+    tokens in a T-row page — the KV-tier page-transfer contract.
+
+    This is the host-side mirror of the lens masks below: before the page
+    wraps, rows [0, cache_len) hold the sequence (``idx <= lens`` exposes
+    exactly them plus the current step's write); once ``cache_len >= t``
+    the whole ring is live at positions ``pos % t`` (the ``lens >= t``
+    branch). A tier eviction therefore pages out exactly these rows and a
+    restore writes them back at row 0 — ring layout is preserved in both
+    regimes, so the decode/spec-tail masks (and the Pallas kernel's
+    dead-block clamp, which derives from the same ``lens``) are already
+    exact over a restored page: rows beyond the restored count belong to
+    a previous tenant and stay masked until the sequence's own writes
+    reach them, the same invariant slot reuse has always relied on."""
+    if cache_len < 0:
+        raise ValueError(f"cache_len must be >= 0, got {cache_len}")
+    return min(int(cache_len), int(t))
+
+
 def decode_attention(
     q: jax.Array,
     k: jax.Array,
@@ -78,6 +98,9 @@ def decode_attention(
     been written at ring index ``lens % T``). Valid cache entries are
     indices <= lens until the sequence outgrows the page, after which the
     whole ring is live (sliding-window attention over the last T tokens).
+    The same mask covers tier-restored slots: a page-in rewrites exactly
+    :func:`ring_live_rows` rows at row 0, so validity is still fully
+    determined by ``lens``.
 
     Math matches :func:`xla_attention` row-for-row — f32 scores/softmax,
     probabilities cast back to q.dtype — so incremental decode reproduces

@@ -455,6 +455,16 @@ def ring_attention_auto(
         inside_manual = types.get(axis) == jax.sharding.AxisType.Manual
     except Exception:  # pragma: no cover - older jax without abstract mesh
         pass
+    if not inside_manual and not hasattr(jax.sharding, "get_abstract_mesh"):
+        # pre-AbstractMesh jax can't introspect the tracing context, but
+        # there the esm fallback binds regions FULL-manual — so the ring
+        # axis having a bound frame means we are already inside one and a
+        # nested shard_map would re-bind outer axes (rejected)
+        try:
+            jax.core.axis_frame(axis)
+            inside_manual = True
+        except Exception:
+            pass
     block = _flash_chunk_block(mesh, axis, q, causal=True, local=inside_manual)
     if block:
         body = lambda q, k, v: ring_flash_attention(q, k, v, axis, block)

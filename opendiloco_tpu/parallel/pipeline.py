@@ -98,7 +98,7 @@ def pipeline_hidden(
         _shard_map,
         mesh=mesh,
         in_specs=(layer_specs, hs_spec, pos_spec),
-        out_specs=(hs_spec, P()),
+        out_specs=(hs_spec, P(axis)),
         axis_names=set(manual_axes),
     )
     def _pipeline(layers_local, hs, mb_positions):
@@ -113,7 +113,10 @@ def pipeline_hidden(
             )
             block = _maybe_remat(block, remat)
             y, (_, layer_auxs) = jax.lax.scan(block, x, layers_local)
-            return y, jnp.sum(layer_auxs)
+            # keep the aux rank-1 everywhere in this region: pre-vma
+            # shard_map cannot re-shard rank-0 residuals/outputs across
+            # the region boundary (MoE backward raises _SpecError)
+            return y, jnp.sum(layer_auxs, keepdims=True)
 
         def tick(carry, t):
             cur, outs, aux = carry
@@ -125,7 +128,7 @@ def pipeline_hidden(
             # fill/drain ticks run on clipped garbage inputs: their router
             # aux must not count
             valid = (t - r >= 0) & (t - r <= M - 1)
-            aux = aux + jnp.where(valid, aux_sum, 0.0)
+            aux = aux + jnp.where(valid, aux_sum, jnp.zeros_like(aux_sum))
             out_idx = t - (n - 1)
             take = (r == n - 1) & (out_idx >= 0)
             slot = jnp.clip(out_idx, 0, M - 1)
@@ -147,7 +150,9 @@ def pipeline_hidden(
 
         cur0 = to_varying(jnp.zeros_like(hs[0]))
         outs0 = to_varying(jnp.zeros_like(hs))
-        aux0 = to_varying(jnp.float32(0.0))
+        # [1]-shaped and derived from a traced input, not a hoisted
+        # constant — both matter for the pre-vma transpose (see stage)
+        aux0 = to_varying((hs[0, 0, 0, :1] * 0.0).astype(jnp.float32))
         (cur, outs, aux), _ = jax.lax.scan(
             tick, (cur0, outs0, aux0), jnp.arange(M + n - 1)
         )
@@ -156,13 +161,18 @@ def pipeline_hidden(
             jnp.where(r == n - 1, outs, jnp.zeros_like(outs)), axis
         )
         # each stage summed the aux of its own layers over its M valid
-        # microbatch runs: psum -> total over all L layers x M microbatches
-        aux = jax.lax.psum(aux, axis) / (cfg.num_hidden_layers * M)
+        # microbatch runs. Export it as a per-stage [1] slice (the P(pp)
+        # out spec concatenates them to [n]) and reduce OUTSIDE the
+        # region: pre-vma shard_map cannot re-shard a rank-0 output in
+        # the pipeline's transpose (MoE backward raises _SpecError),
+        # while a pp-sharded vector transposes on every jax release.
+        # Summing the slices is the old psum.
+        aux = aux / (cfg.num_hidden_layers * M)
         if sp_axis is not None:
             # chunk-local router stats: mean over sequence chunks, and the
-            # P() out_spec needs the value invariant over sp
+            # pp-only out_spec needs the value invariant over sp
             aux = jax.lax.psum(aux, sp_axis) / _axis_size(sp_axis)
         return outs, aux
 
-    outs, moe_aux = _pipeline(cparams["layers"], hs, mb_positions)
-    return outs.reshape(B, T, D), moe_aux
+    outs, aux_vec = _pipeline(cparams["layers"], hs, mb_positions)
+    return outs.reshape(B, T, D), jnp.sum(aux_vec)

@@ -23,12 +23,17 @@ from typing import Optional
 import jax.numpy as jnp
 
 from opendiloco_tpu.serve.engine import ServeEngine  # noqa: F401
-from opendiloco_tpu.serve.kvcache import SlotAllocator, pick_bucket  # noqa: F401
+from opendiloco_tpu.serve.kvcache import (  # noqa: F401
+    HostKVTier,
+    SlotAllocator,
+    pick_bucket,
+)
 from opendiloco_tpu.serve.scheduler import ContinuousBatcher, Request  # noqa: F401
 from opendiloco_tpu.serve.server import ServeServer  # noqa: F401
 
 __all__ = [
     "ContinuousBatcher",
+    "HostKVTier",
     "Request",
     "ServeEngine",
     "ServeServer",
@@ -104,11 +109,26 @@ def build_serving(
         weight_format=weight_format,
         decode_kernel=decode_kernel,
     )
+    env_tier = os.environ.get("ODTP_KV_TIER")
+    kv_tier_on = bool(int(env_tier)) if env_tier else serve_cfg.kv_tier
+    kv_tier = None
+    if kv_tier_on:
+        kv_tier = HostKVTier(
+            host_slots=int(
+                os.environ.get("ODTP_KV_HOST_SLOTS")
+                or serve_cfg.kv_host_slots
+            ),
+            codec=(
+                os.environ.get("ODTP_KV_TIER_CODEC")
+                or serve_cfg.kv_tier_codec
+            ),
+        )
     batcher = ContinuousBatcher(
         engine,
         max_queue=serve_cfg.max_queue,
         swap_every_steps=serve_cfg.swap_every_steps,
         prefix_cache=serve_cfg.prefix_cache,
+        kv_tier=kv_tier,
     ).start()
     server = None
     if start_server:

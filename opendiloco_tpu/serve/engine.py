@@ -90,7 +90,10 @@ def _fresh_copy(leaves):
 # what DiLoCoOptimizer.master_snapshot_wire returns.
 SnapshotFn = Callable[[], tuple]
 
-_STAGES = ("prefill", "draft", "verify", "insert", "decode", "swap")
+_STAGES = (
+    "prefill", "draft", "verify", "insert", "decode", "swap",
+    "page_out", "page_in",
+)
 
 
 class ServeEngine:
@@ -248,6 +251,33 @@ class ServeEngine:
         self._suffix = jax.jit(_suffix)
         self._suffix_insert = jax.jit(_suffix_ins, donate_argnums=(0, 1))
 
+        # KV-tier page transfers (compiled only when tiering is on): one
+        # slot's ring pages gathered for D2H eviction / scattered back on
+        # H2D restore. ``rows`` is static — padded to the prefill-bucket
+        # grid by :meth:`page_rows` so the compile family stays bounded.
+        def _fetch_pages(ck, cv, slot, rows):
+            pk = jax.lax.dynamic_slice_in_dim(
+                jnp.take(ck, slot, axis=1), 0, rows, axis=1
+            )
+            pv = jax.lax.dynamic_slice_in_dim(
+                jnp.take(cv, slot, axis=1), 0, rows, axis=1
+            )
+            return pk, pv
+
+        def _install_pages(ck, cv, pk, pv, slot):
+            zero = jnp.int32(0)
+            start = (zero, jnp.asarray(slot, jnp.int32), zero, zero, zero)
+            ck = jax.lax.dynamic_update_slice(
+                ck, pk[:, None].astype(ck.dtype), start
+            )
+            cv = jax.lax.dynamic_update_slice(
+                cv, pv[:, None].astype(cv.dtype), start
+            )
+            return ck, cv
+
+        self._fetch_pages = jax.jit(_fetch_pages, static_argnums=(3,))
+        self._install_pages = jax.jit(_install_pages, donate_argnums=(0, 1))
+
     # -- weight residency ---------------------------------------------------
 
     def _assemble(self, leaves):
@@ -281,6 +311,7 @@ class ServeEngine:
         *,
         prefix_src: Optional[int] = None,
         prefix_len: int = 0,
+        host_prefix: Optional[tuple] = None,
     ) -> tuple[int, np.ndarray]:
         """Prefill ``prompt`` into ``slot`` and return (first greedy token,
         last-position logits [V] f32). The prompt must fit a compile
@@ -290,7 +321,9 @@ class ServeEngine:
         are NOT recomputed: their K/V rows are ring-copied from the live
         source slot (bitwise what a cold prefill writes — causal attention
         makes prefix K/V independent of anything after it) and only the
-        suffix runs through the model."""
+        suffix runs through the model. ``host_prefix=(k, v, plen)`` is the
+        cold-tier variant: the prefix K/V pages come from the host prefix
+        store (H2D install) instead of a live slot's ring."""
         n = len(prompt)
         bucket = pick_bucket(n, self.prefill_buckets)
         if bucket is None:
@@ -299,7 +332,16 @@ class ServeEngine:
                 f"{self.prefill_buckets[-1]}"
             )
         t0 = time.perf_counter()
-        if prefix_src is not None and 0 < prefix_len < n:
+        if host_prefix is not None and 0 < host_prefix[2] < n:
+            hk, hv, plen = host_prefix
+            self.cache_k, self.cache_v = self._install_pages(
+                self.cache_k, self.cache_v,
+                jnp.asarray(hk, self.compute_dtype),
+                jnp.asarray(hv, self.compute_dtype),
+                jnp.int32(slot),
+            )
+            tok, logits = self._run_suffix(slot, prompt, int(plen))
+        elif prefix_src is not None and 0 < prefix_len < n:
             tok, logits = self._admit_suffix(slot, prompt, prefix_src, prefix_len)
         else:
             ids = np.zeros((1, bucket), np.int32)
@@ -321,15 +363,22 @@ class ServeEngine:
     def _admit_suffix(
         self, slot: int, prompt: Sequence[int], src: int, plen: int
     ) -> tuple[int, np.ndarray]:
+        self.cache_k, self.cache_v = self._prefix_copy(
+            self.cache_k, self.cache_v,
+            jnp.int32(src), jnp.int32(slot), jnp.int32(plen),
+        )
+        return self._run_suffix(slot, prompt, plen)
+
+    def _run_suffix(
+        self, slot: int, prompt: Sequence[int], plen: int
+    ) -> tuple[int, np.ndarray]:
+        """Continued prefill over ``slot`` whose ring already holds the
+        first ``plen`` rows (live-slot copy or tier install)."""
         suffix = np.asarray(prompt[plen:], np.int32)
         ns = int(suffix.size)
         sb = pick_bucket(ns, self.prefill_buckets)
         tail = np.zeros((1, sb), np.int32)
         tail[0, :ns] = suffix
-        self.cache_k, self.cache_v = self._prefix_copy(
-            self.cache_k, self.cache_v,
-            jnp.int32(src), jnp.int32(slot), jnp.int32(plen),
-        )
         logits, tks, tvs = self._suffix(
             self.params, self.cache_k, self.cache_v,
             jnp.int32(slot), jnp.asarray(tail), jnp.int32(plen),
@@ -343,6 +392,54 @@ class ServeEngine:
 
     def prompt_fits(self, n: int) -> bool:
         return pick_bucket(n, self.prefill_buckets) is not None
+
+    # -- KV-tier page transfers ---------------------------------------------
+
+    def page_rows(self, rows: int) -> int:
+        """Static transfer row count for ``rows`` live ring rows: padded
+        up the prefill-bucket grid (bounded compile family; padding rows
+        carry a previous tenant's masked entries, which restore rewrites
+        verbatim — harmless by the same lens-mask invariant, see
+        ``ops.attention.ring_live_rows``)."""
+        if not 0 < rows <= self.max_context:
+            raise ValueError(
+                f"rows {rows} outside (0, {self.max_context}]"
+            )
+        return pick_bucket(rows, self.prefill_buckets) or self.max_context
+
+    def fetch_slot_pages(self, slot: int, rows: int) -> tuple:
+        """Start an async D2H gather of ``slot``'s leading ``rows`` ring
+        rows. Returns device arrays ([L, rows', Nkv, Dh] each, rows'
+        bucket-padded) with a host copy already in flight — the caller
+        materializes them with ``np.asarray`` on a LATER scheduler
+        iteration so the transfer overlaps the next decode step instead
+        of blocking the loop. The gather is by value: the slot can be
+        re-tenanted immediately."""
+        t0 = time.perf_counter()
+        pk, pv = self._fetch_pages(
+            self.cache_k, self.cache_v, jnp.int32(slot), self.page_rows(rows)
+        )
+        for a in (pk, pv):
+            try:
+                a.copy_to_host_async()
+            except (AttributeError, RuntimeError):
+                pass  # backend without async D2H: np.asarray still works
+        self.stage_seconds["page_out"] += time.perf_counter() - t0
+        return pk, pv
+
+    def install_slot_pages(self, slot: int, k: np.ndarray, v: np.ndarray) -> None:
+        """Page a slot's ring rows back H2D (tier restore): rows [0, R)
+        of ``slot`` are rewritten from the host arrays. Dispatch is
+        async — the next decode step queues behind it on-stream, so the
+        scheduler thread never blocks on the transfer."""
+        t0 = time.perf_counter()
+        self.cache_k, self.cache_v = self._install_pages(
+            self.cache_k, self.cache_v,
+            jnp.asarray(k, self.compute_dtype),
+            jnp.asarray(v, self.compute_dtype),
+            jnp.int32(slot),
+        )
+        self.stage_seconds["page_in"] += time.perf_counter() - t0
 
     # -- decode ------------------------------------------------------------
 

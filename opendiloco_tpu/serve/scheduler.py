@@ -23,17 +23,26 @@ import numpy as np
 
 from opendiloco_tpu import obs
 from opendiloco_tpu.obs import reqtrace
+from opendiloco_tpu.ops.attention import ring_live_rows
 from opendiloco_tpu.serve.engine import ServeEngine
 from opendiloco_tpu.serve.kvcache import (
+    HostKVTier,
     SlotAllocator,
     common_prefix_len,
     pick_bucket,
+    prefix_grid_lengths,
+    prefix_key,
 )
 
 # a reused prefix must be worth the copy: below this many shared tokens
 # the batcher prefills cold (the suffix pass would cover ~the whole
 # prompt anyway)
 MIN_PREFIX_TOKENS = 4
+
+# slot evictions started per scheduler iteration: bounds how much page-out
+# work one pass can stack between decode steps, so a long queue drains the
+# batch gradually instead of stalling a whole step on D2H traffic
+EVICT_PER_PASS = 2
 
 
 @dataclasses.dataclass
@@ -86,6 +95,20 @@ class _Slot:
     req: Request
     cache_len: int  # tokens in the ring page (absolute position of next write)
     last_token: int
+    # decode steps since this tenancy began (admit or tier restore): the
+    # eviction policy's coldness signal AND its thrash guard
+    resident_steps: int = 0
+
+
+@dataclasses.dataclass
+class _Paused:
+    """A live request whose ring page lives in the host tier: everything
+    needed to resume decode exactly where it stopped, minus the K/V
+    (which :class:`HostKVTier` holds keyed by ``req.id``)."""
+
+    req: Request
+    cache_len: int
+    last_token: int
 
 
 class ContinuousBatcher:
@@ -97,12 +120,24 @@ class ContinuousBatcher:
         swap_every_steps: int = 16,
         gauge_every_steps: int = 32,
         prefix_cache: bool = False,
+        kv_tier: Optional[HostKVTier] = None,
+        tier_quantum_steps: int = 8,
+        tier_min_resident_steps: int = 2,
     ):
         self.engine = engine
         self.max_queue = int(max_queue)
         self.swap_every_steps = max(1, int(swap_every_steps))
         self.gauge_every_steps = max(1, int(gauge_every_steps))
         self.prefix_cache = bool(prefix_cache)
+        # host-memory cold tier (None = today's all-resident behavior,
+        # bit-identical). quantum = steps a RESUMED/long-resident slot is
+        # guaranteed before a paused peer may displace it (round-robin
+        # time-slicing period); min_resident = floor before a QUEUED
+        # request may displace anyone (TTFT pressure evicts sooner, but
+        # never a slot that has not decoded at all)
+        self.kv_tier = kv_tier
+        self.tier_quantum_steps = max(1, int(tier_quantum_steps))
+        self.tier_min_resident_steps = max(1, int(tier_min_resident_steps))
         self.spec_decode = engine.spec_k > 0
         self._kernel_probed = False
         self.slots = SlotAllocator(engine.num_slots)
@@ -132,9 +167,22 @@ class ContinuousBatcher:
         # speculative-decode accounting (loop thread only)
         self.spec_proposed = 0
         self.spec_accepted = 0
-        # shared-prefix reuse accounting
+        # shared-prefix reuse accounting (live-slot ring copies + host
+        # tier restores; host_prefix_hits is the tier subset)
         self.prefix_hits = 0
         self.prefix_tokens_saved = 0
+        self.host_prefix_hits = 0
+        # KV-tier state (loop thread only): paused requests FIFO by pause
+        # time, page-outs whose D2H copy is still in flight, and prefix
+        # snapshots waiting to be encoded into the tier
+        self._paused: "collections.OrderedDict[int, _Paused]" = (
+            collections.OrderedDict()
+        )
+        self._pending_evict: list = []
+        self._pending_prefix: list = []
+        self.evictions = 0
+        self.resumes = 0
+        self.paused_peak = 0
 
     # -- client API --------------------------------------------------------
 
@@ -257,13 +305,36 @@ class ContinuousBatcher:
             st.req.finish("server stopped")
             self._trace_terminal(st.req, "retire", "failed", error=st.req.error)
         self._active.clear()
+        self._fail_cold("server stopped")
+
+    def _fail_cold(self, error: str) -> None:
+        """Fail every tier-resident request (paused or mid-page-out) so no
+        client blocks forever on teardown/loop death."""
+        for st, _pk, _pv, _t0 in self._pending_evict:
+            self.failed += 1
+            st.req.finish(error)
+            self._trace_terminal(st.req, "retire", "failed", error=error)
+        self._pending_evict.clear()
+        for p in self._paused.values():
+            if self.kv_tier is not None:
+                self.kv_tier.drop_paused(p.req.id)
+            self.failed += 1
+            p.req.finish(error)
+            self._trace_terminal(p.req, "retire", "failed", error=error)
+        self._paused.clear()
 
     def drain(self, timeout: float = 60.0) -> bool:
-        """Block until queue and batch are empty (bench teardown)."""
+        """Block until queue, batch, and cold tier are empty (bench
+        teardown)."""
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
             with self._cond:
-                if not self._queue and not self._active:
+                if (
+                    not self._queue
+                    and not self._active
+                    and not self._paused
+                    and not self._pending_evict
+                ):
                     return True
             time.sleep(0.01)
         return False
@@ -282,6 +353,10 @@ class ContinuousBatcher:
                 # trace's stage sums reconcile with its e2e latency
                 it0 = t_carry if t_carry is not None else time.perf_counter()
                 self._sweep_cancelled()
+                # page-outs started LAST iteration finalize here: their
+                # D2H copies overlapped the decode step in between, so
+                # the np materialization below is (near-)free
+                self._finish_pageouts()
                 admitted = self._admit()
                 stepped = self._decode(it0)
                 if stepped:
@@ -303,6 +378,7 @@ class ContinuousBatcher:
                 self._retire(st, error=self.loop_error)
                 self.slots.free(slot)
             self._active.clear()
+            self._fail_cold(self.loop_error)
             with self._cond:
                 pending = list(self._queue)
                 self._queue.clear()
@@ -320,6 +396,10 @@ class ContinuousBatcher:
         t1 = time.perf_counter()
         if not swapped:
             return
+        if self.kv_tier is not None:
+            # prefix K/V was computed under the old weights: entries at a
+            # stale epoch must never serve (or be advertised) again
+            self.kv_tier.purge_stale(self.engine.weights_epoch)
         rt = reqtrace.ring()
         if rt is None:
             return
@@ -378,6 +458,27 @@ class ContinuousBatcher:
                 st.req.finish("deadline exceeded")
                 obs.count("serve_shed", reason="deadline")
                 self._trace_terminal(st.req, "shed", "shed", reason="deadline")
+        # paused (tier-resident) requests: same sweep, plus the tier page
+        # is dropped — a dead client's cold state never pins host budget
+        cold_gone = [
+            rid
+            for rid, p in self._paused.items()
+            if p.req.cancelled or expired(p.req)
+        ]
+        for rid in cold_gone:
+            p = self._paused.pop(rid)
+            if self.kv_tier is not None:
+                self.kv_tier.drop_paused(rid)
+            if p.req.cancelled:
+                self.cancelled += 1
+                p.req.finish("cancelled")
+                obs.count("serve_cancelled")
+                self._trace_terminal(p.req, "retire", "cancelled")
+            else:
+                self.shed += 1
+                p.req.finish("deadline exceeded")
+                obs.count("serve_shed", reason="deadline")
+                self._trace_terminal(p.req, "shed", "shed", reason="deadline")
 
     def _find_prefix(self, prompt: list) -> tuple[Optional[int], int]:
         """Longest usable shared prompt prefix among the live slots.
@@ -422,49 +523,230 @@ class ContinuousBatcher:
             return best
 
     def _admit(self) -> bool:
-        rt = reqtrace.ring()
+        """Fill free slots, and under tiering MAKE slots when demand
+        exists: resume the oldest paused request first (it already paid
+        its TTFT — FIFO keeps completion latency bounded), then admit
+        queued prompts; with the batch full, a queued request may
+        displace the longest-resident slot (min_resident floor) and a
+        paused one may displace a slot that has held its quantum —
+        round-robin time-slicing over more sequences than the device
+        ring holds."""
         admitted = False
-        while self.slots.num_free:
-            req = self._pop_next()
-            if req is None:
+        evictions = 0
+        while True:
+            if self.slots.num_free:
+                if self._paused:
+                    self._resume_one(self.slots.alloc())
+                    admitted = True
+                    continue
+                req = self._pop_next()
+                if req is None:
+                    break
+                self._admit_into(self.slots.alloc(), req)
+                admitted = True
+                continue
+            if self.kv_tier is None or evictions >= EVICT_PER_PASS:
                 break
-            slot = self.slots.alloc()
-            t_slot = time.perf_counter()
-            src, plen = (
-                self._find_prefix(req.prompt)
-                if self.prefix_cache
-                else (None, 0)
-            )
-            if src is not None:
-                tok, _ = self.engine.admit(
-                    slot, req.prompt, prefix_src=src, prefix_len=plen
-                )
-                self.prefix_hits += 1
-                self.prefix_tokens_saved += plen
-                obs.count("serve_prefix_hits")
-                obs.count("serve_prefix_tokens_saved", plen)
-            else:
-                tok, _ = self.engine.admit(slot, req.prompt)
-            req.t_first = time.perf_counter()
-            if rt is not None and req.trace is not None:
-                rt.span(
-                    req.trace, "queue", req.t_submit, t_slot, slot=slot
-                )
-                rt.span(
-                    req.trace, "prefill", t_slot, req.t_first,
-                    tokens=len(req.prompt),
-                    bucket=pick_bucket(len(req.prompt), self.engine.prefill_buckets),
-                    prefix_reused=plen,
-                )
-            req.tokens.append(tok)
-            st = _Slot(req=req, cache_len=len(req.prompt), last_token=tok)
-            if self._finished(st):
-                self._retire(st)
-                self.slots.free(slot)
-            else:
-                self._active[slot] = st
-            admitted = True
+            req = self._pop_next()
+            if req is not None:
+                # TTFT pressure: a never-started request is worth an
+                # early eviction (the displaced sequence keeps its state
+                # in the tier and rotates back in)
+                if self._evict_one(self.tier_min_resident_steps):
+                    evictions += 1
+                    self._admit_into(self.slots.alloc(), req)
+                    admitted = True
+                    continue
+                with self._cond:
+                    self._queue.append(req)  # nothing evictable yet
+                break
+            if self._paused:
+                # pure rotation: oldest paused displaces the slot that
+                # has held the batch longest, once per quantum
+                if self._evict_one(self.tier_quantum_steps):
+                    evictions += 1
+                    self._resume_one(self.slots.alloc())
+                    admitted = True
+                    continue
+            break
         return admitted
+
+    def _admit_into(self, slot: int, req: Request) -> None:
+        rt = reqtrace.ring()
+        t_slot = time.perf_counter()
+        src, plen, host = None, 0, None
+        if self.prefix_cache:
+            src, plen = self._find_prefix(req.prompt)
+            if src is None and self.kv_tier is not None:
+                host, plen = self._host_prefix_lookup(req.prompt)
+        if src is not None:
+            tok, _ = self.engine.admit(
+                slot, req.prompt, prefix_src=src, prefix_len=plen
+            )
+            self.prefix_hits += 1
+            self.prefix_tokens_saved += plen
+            obs.count("serve_prefix_hits")
+            obs.count("serve_prefix_tokens_saved", plen)
+        elif host is not None:
+            tok, _ = self.engine.admit(slot, req.prompt, host_prefix=host)
+            self.prefix_hits += 1
+            self.host_prefix_hits += 1
+            self.prefix_tokens_saved += plen
+            obs.count("serve_prefix_hits")
+            obs.count("serve_host_prefix_hits")
+            obs.count("serve_prefix_tokens_saved", plen)
+        else:
+            tok, _ = self.engine.admit(slot, req.prompt)
+            self._maybe_store_prefix(slot, req.prompt)
+        req.t_first = time.perf_counter()
+        if rt is not None and req.trace is not None:
+            rt.span(
+                req.trace, "queue", req.t_submit, t_slot, slot=slot
+            )
+            rt.span(
+                req.trace, "prefill", t_slot, req.t_first,
+                tokens=len(req.prompt),
+                bucket=pick_bucket(len(req.prompt), self.engine.prefill_buckets),
+                prefix_reused=plen,
+            )
+        req.tokens.append(tok)
+        st = _Slot(req=req, cache_len=len(req.prompt), last_token=tok)
+        if self._finished(st):
+            self._retire(st)
+            self.slots.free(slot)
+        else:
+            self._active[slot] = st
+
+    # -- KV tiering (evict / restore / host prefix store) --------------------
+
+    def _evict_one(self, min_resident: int) -> bool:
+        """Page the coldest evictable slot out to the host tier and free
+        it. Coldest = most decode steps since its tenancy began (every
+        live slot decodes every step, so residency age IS the LRU order
+        by last page-in); ``min_resident`` is the thrash guard. The D2H
+        copy is only STARTED here — :meth:`_finish_pageouts` encodes it
+        into the tier next iteration, after the transfer overlapped a
+        decode step."""
+        # in-flight page-outs land in the tier next iteration: count them
+        # against the pin budget now or a 2-evict pass can overflow it
+        if (
+            self.kv_tier.paused_count + len(self._pending_evict)
+            >= self.kv_tier.host_slots
+        ):
+            return False
+        best_slot = None
+        for slot, st in self._active.items():
+            if st.resident_steps < min_resident:
+                continue
+            if best_slot is None or (
+                st.resident_steps > self._active[best_slot].resident_steps
+            ):
+                best_slot = slot
+        if best_slot is None:
+            return False
+        st = self._active.pop(best_slot)
+        t0 = time.perf_counter()
+        rows = ring_live_rows(st.cache_len, self.engine.max_context)
+        pk, pv = self.engine.fetch_slot_pages(best_slot, rows)
+        self._pending_evict.append((st, pk, pv, t0))
+        self.slots.free(best_slot)
+        self.evictions += 1
+        obs.count("serve_tier_evictions")
+        return True
+
+    def _finish_pageouts(self) -> None:
+        if not self._pending_evict:
+            self._finish_prefix_stores()
+            return
+        pending, self._pending_evict = self._pending_evict, []
+        rt = reqtrace.ring()
+        for st, pk, pv, t0 in pending:
+            k, v = np.asarray(pk), np.asarray(pv)
+            self.kv_tier.put_paused(st.req.id, k, v)
+            self._paused[st.req.id] = _Paused(
+                req=st.req, cache_len=st.cache_len, last_token=st.last_token
+            )
+            t1 = time.perf_counter()
+            self.engine.stage_seconds["page_out"] += t1 - t0
+            obs.count("serve_page_out_bytes", k.nbytes + v.nbytes)
+            if rt is not None and st.req.trace is not None:
+                rt.span(
+                    st.req.trace, "page_out", t0, t1,
+                    tokens=st.cache_len, bytes=k.nbytes + v.nbytes,
+                )
+        self.paused_peak = max(self.paused_peak, len(self._paused))
+        self._finish_prefix_stores()
+
+    def _resume_one(self, slot: int) -> None:
+        """Page the oldest paused request back in and rejoin the batch
+        exactly where it stopped (tokens, cache_len, last_token are the
+        request's own; the ring rows come back from the tier)."""
+        rid, p = self._paused.popitem(last=False)
+        t0 = time.perf_counter()
+        k, v = self.kv_tier.pop_paused(rid)
+        self.engine.install_slot_pages(slot, k, v)
+        t1 = time.perf_counter()
+        self._active[slot] = _Slot(
+            req=p.req, cache_len=p.cache_len, last_token=p.last_token
+        )
+        self.resumes += 1
+        obs.count("serve_tier_resumes")
+        obs.count("serve_page_in_bytes", k.nbytes + v.nbytes)
+        rt = reqtrace.ring()
+        if rt is not None and p.req.trace is not None:
+            rt.span(
+                p.req.trace, "page_in", t0, t1,
+                tokens=p.cache_len, bytes=k.nbytes + v.nbytes,
+            )
+
+    def _host_prefix_lookup(self, prompt: list):
+        """Longest grid-length prompt prefix resident in the host tier at
+        the CURRENT weights epoch (stale-epoch entries never serve)."""
+        epoch = self.engine.weights_epoch
+        for glen in prefix_grid_lengths(len(prompt)):
+            got = self.kv_tier.get_prefix(prefix_key(prompt, glen), glen, epoch)
+            if got is not None:
+                return (got[0], got[1], glen), glen
+        return None, 0
+
+    def _maybe_store_prefix(self, slot: int, prompt: list) -> None:
+        """After a cold prefill, snapshot the prompt's longest grid-length
+        prefix into the tier (async D2H; encoded next iteration). This is
+        what makes prefix reuse survive slot churn and what the fleet
+        directory advertises."""
+        if self.kv_tier is None or not self.prefix_cache:
+            return
+        grid = prefix_grid_lengths(len(prompt))
+        if not grid:
+            return
+        glen = grid[0]
+        key = prefix_key(prompt, glen)
+        epoch = self.engine.weights_epoch
+        if self.kv_tier.has_prefix(key, glen, epoch):
+            return
+        t0 = time.perf_counter()
+        pk, pv = self.engine.fetch_slot_pages(slot, glen)
+        self._pending_prefix.append((key, glen, epoch, pk, pv, t0))
+
+    def _finish_prefix_stores(self) -> None:
+        if not self._pending_prefix:
+            return
+        pending, self._pending_prefix = self._pending_prefix, []
+        for key, glen, epoch, pk, pv, t0 in pending:
+            if epoch != self.engine.weights_epoch:
+                continue  # weights swapped since the snapshot: stale, drop
+            k, v = np.asarray(pk), np.asarray(pv)
+            self.kv_tier.put_prefix(key, glen, epoch, k, v)
+            self.engine.stage_seconds["page_out"] += time.perf_counter() - t0
+            obs.count("serve_page_out_bytes", k.nbytes + v.nbytes)
+
+    def resident_prefixes(self) -> list:
+        """``[[key, glen], ...]`` the fleet health channel advertises —
+        epoch-valid host-tier prefix entries (read racily off-thread;
+        the tier's dict snapshot is safe under the GIL)."""
+        if self.kv_tier is None:
+            return []
+        return self.kv_tier.resident_prefixes(self.engine.weights_epoch)
 
     def _decode(self, t0: Optional[float] = None) -> bool:
         if not self._active:
@@ -493,6 +775,7 @@ class ContinuousBatcher:
             st.req.tokens.append(tok)
             st.cache_len += 1
             st.last_token = tok
+            st.resident_steps += 1
             self.total_new_tokens += 1
             if self._finished(st):
                 done_slots.append(slot)
@@ -545,6 +828,7 @@ class ContinuousBatcher:
         emitted_by_slot = {}
         for slot, st in self._active.items():
             slot_emitted = 0
+            st.resident_steps += 1
             for tok in g[slot, : int(m[slot]) + 1].tolist():
                 st.req.tokens.append(int(tok))
                 st.cache_len += 1
@@ -659,6 +943,11 @@ class ContinuousBatcher:
             obs.gauge(
                 "serve_spec_acceptance", self.spec_accepted / self.spec_proposed
             )
+        if self.kv_tier is not None:
+            obs.gauge("serve_tier_occupancy", self.kv_tier.occupancy())
+            obs.gauge("serve_tier_paused", len(self._paused))
+            obs.gauge("serve_tier_prefix_entries", self.kv_tier.prefix_count)
+            obs.gauge("serve_tier_stored_bytes", self.kv_tier.stored_bytes())
         with self._cond:
             obs.gauge("serve_queue_depth", len(self._queue))
 
@@ -694,6 +983,9 @@ class ContinuousBatcher:
             "completed": self.completed,
             "shed": self.shed,
         }
+        if self.kv_tier is not None:
+            out["tier_occupancy"] = round(self.kv_tier.occupancy(), 4)
+            out["tier_paused"] = len(self._paused)
         exemplars = self._slo_exemplars()
         if exemplars:
             out["slo_exemplars"] = exemplars
@@ -747,7 +1039,19 @@ class ContinuousBatcher:
             },
             "prefix": {
                 "hits": self.prefix_hits,
+                "host_hits": self.host_prefix_hits,
                 "tokens_saved": self.prefix_tokens_saved,
             },
+            "tier": (
+                {
+                    **self.kv_tier.stats(),
+                    "evictions": self.evictions,
+                    "resumes": self.resumes,
+                    "paused": len(self._paused),
+                    "paused_peak": self.paused_peak,
+                }
+                if self.kv_tier is not None
+                else None
+            ),
             "loop_error": self.loop_error,
         }

@@ -262,6 +262,18 @@ class ServeServer:
                     "weights_epoch": self.batcher.engine.weights_epoch,
                     "staleness": self.batcher.engine.staleness(),
                     "free_slots": self.batcher.slots.num_free,
+                    # cold-tier load rides health so pollers (router
+                    # probe, odtp_top) see paging pressure without /stats
+                    **(
+                        {
+                            "tier_occupancy": round(
+                                self.batcher.kv_tier.occupancy(), 4
+                            ),
+                            "tier_paused": self.batcher.kv_tier.paused_count,
+                        }
+                        if self.batcher.kv_tier is not None
+                        else {}
+                    ),
                     **self.identity(),
                 },
             )

@@ -336,7 +336,15 @@ class InnerTrainer:
         init_fn = functools.partial(init_params, cfg=self.model_cfg)
 
         if params is None:
-            params = jax.jit(init_fn, out_shardings=self.state_shardings["params"])(rng)
+            # init UNSHARDED, then reshard: with non-partitionable
+            # threefry (this jax's default) a sharded out_shardings
+            # changes the RNG lowering and thus the drawn values, so the
+            # same seed would yield different weights on different
+            # meshes — breaking every cross-mesh equivalence guarantee
+            # (and DiLoCo's same-seed multi-worker init contract)
+            params = jax.device_put(
+                jax.jit(init_fn)(rng), self.state_shardings["params"]
+            )
         else:
             params = jax.device_put(params, self.state_shardings["params"])
         opt_state = jax.jit(

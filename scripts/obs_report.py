@@ -328,16 +328,24 @@ def fleet_section(counters: dict) -> dict:
     per-replica push bytes split delta-vs-keyframe (the delta-push
     saving, measurable without the bench artifact), a staleness
     histogram (rounds the serving weights lagged the trainer, one sample
-    per push reply), and the router's dispatch/redispatch/death/rejoin
-    ledger per replica."""
+    per push reply), the router's dispatch/redispatch/death/rejoin
+    ledger per replica, and the prefix-directory routing hit rate."""
     push: dict = {}
     stale_hist: dict = {}
     router: dict = {}
+    dir_hits: dict = {}
+    dir_misses = 0
     for key, v in counters.items():
         if not key.startswith("fleet_"):
             continue
         name, labels = _parse_flat_key(key)
         rid = labels.get("replica", "?")
+        if name == "fleet_directory_hits":
+            dir_hits[rid] = dir_hits.get(rid, 0) + int(v)
+            continue
+        if name == "fleet_directory_misses":
+            dir_misses += int(v)
+            continue
         if name in ("fleet_push_bytes", "fleet_push_frames"):
             unit = "bytes" if name.endswith("bytes") else "frames"
             slot = push.setdefault(
@@ -365,7 +373,7 @@ def fleet_section(counters: dict) -> dict:
             short = name.removeprefix("fleet_router_").removeprefix("fleet_replica_")
             router.setdefault(short, {})
             router[short][rid] = router[short].get(rid, 0) + int(v)
-    if not (push or stale_hist or router):
+    if not (push or stale_hist or router or dir_hits or dir_misses):
         return {}
     out: dict = {}
     if push:
@@ -376,6 +384,18 @@ def fleet_section(counters: dict) -> dict:
         }
     if router:
         out["router"] = {k: router[k] for k in sorted(router)}
+    if dir_hits or dir_misses:
+        # prefix-directory routing: hit = a directory-routed request
+        # landed on a prefix holder; miss = no holder known (or all
+        # holders overloaded) and the router fell back to least-loaded
+        total = sum(dir_hits.values()) + dir_misses
+        out["prefix_directory"] = {
+            "hits_per_replica": {r: dir_hits[r] for r in sorted(dir_hits)},
+            "misses": dir_misses,
+            "hit_rate": round(sum(dir_hits.values()) / total, 4)
+            if total
+            else None,
+        }
     return out
 
 
@@ -536,6 +556,32 @@ def merge_report(trace_dir: str) -> tuple[dict, dict]:
             serve["decode_kernel"] = {
                 **({"steps_by_path": kernel_steps} if kernel_steps else {}),
                 **({"probe_us": probe_us} if probe_us else {}),
+            }
+        # host KV-tier surface: cold-tier load (last gauge sample per
+        # worker) plus the page-transfer byte/event counters
+        tier_gauges: dict[str, dict] = {}
+        for wid, _events, meta in workers:
+            g = meta.get("gauges") or {}
+            if "serve_tier_occupancy" in g:
+                tier_gauges[str(wid)] = {
+                    "occupancy": round(float(g["serve_tier_occupancy"]), 4),
+                    "paused": int(g.get("serve_tier_paused", 0)),
+                    "prefix_entries": int(
+                        g.get("serve_tier_prefix_entries", 0)
+                    ),
+                    "stored_bytes": int(g.get("serve_tier_stored_bytes", 0)),
+                }
+        page_out = serve_counters.get("serve_page_out_bytes", 0)
+        page_in = serve_counters.get("serve_page_in_bytes", 0)
+        if tier_gauges or page_out or page_in:
+            serve["kv_tier"] = {
+                **({"per_worker": tier_gauges} if tier_gauges else {}),
+                "page_out_bytes": int(page_out),
+                "page_in_bytes": int(page_in),
+                "evictions": int(
+                    serve_counters.get("serve_tier_evictions", 0)
+                ),
+                "resumes": int(serve_counters.get("serve_tier_resumes", 0)),
             }
 
     # WAN/intra byte split. The transport classifies every frame against the

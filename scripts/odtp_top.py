@@ -37,7 +37,7 @@ _COLS = (
     ("worker", 10), ("round", 18), ("partner", 10), ("epoch", 5),
     ("lag", 4), ("loss", 8), ("tok/s", 9), ("step/s", 7),
     ("pg_norm", 9), ("wan_tx", 9),
-    ("round_s", 8), ("stale", 5), ("age_s", 6),
+    ("round_s", 8), ("tier%", 6), ("stale", 5), ("age_s", 6),
 )
 
 
@@ -149,8 +149,18 @@ def reqtrace_from_dir(obs_dir: str) -> dict:
 _REQ_COLS = (
     ("worker", 10), ("trace", 26), ("state", 7), ("e2e_ms", 9),
     ("last", 8), ("queue", 7), ("prefill", 8), ("decode", 8),
-    ("swap", 6), ("attrs", 24),
+    ("page", 6), ("swap", 6), ("attrs", 24),
 )
+
+
+def _page_ms(row: dict):
+    """Cold-tier transfer time a request sat through (page_out +
+    page_in spans); None when it was never paged."""
+    s = row.get("stages_ms") or {}
+    out, back = s.get("page_out"), s.get("page_in")
+    if out is None and back is None:
+        return None
+    return round((out or 0.0) + (back or 0.0), 1)
 
 
 def _stage_ms(row: dict, stage: str):
@@ -170,7 +180,8 @@ def render_requests(snaps: dict) -> str:
                 worker, row.get("id"), "live",
                 round(row.get("age_ms", 0.0), 1), row.get("last_stage"),
                 _stage_ms(row, "queue"), _stage_ms(row, "prefill"),
-                _stage_ms(row, "decode"), _stage_ms(row, "swap"), "",
+                _stage_ms(row, "decode"), _page_ms(row),
+                _stage_ms(row, "swap"), "",
             )
             lines.append(" ".join(
                 _fmt(c, w) for c, (_, w) in zip(cells, _REQ_COLS)))
@@ -187,7 +198,8 @@ def render_requests(snaps: dict) -> str:
                 worker, row.get("id"), row.get("status"),
                 None if e2e is None else round(e2e, 1), "retire",
                 _stage_ms(row, "queue"), _stage_ms(row, "prefill"),
-                _stage_ms(row, "decode"), _stage_ms(row, "swap"), attr_s,
+                _stage_ms(row, "decode"), _page_ms(row),
+                _stage_ms(row, "swap"), attr_s,
             )
             lines.append(" ".join(
                 _fmt(c, w) for c, (_, w) in zip(cells, _REQ_COLS)))
@@ -243,6 +255,8 @@ def render(matrix: dict, now: float) -> str:
             vec.get("steps_per_s"), vec.get("pg_norm"),
             vec.get("wire_tx_bytes_wan"),
             stages.get("round_s", stages.get("pair_s")),
+            # serve cold-tier occupancy ("-" for workers without a tier)
+            vec.get("tier_occupancy"),
             vec.get("staleness"), round(now - ts, 1) if ts else None,
         )
         lines.append(" ".join(

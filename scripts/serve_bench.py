@@ -28,6 +28,20 @@ clear 2x the banked SERVE_BENCH.json tokens/s (full runs only).
 
     python scripts/serve_bench.py --decode            # banks DECODE_BENCH.json
     python scripts/serve_bench.py --decode --selftest # tiny CI run
+
+``--longctx`` runs the KV-tiering A/B (PR 20): the same open-loop long-
+context workload through two engines at EQUAL per-request context — an
+all-resident arm with one device slot per request, and a tiered arm with
+4x fewer slots plus the host cold tier (``ODTP_KV_TIER`` machinery)
+paging paused sequences D2H/H2D between decode steps. Banks a
+``longctx`` section into DECODE_BENCH.json (read-modify-write; the
+decode arms are preserved). Gates: the tiered arm serves an aggregate
+context >= 4x its device ring capacity, drops nothing, streams token-
+bit-identical outputs (codec none), and its TTFT p50 stays within 1.5x
+of the all-resident arm.
+
+    python scripts/serve_bench.py --longctx            # banks the longctx section
+    python scripts/serve_bench.py --longctx --selftest # tiny CI run
 """
 import argparse
 import json
@@ -475,6 +489,128 @@ def run_decode_arm(args, name, model_cfg, params, *, spec_k, weight_format) -> d
     return arm
 
 
+# -- long-context tiering A/B (--longctx) ------------------------------------
+
+
+def _longctx_arm(args, name, model_cfg, params, *, num_slots, kv_tier,
+                 prompts, max_new) -> dict:
+    """One open-loop leg: submit every request up front, wait for all.
+    Equal per-request context across arms — only slot count and the cold
+    tier differ."""
+    import jax.numpy as jnp
+
+    from opendiloco_tpu.serve import HostKVTier, ServeEngine
+    from opendiloco_tpu.serve.scheduler import ContinuousBatcher
+
+    engine = ServeEngine(
+        model_cfg,
+        params,
+        num_slots=num_slots,
+        max_context=args.max_context,
+        prefill_buckets=[args.max_context // 4, args.max_context],
+        compute_dtype=jnp.float32,
+    )
+    tier = (
+        HostKVTier(host_slots=len(prompts) + 4, codec=args.tier_codec)
+        if kv_tier
+        else None
+    )
+    batcher = ContinuousBatcher(engine, kv_tier=tier).start()
+    # warm the compile family (prefill buckets, decode, page transfers)
+    w = batcher.submit(prompts[0][: args.max_context // 4], max_new_tokens=2)
+    w.wait(300)
+    batcher.drain(timeout=60)
+    t0 = time.perf_counter()
+    reqs = [batcher.submit(p, max_new_tokens=max_new) for p in prompts]
+    hung = [r for r in reqs if not r.wait(600)]
+    elapsed = time.perf_counter() - t0
+    stats = batcher.stats()
+    batcher.stop()
+    errors = [r.error for r in reqs if r.error is not None]
+    ttfts = [r.ttft_s * 1e3 for r in reqs if r.ttft_s is not None]
+    arm = {
+        "slots": num_slots,
+        "kv_tier": bool(kv_tier),
+        "requests": len(prompts),
+        "per_request_context": len(prompts[0]) + max_new,
+        "device_ring_tokens": num_slots * args.max_context,
+        "aggregate_context_tokens": sum(len(p) + max_new for p in prompts),
+        "duration_s": round(elapsed, 3),
+        "tokens_per_s": round(stats["new_tokens"] / elapsed, 3),
+        "ttft_p50_ms": round(float(np.percentile(ttfts, 50)), 3) if ttfts else None,
+        "ttft_p99_ms": round(float(np.percentile(ttfts, 99)), 3) if ttfts else None,
+        "latency_ms": stats["latency_ms"],
+        "dropped": stats["failed"] + len(hung),
+        "errors": errors[:5],
+        "loop_error": stats["loop_error"],
+        "tier": stats["tier"],
+    }
+    tokens = [list(r.tokens) for r in reqs]
+    print(
+        f"[{name}] slots={num_slots} tier={bool(kv_tier)} "
+        f"ttft_p50={arm['ttft_p50_ms']}ms tokens/s={arm['tokens_per_s']} "
+        f"dropped={arm['dropped']}"
+        + (
+            f" evictions={stats['tier']['evictions']}"
+            f" resumes={stats['tier']['resumes']}"
+            if stats["tier"]
+            else ""
+        )
+    )
+    return arm, tokens
+
+
+def run_longctx(args) -> dict:
+    model_cfg, params = _decode_model(args, 0)
+    rng = np.random.default_rng(3)
+    max_new = args.max_new
+    prompt_len = args.max_context - max_new  # final context fills the ring
+    tiered_slots = max(1, args.slots)
+    # enough concurrent requests that their aggregate context is >= 4x the
+    # tiered arm's device ring (the whole point of the cold tier), with
+    # half a slot's worth of margin over the exact 4x line
+    n_req = -(-9 * tiered_slots // 2)  # ceil(4.5 * slots)
+    prompts = [
+        rng.integers(1, model_cfg.vocab_size, prompt_len).tolist()
+        for _ in range(n_req)
+    ]
+    resident, tok_resident = _longctx_arm(
+        args, "all-resident", model_cfg, params,
+        num_slots=n_req, kv_tier=False, prompts=prompts, max_new=max_new,
+    )
+    tiered, tok_tiered = _longctx_arm(
+        args, "tiered", model_cfg, params,
+        num_slots=tiered_slots, kv_tier=True, prompts=prompts, max_new=max_new,
+    )
+    bit_exact = tok_resident == tok_tiered
+    overcommit = (
+        tiered["aggregate_context_tokens"] / tiered["device_ring_tokens"]
+    )
+    ttft_ratio = (
+        tiered["ttft_p50_ms"] / resident["ttft_p50_ms"]
+        if tiered["ttft_p50_ms"] and resident["ttft_p50_ms"]
+        else None
+    )
+    return {
+        "model": {
+            "hidden": model_cfg.hidden_size,
+            "layers": model_cfg.num_hidden_layers,
+            "vocab": model_cfg.vocab_size,
+        },
+        "load": {
+            "requests": n_req,
+            "prompt_tokens": prompt_len,
+            "max_new_tokens": max_new,
+            "max_context": args.max_context,
+            "tier_codec": args.tier_codec,
+        },
+        "arms": {"all_resident": resident, "tiered": tiered},
+        "overcommit_x": round(overcommit, 3),
+        "ttft_p50_ratio": round(ttft_ratio, 3) if ttft_ratio else None,
+        "token_bit_exact": bit_exact,
+    }
+
+
 def run_decode(args) -> dict:
     model_cfg, params = _decode_model(args, args.train_steps)
     probes = _parity_gate(args, model_cfg, params, "fp32")
@@ -534,6 +670,14 @@ def main() -> None:
     ap.add_argument("--decode", action="store_true",
                     help="fast-decode A/B: plain vs spec vs spec+w4 arms over "
                          "static weights; banks DECODE_BENCH.json")
+    ap.add_argument("--longctx", action="store_true",
+                    help="KV-tiering A/B: all-resident vs host-cold-tier arms "
+                         "at equal per-request context; banks a `longctx` "
+                         "section into DECODE_BENCH.json")
+    ap.add_argument("--tier-codec", default="none",
+                    choices=("none", "blockwise4bit"),
+                    help="cold-page codec for the --longctx tiered arm "
+                         "(bit-exactness is only gated with `none`)")
     ap.add_argument("--spec-k", type=int, default=4,
                     help="draft tokens per slot per step in the spec arms")
     ap.add_argument("--draft-layers", type=int, default=0,
@@ -555,22 +699,73 @@ def main() -> None:
     ap.add_argument("--swap-every", type=int, default=8)
     args = ap.parse_args()
 
-    out_path = _DECODE_OUT if args.decode else _OUT
+    out_path = _DECODE_OUT if (args.decode or args.longctx) else _OUT
     if args.selftest:
         args.duration = min(args.duration, 8.0 if not args.decode else 6.0)
         args.clients = min(args.clients, 3)
-        args.slots = min(args.slots, 4)
+        args.slots = min(args.slots, 4 if not args.longctx else 2)
         args.hidden = min(args.hidden, 64)
         args.layers = min(args.layers, 2)
-        args.max_new = min(args.max_new, 8)
+        args.max_new = min(args.max_new, 8 if not args.longctx else 16)
         args.train_steps = min(args.train_steps, 150)
         args.local_steps = min(args.local_steps, 5)
-        name = "DECODE_BENCH" if args.decode else "SERVE_BENCH"
+        if args.longctx:
+            args.max_context = min(args.max_context, 64)
+        name = "DECODE_BENCH" if (args.decode or args.longctx) else "SERVE_BENCH"
         out_path = os.path.join(
             os.environ.get("TMPDIR", "/tmp"), f"{name}.selftest.json"
         )
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if args.longctx:
+        if not args.selftest:
+            args.slots = min(args.slots, 4)  # 4 device slots vs ~18 requests
+        result = run_longctx(args)
+        # read-modify-write: the longctx section rides DECODE_BENCH.json
+        # next to the fast-decode arms without clobbering them
+        try:
+            with open(out_path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            doc = {"schema": 1}
+        doc["longctx"] = {
+            "selftest": bool(args.selftest),
+            "updated": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+            **result,
+        }
+        with open(out_path, "w") as f:
+            json.dump(doc, f, indent=1, sort_keys=True)
+        print(f"wrote {out_path} (longctx section)")
+        lx = doc["longctx"]
+        print(
+            f"overcommit={lx['overcommit_x']}x ttft_ratio={lx['ttft_p50_ratio']} "
+            f"bit_exact={lx['token_bit_exact']}"
+        )
+        for name, arm in lx["arms"].items():
+            if arm["dropped"] != 0 or arm["errors"] or arm["loop_error"]:
+                raise SystemExit(
+                    f"longctx arm {name}: dropped={arm['dropped']} "
+                    f"errors={arm['errors']} loop={arm['loop_error']}"
+                )
+        if lx["overcommit_x"] < 4.0:
+            raise SystemExit(
+                f"tiered arm served only {lx['overcommit_x']}x its device "
+                "ring — acceptance is >= 4x"
+            )
+        if args.tier_codec == "none" and not lx["token_bit_exact"]:
+            raise SystemExit("tiered token streams diverged from all-resident")
+        ratio = lx["ttft_p50_ratio"]
+        if ratio is not None and ratio > 1.5:
+            # CPU CI boxes jitter; absolute slack covers tiny-p50 noise
+            p50s = (
+                lx["arms"]["tiered"]["ttft_p50_ms"],
+                lx["arms"]["all_resident"]["ttft_p50_ms"],
+            )
+            if not (args.selftest and p50s[0] - p50s[1] <= 200.0):
+                raise SystemExit(
+                    f"tiered TTFT p50 regression {ratio}x — acceptance is <= 1.5x"
+                )
+        return
     if args.decode:
         # per-stage breakdown rides obs spans: arm the tracer for the run
         os.environ.setdefault("ODTP_OBS", "1")

@@ -25,6 +25,10 @@ Gates (SystemExit on violation):
 - every ready replica's reported staleness stays within
   max_stale_rounds (sampled throughout the run)
 - the dead-peer watchdog named the killed replica (chaos plane armed)
+- prefix-directory leg: a shared system prompt is prefilled exactly once
+  fleet-wide (every later request directory-routes to the holder and
+  reuses the banked prefix K/V); SIGKILLing the holder invalidates its
+  directory entries and traffic re-routes with zero drops
 - full runs only: requests/s scales with the fleet (>= 0.5x linear)
 """
 import argparse
@@ -202,7 +206,8 @@ class ClientPool:
         return round(float(np.percentile(lat, q)) * 1e3, 3)
 
 
-def spawn_fleet(model_cfg, args, n_replicas):
+def spawn_fleet(model_cfg, args, n_replicas, *, serve_geom=None,
+                prefix_directory=False):
     """Publisher + manager + router + n subprocess replicas, all ready."""
     from opendiloco_tpu.fleet import (
         DeltaPublisher,
@@ -218,7 +223,12 @@ def spawn_fleet(model_cfg, args, n_replicas):
         fragments=args.fragments,
         keyframe_every=args.keyframe_every,
     )
-    router = FleetRouter(port=0, probe_interval_s=0.25, request_timeout=120.0)
+    router = FleetRouter(
+        port=0,
+        probe_interval_s=0.25,
+        request_timeout=120.0,
+        prefix_directory=prefix_directory,
+    )
     mgr = FleetManager(pub, router, push_interval_s=args.push_interval)
 
     procs, infos = {}, {}
@@ -230,7 +240,7 @@ def spawn_fleet(model_cfg, args, n_replicas):
             procs[rid], infos[rid] = spawn_replica(
                 rid,
                 model_cfg,
-                serve=SERVE_GEOM,
+                serve=serve_geom or SERVE_GEOM,
                 max_stale_rounds=args.max_stale_rounds,
             )
         except Exception as e:  # noqa: BLE001 - surfaced as a gate below
@@ -400,6 +410,174 @@ def run_chaos_leg(args, procs, infos, mgr, router, monitor):
         "downtime_s": round(time.perf_counter() - t_kill, 3),
         "rejoined": True,
     }
+
+
+def _router_request(port, prompt, max_new):
+    """One JSONL request through the router on a fresh connection."""
+    with socket.create_connection(("127.0.0.1", port), timeout=120) as conn:
+        conn.sendall(
+            (
+                json.dumps({"prompt": prompt, "max_new_tokens": max_new})
+                + "\n"
+            ).encode()
+        )
+        buf = b""
+        while b"\n" not in buf:
+            chunk = conn.recv(65536)
+            if not chunk:
+                raise OSError("router closed the connection")
+            buf += chunk
+    return json.loads(buf.partition(b"\n")[0].decode())
+
+
+def run_prefix_leg(args, model_cfg, n_replicas) -> dict:
+    """Fleet prefix-cache directory (PR 20): a shared system prompt is
+    prefilled ONCE fleet-wide — the first request cold-prefills it, the
+    replica banks the prefix K/V in its host tier and advertises the hash
+    through its health frames, and the router's directory sends every
+    later shared-prefix request to the holder, which reuses the pages.
+    SIGKILLing the holder must drop its directory entries and re-route
+    the traffic to the survivors with zero drops."""
+    sim, pub, router, mgr, procs, infos = spawn_fleet(
+        model_cfg, args, n_replicas,
+        serve_geom={
+            **SERVE_GEOM,
+            "kv_tier": True,
+            "kv_host_slots": 16,
+            # shared prefix (64) + unique suffix (8) needs a bucket past
+            # the load-arm geometry's 64
+            "prefill_buckets": [16, 64, 96],
+        },
+        prefix_directory=True,
+    )
+    try:
+        # freeze the outer loop: prefix K/V is invalidated on every weight
+        # swap (by design — cached pages must match the serving epoch), and
+        # the sim trainer's 1 s epochs would purge entries faster than any
+        # client could reuse them. Real fleets reuse a system prompt within
+        # an outer epoch, which is minutes long; the swap-invalidation path
+        # itself is pinned by the kv-tier unit tests.
+        sim.stop()
+        _warm(infos, model_cfg.vocab_size)
+        rng = np.random.default_rng(7)
+        shared = rng.integers(1, model_cfg.vocab_size, 64).tolist()
+
+        def ask(seed):
+            sr = np.random.default_rng(4000 + seed)
+            prompt = shared + sr.integers(1, model_cfg.vocab_size, 8).tolist()
+            out = _router_request(router.port, prompt, args.max_new)
+            if not out.get("tokens"):
+                raise SystemExit(f"prefix leg: request {seed} failed: {out}")
+
+        def fleet_prefix_stats():
+            per = {}
+            for rid, info in infos.items():
+                try:
+                    with urllib.request.urlopen(
+                        f"http://127.0.0.1:{info['serve_port']}/stats",
+                        timeout=5,
+                    ) as r:
+                        s = json.loads(r.read())
+                except (OSError, ValueError):
+                    continue  # dead (the kill phase's business)
+                tier = s.get("tier") or {}
+                per[rid] = {
+                    "hits": s["prefix"]["hits"] + s["prefix"]["host_hits"],
+                    "stores": tier.get("prefix_stores", 0),
+                }
+            return per
+
+        def dir_entries():
+            return (router.stats()["prefix_directory"] or {}).get("entries", 0)
+
+        # let the warm prompts' own prefix advertisements settle so the
+        # seed request's entry is measured against a quiet baseline
+        time.sleep(args.push_interval * 2 + 0.5)
+        entries0 = dir_entries()
+        base = fleet_prefix_stats()
+
+        # -- seed: ONE cold prefill of the shared prompt, fleet-wide ------
+        ask(0)
+        _wait(
+            lambda: dir_entries() > entries0,
+            30,
+            "the seeded prefix reaching the router directory",
+        )
+        seeded = fleet_prefix_stats()
+        seed_stores = {
+            rid: seeded[rid]["stores"] - base[rid]["stores"] for rid in seeded
+        }
+        holders = [rid for rid, n in seed_stores.items() if n > 0]
+
+        # -- flood: every request must reuse the seeded prefill -----------
+        flood_n = 12
+        for i in range(1, flood_n + 1):
+            ask(i)
+        flooded = fleet_prefix_stats()
+        flood_hits = sum(
+            flooded[rid]["hits"] - seeded[rid]["hits"] for rid in flooded
+        )
+        flood_stores = sum(
+            flooded[rid]["stores"] - seeded[rid]["stores"] for rid in flooded
+        )
+        rstats = router.stats()
+        dir_hits = (rstats["prefix_directory"] or {}).get("hits", 0)
+
+        # -- kill the holder: entries drop, traffic re-routes -------------
+        victim = holders[0] if holders else sorted(infos)[0]
+        entries_before_kill = dir_entries()
+        procs[victim].send_signal(signal.SIGKILL)
+        procs[victim].wait(timeout=30)
+        _wait(
+            lambda: router.stats()["replicas"][victim]["dead"],
+            60,
+            f"router noticing prefix holder {victim} died",
+        )
+        entries_after_kill = dir_entries()
+        refill_n = 6
+        ask(100)  # re-seeds the prefix on a survivor (zero drops: ask()
+        # raises on any error). Wait for the survivor's advertisement so
+        # the remaining traffic routes by directory, not by luck.
+        _wait(
+            lambda: dir_entries() > entries_after_kill,
+            30,
+            "a survivor advertising the re-seeded prefix",
+        )
+        for i in range(101, 100 + refill_n):
+            ask(i)
+        refilled = fleet_prefix_stats()
+        refill_stores = sum(
+            refilled[rid]["stores"] - flooded[rid]["stores"]
+            for rid in refilled
+        )
+        return {
+            "replicas": n_replicas,
+            "shared_prefix_tokens": len(shared),
+            "holder": victim,
+            "seed_stores": sum(seed_stores.values()),
+            "flood": {
+                "requests": flood_n,
+                "prefix_hits": flood_hits,
+                "cold_stores": flood_stores,
+                "directory_hits": dir_hits,
+            },
+            "kill": {
+                "directory_entries_before": entries_before_kill,
+                "directory_entries_after": entries_after_kill,
+                "rerouted_requests": refill_n,
+                "reroute_stores": refill_stores,
+            },
+        }
+    finally:
+        mgr.stop()
+        router.stop()
+        sim.stop()
+        for p in procs.values():
+            try:
+                p.kill()
+                p.wait(timeout=10)
+            except OSError:
+                pass
 
 
 def run_arm(args, model_cfg, n_replicas, with_chaos) -> dict:
@@ -611,6 +789,17 @@ def main() -> None:
         f"downtime {chaos_arm['chaos']['downtime_s']}s"
     )
 
+    prefix_n = 2 if args.selftest else 3
+    print(f"=== prefix-directory leg: {prefix_n} replicas ===")
+    prefix_arm = run_prefix_leg(args, model_cfg, prefix_n)
+    print(
+        f"    seed_stores={prefix_arm['seed_stores']} "
+        f"flood_hits={prefix_arm['flood']['prefix_hits']}/"
+        f"{prefix_arm['flood']['requests']} "
+        f"cold_stores={prefix_arm['flood']['cold_stores']} "
+        f"reroute_stores={prefix_arm['kill']['reroute_stores']}"
+    )
+
     base = arms[str(sizes[0])]["requests_per_s"] / sizes[0]
     scaling = {
         str(n): round(arms[str(n)]["requests_per_s"] / base, 3) if base else None
@@ -637,6 +826,7 @@ def main() -> None:
         },
         "arms": arms,
         "chaos_arm": chaos_arm,
+        "prefix_directory_arm": prefix_arm,
         "scaling_speedup": scaling,
     }
     with open(out_path, "w") as f:
@@ -689,6 +879,46 @@ def main() -> None:
         raise SystemExit("chaos arm: SIGKILLed replica never rejoined")
     if not chaos["dead_peer_watchdog_tripped"]:
         raise SystemExit("chaos arm: dead-peer watchdog never named the victim")
+    # prefix-directory leg: the shared prompt was prefilled exactly once
+    # fleet-wide, every flood request reused it via the directory, and the
+    # holder's death dropped its entries and re-routed traffic (ask()
+    # raised on any dropped/errored request, so reaching here = 0 drops)
+    pfx = prefix_arm
+    if pfx["seed_stores"] != 1:
+        raise SystemExit(
+            f"prefix leg: shared prompt cold-prefilled {pfx['seed_stores']} "
+            "time(s) at seed — acceptance is exactly once fleet-wide"
+        )
+    if pfx["flood"]["cold_stores"] != 0:
+        raise SystemExit(
+            f"prefix leg: {pfx['flood']['cold_stores']} flood request(s) "
+            "re-prefilled the shared prompt — every one must reuse the "
+            "seeded prefill"
+        )
+    if pfx["flood"]["prefix_hits"] < pfx["flood"]["requests"]:
+        raise SystemExit(
+            f"prefix leg: only {pfx['flood']['prefix_hits']} of "
+            f"{pfx['flood']['requests']} flood requests hit the cached "
+            "prefix"
+        )
+    if pfx["flood"]["directory_hits"] < pfx["flood"]["requests"]:
+        raise SystemExit(
+            f"prefix leg: router directory routed only "
+            f"{pfx['flood']['directory_hits']} of "
+            f"{pfx['flood']['requests']} flood requests to the holder"
+        )
+    if pfx["kill"]["directory_entries_after"] >= pfx["kill"][
+            "directory_entries_before"]:
+        raise SystemExit(
+            "prefix leg: the SIGKILLed holder's directory entries were "
+            "not invalidated"
+        )
+    if pfx["kill"]["reroute_stores"] != 1:
+        raise SystemExit(
+            f"prefix leg: post-kill traffic re-prefilled the shared "
+            f"prompt {pfx['kill']['reroute_stores']} time(s) on the "
+            "survivors — acceptance is exactly once"
+        )
     if not args.selftest and len(sizes) > 1:
         # ~linear scaling, honestly bounded by the host: N replicas cannot
         # beat the core count on a CPU rig, so the expectation is

@@ -1,0 +1,366 @@
+"""Host-memory cold KV tier + fleet prefix-cache directory (PR 20).
+
+Covers the tier's paused-page and prefix-entry stores (codec none and
+blockwise4bit), the scheduler's evict/page-back path (bit-exact token
+streams under slot pressure), host prefix restore across a ring wrap,
+SlotAllocator edge cases, and the router directory's update / route /
+invalidate-on-death lifecycle — including the mixed-fleet interop rule
+that old peers ignore the new health-frame ``prefixes`` field.
+"""
+import socket
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from opendiloco_tpu.models.llama import init_params
+from opendiloco_tpu.serve import (
+    ContinuousBatcher,
+    HostKVTier,
+    ServeEngine,
+    SlotAllocator,
+    pick_bucket,
+)
+from opendiloco_tpu.serve.kvcache import prefix_grid_lengths, prefix_key
+
+
+def make_engine(tiny_cfg, seed=0, **kw):
+    params = init_params(jax.random.PRNGKey(seed), tiny_cfg)
+    kw.setdefault("num_slots", 4)
+    kw.setdefault("max_context", 64)
+    kw.setdefault("prefill_buckets", (8, 16, 32))
+    kw.setdefault("compute_dtype", jnp.float32)
+    return ServeEngine(tiny_cfg, params, **kw), params
+
+
+def wait_for(pred, timeout=10.0):
+    """Prefix stores finalize on a later scheduler pass (the D2H fetch
+    overlaps decode); poll instead of racing the loop thread."""
+    import time
+
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.02)
+    raise AssertionError("condition never became true")
+
+
+def run_requests(batcher, prompts, max_new=8, timeout=120):
+    reqs = [batcher.submit(p, max_new_tokens=max_new) for p in prompts]
+    for r in reqs:
+        assert r.wait(timeout), "request hung"
+        assert r.error is None, r.error
+    return [list(r.tokens) for r in reqs]
+
+
+# ---------------------------------------------------------------------------
+# HostKVTier unit surface
+# ---------------------------------------------------------------------------
+
+
+def test_tier_paused_roundtrip_exact(rng):
+    tier = HostKVTier(host_slots=4, codec="none")
+    k = rng.standard_normal((2, 16, 2, 8)).astype(np.float32)
+    v = rng.standard_normal((2, 16, 2, 8)).astype(np.float32)
+    tier.put_paused(7, k, v)
+    assert tier.paused_count == 1 and tier.stored_bytes() > 0
+    with pytest.raises(ValueError):
+        tier.put_paused(7, k, v)  # double-pause is a scheduler bug
+    rk, rv = tier.pop_paused(7)
+    np.testing.assert_array_equal(rk, k)  # codec none = bit-exact
+    np.testing.assert_array_equal(rv, v)
+    assert tier.paused_count == 0
+    assert not tier.drop_paused(7)  # already popped
+
+
+def test_tier_pin_budget_reclaims_prefix_entries(rng):
+    tier = HostKVTier(host_slots=2, codec="none")
+    k = rng.standard_normal((1, 8, 2, 4)).astype(np.float32)
+    assert tier.put_prefix("aa", 8, 0, k, k)
+    assert tier.put_prefix("bb", 8, 0, k, k)
+    assert tier.prefix_count == 2
+    # pinned pages preempt droppable prefix entries under budget
+    tier.put_paused(1, k, k)
+    tier.put_paused(2, k, k)
+    assert tier.paused_count == 2 and tier.prefix_count == 0
+    assert tier.prefix_dropped == 2
+    assert not tier.can_pin()
+    # all pinned: a new prefix entry is declined, never evicts a pin
+    assert not tier.put_prefix("cc", 8, 0, k, k)
+    tier.pop_paused(1)
+    assert tier.can_pin()
+
+
+def test_tier_prefix_epoch_invalidation_and_lru(rng):
+    tier = HostKVTier(host_slots=3, codec="none")
+    k = rng.standard_normal((1, 8, 2, 4)).astype(np.float32)
+    tier.put_prefix("aa", 8, 0, k, k)
+    got = tier.get_prefix("aa", 8, 0)
+    assert got is not None
+    np.testing.assert_array_equal(got[0], k)
+    # stale-epoch entries never serve and are deleted on touch
+    assert tier.get_prefix("aa", 8, 1) is None
+    assert tier.prefix_count == 0
+    # purge_stale sweeps without a lookup
+    tier.put_prefix("bb", 8, 0, k, k)
+    tier.put_prefix("cc", 8, 1, k, k)
+    tier.purge_stale(1)
+    assert tier.resident_prefixes(1) == [["cc", 8]]
+    assert tier.prefix_stale_purged >= 1
+    # LRU: oldest droppable entry leaves when the budget fills
+    tier.put_prefix("dd", 8, 1, k, k)
+    tier.put_prefix("ee", 8, 1, k, k)
+    tier.put_prefix("ff", 8, 1, k, k)
+    assert tier.prefix_count == 3
+    assert tier.get_prefix("cc", 8, 1) is None  # LRU-dropped
+
+
+def test_tier_blockwise4bit_restore_error_bounded(rng):
+    tier = HostKVTier(host_slots=2, codec="blockwise4bit")
+    k = rng.standard_normal((2, 32, 2, 8)).astype(np.float32)
+    v = rng.standard_normal((2, 32, 2, 8)).astype(np.float32)
+    tier.put_paused(1, k, v)
+    assert tier.stored_bytes() < (k.nbytes + v.nbytes) / 4  # actually small
+    rk, rv = tier.pop_paused(1)
+    assert rk.shape == k.shape and rk.dtype == k.dtype
+    # 4-bit blockwise quantization: divergence exists but is bounded.
+    # Pinned: loosening this bound is a compression regression.
+    assert 0.0 < float(np.max(np.abs(rk - k))) < 0.35
+    assert 0.0 < float(np.max(np.abs(rv - v))) < 0.35
+
+
+def test_prefix_grid_helpers():
+    # grid lengths are strictly < the prompt length (a full-prompt entry
+    # would leave no suffix to decode from) and descend for lookup order
+    assert prefix_grid_lengths(65) == [64, 32, 16]
+    assert prefix_grid_lengths(64) == [32, 16]
+    assert prefix_grid_lengths(17) == [16]
+    assert prefix_grid_lengths(16) == []
+    a = prefix_key(list(range(100)), 32)
+    b = prefix_key(list(range(32)) + [999], 32)
+    assert a == b  # key covers exactly the first glen tokens
+    assert a != prefix_key(list(range(100)), 64)
+
+
+# ---------------------------------------------------------------------------
+# SlotAllocator edge cases (satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_slot_allocator_exhaustion_and_reuse_order():
+    a = SlotAllocator(3)
+    assert [a.alloc() for _ in range(3)] == [0, 1, 2]
+    assert a.alloc() is None and a.alloc() is None  # exhaustion is stable
+    assert (a.num_free, a.num_active) == (0, 3)
+    # free-then-reuse: LIFO — the most recently freed slot is handed out
+    # first (its pages are the most likely still cache-warm)
+    a.free(1)
+    a.free(0)
+    assert a.alloc() == 0
+    assert a.alloc() == 1
+    assert a.alloc() is None
+    with pytest.raises(ValueError):
+        SlotAllocator(0)
+
+
+def test_pick_bucket_boundaries():
+    assert pick_bucket(8, [8, 16]) == 8  # exact fit stays in its bucket
+    assert pick_bucket(9, [8, 16]) == 16
+    assert pick_bucket(16, [8, 16]) == 16
+    assert pick_bucket(17, [8, 16]) is None  # over the largest bucket
+    assert pick_bucket(1, [8, 16]) == 8
+    assert pick_bucket(0, [8, 16]) == 8
+
+
+# ---------------------------------------------------------------------------
+# evict / page-back correctness (the tentpole's bit-exactness gates)
+# ---------------------------------------------------------------------------
+
+
+def _tiered_vs_resident(tiny_cfg, *, tiered_slots, n_req, codec="none",
+                        quantum=2):
+    rng = np.random.default_rng(11)
+    prompts = [
+        rng.integers(1, tiny_cfg.vocab_size, 12).tolist() for _ in range(n_req)
+    ]
+    engine_a, _ = make_engine(tiny_cfg, num_slots=n_req)
+    ba = ContinuousBatcher(engine_a).start()
+    want = run_requests(ba, prompts)
+    ba.stop()
+    engine_b, _ = make_engine(tiny_cfg, num_slots=tiered_slots)
+    tier = HostKVTier(host_slots=n_req + 2, codec=codec)
+    bb = ContinuousBatcher(
+        engine_b,
+        kv_tier=tier,
+        tier_quantum_steps=quantum,
+        tier_min_resident_steps=1,
+    ).start()
+    got = run_requests(bb, prompts)
+    stats = bb.stats()
+    bb.stop()
+    return want, got, stats
+
+
+def test_tier_on_no_pressure_is_bit_exact(tiny_cfg):
+    # as many slots as requests: the tier arms but never fires, and the
+    # token streams are identical to the all-resident scheduler
+    want, got, stats = _tiered_vs_resident(tiny_cfg, tiered_slots=4, n_req=4)
+    assert stats["tier"]["evictions"] == 0
+    assert got == want
+
+
+def test_evict_pageback_codec_none_is_bit_exact(tiny_cfg):
+    # 6 requests through 2 slots: eviction + page-back MUST happen, and
+    # with codec none the continued streams are bit-exact
+    want, got, stats = _tiered_vs_resident(tiny_cfg, tiered_slots=2, n_req=6)
+    assert stats["tier"]["evictions"] > 0
+    assert stats["tier"]["resumes"] == stats["tier"]["evictions"]
+    assert stats["tier"]["paused"] == 0  # everyone came back
+    assert got == want
+
+
+def test_evict_pageback_blockwise4bit_completes(tiny_cfg):
+    # quantized cold pages: streams may diverge (bounded by the codec
+    # test above), but every request still completes through the churn
+    want, got, stats = _tiered_vs_resident(
+        tiny_cfg, tiered_slots=2, n_req=6, codec="blockwise4bit"
+    )
+    assert stats["tier"]["evictions"] > 0
+    assert [len(t) for t in got] == [len(t) for t in want]
+
+
+def test_host_prefix_restore_across_ring_wrap(tiny_cfg):
+    # install a host-tier prefix, then decode far enough that the ring
+    # wraps: restored pages must behave exactly like freshly-prefilled
+    # ones under the ring-live-rows masking contract
+    rng = np.random.default_rng(5)
+    prompt = rng.integers(1, tiny_cfg.vocab_size, 24).tolist()
+    max_new = 16  # 24 + 16 = 40 > max_context 32 -> wrap
+    engine_a, params = make_engine(
+        tiny_cfg, num_slots=2, max_context=32, prefill_buckets=(8, 16, 32)
+    )
+    ba = ContinuousBatcher(engine_a).start()
+    want = run_requests(ba, [prompt], max_new=max_new)
+    ba.stop()
+
+    glen = prefix_grid_lengths(len(prompt))[0]
+    engine_b, _ = make_engine(
+        tiny_cfg, num_slots=2, max_context=32, prefill_buckets=(8, 16, 32)
+    )
+    tier = HostKVTier(host_slots=4, codec="none")
+    bb = ContinuousBatcher(engine_b, kv_tier=tier, prefix_cache=True).start()
+    run_requests(bb, [prompt[:glen] + [1, 2]], max_new=2)  # seeds the store
+    wait_for(lambda: tier.prefix_count == 1)
+    got = run_requests(bb, [prompt], max_new=max_new)
+    stats = bb.stats()
+    bb.stop()
+    assert stats["prefix"]["host_hits"] == 1
+    assert got == want
+
+
+def test_resident_prefixes_advertises_current_epoch(tiny_cfg):
+    engine, _ = make_engine(tiny_cfg, num_slots=2)
+    tier = HostKVTier(host_slots=4, codec="none")
+    b = ContinuousBatcher(engine, kv_tier=tier, prefix_cache=True).start()
+    rng = np.random.default_rng(9)
+    prompt = rng.integers(1, tiny_cfg.vocab_size, 20).tolist()
+    run_requests(b, [prompt], max_new=2)
+    wait_for(lambda: b.resident_prefixes())
+    adv = b.resident_prefixes()
+    b.stop()
+    assert adv == [[prefix_key(prompt, 16), 16]]
+
+
+# ---------------------------------------------------------------------------
+# router prefix-cache directory
+# ---------------------------------------------------------------------------
+
+
+def make_router(**kw):
+    from opendiloco_tpu.fleet import FleetRouter
+
+    kw.setdefault("port", 0)
+    kw.setdefault("probe_interval_s", 120.0)  # no probes during the test
+    return FleetRouter(**kw)
+
+
+def test_directory_update_route_and_clear():
+    r = make_router(prefix_directory=True)
+    try:
+        r.add_replica("r0", "127.0.0.1", 1)
+        r.add_replica("r1", "127.0.0.1", 2)
+        prompt = list(range(40))
+        key = prefix_key(prompt, 32)
+        r.update_prefixes("r0", [[key, 32]])
+        assert r.stats()["prefix_directory"]["entries"] == 1
+        picked = r._pick(prompt, set())
+        assert picked is not None and picked.rid == "r0"
+        assert r.stats()["prefix_directory"]["hits"] == 1
+        # wholesale replace: an advertisement without the entry clears it
+        r.update_prefixes("r0", [])
+        assert r.stats()["prefix_directory"]["entries"] == 0
+    finally:
+        r.stop()
+
+
+def test_directory_invalidates_on_death_and_removal():
+    r = make_router(prefix_directory=True)
+    try:
+        r.add_replica("r0", "127.0.0.1", 1)
+        r.add_replica("r1", "127.0.0.1", 2)
+        key = prefix_key(list(range(40)), 32)
+        r.update_prefixes("r0", [[key, 32]])
+        r.update_prefixes("r1", [[key, 32]])
+        assert r.stats()["prefix_directory"]["entries"] == 1  # shared entry
+        r._mark_dead(r._backends["r0"])
+        # the dead holder no longer attracts traffic; the live one does
+        picked = r._pick(list(range(40)), set())
+        assert picked is not None and picked.rid == "r1"
+        r.remove_replica("r1")
+        assert r.stats()["prefix_directory"]["entries"] == 0
+    finally:
+        r.stop()
+
+
+def test_directory_off_ignores_advertisements():
+    # mixed-fleet interop: an OLD router (directory off — the shipped
+    # default) receiving a NEW replica's ``prefixes`` health field must
+    # ignore it and keep routing by load/affinity
+    r = make_router(prefix_directory=False)
+    try:
+        r.add_replica("r0", "127.0.0.1", 1)
+        r.update_prefixes("r0", [[prefix_key(list(range(40)), 32), 32]])
+        assert r.stats()["prefix_directory"] is None
+        assert r._pick(list(range(40)), set()) is not None
+    finally:
+        r.stop()
+
+
+def test_health_frame_prefixes_survive_wire_and_old_consumers():
+    # the advertisement rides the push-reply health dict as a NEW key:
+    # it must round-trip the fleet framing intact, and an old consumer
+    # reading only the keys it knows must be unaffected by its presence
+    from opendiloco_tpu.fleet import wire
+
+    health = {
+        "queue_depth": 0,
+        "occupancy": 0.5,
+        "p99_ms": 12.0,
+        "ready": True,
+        "prefixes": [["deadbeefdeadbeef", 64]],
+    }
+    a, b = socket.socketpair()
+    try:
+        wire.send_frame(a, "ok", {"health": health, "staleness": 0})
+        kind, meta, payload = wire.recv_frame(b, timeout=5.0)
+    finally:
+        a.close()
+        b.close()
+    assert kind == "ok" and payload == b""
+    got = meta["health"]
+    assert got["prefixes"] == [["deadbeefdeadbeef", 64]]
+    # an old peer's view: only the fields it knows, unknown keys ignored
+    old_view = {k: got.get(k) for k in ("queue_depth", "occupancy", "p99_ms")}
+    assert old_view == {"queue_depth": 0, "occupancy": 0.5, "p99_ms": 12.0}

@@ -102,8 +102,10 @@ def test_moe_pp_loss_matches_sequential():
     """MoE composes with pipeline parallelism: the router aux rides the
     pipeline's per-stage accumulators (parallel/pipeline.py). With
     microbatches=1 the total loss (xent + aux) is exactly the unpipelined
-    value; with M>1 the aux becomes the mean of microbatch-local router
-    statistics (standard GPipe semantics) and training still runs."""
+    value; with M>1 EVERY router batch statistic becomes microbatch-local
+    (standard GPipe semantics) — the aux, AND the expert capacity /
+    overflow-drop decisions, so hidden states match per-microbatch
+    unpipelined forwards rather than the joint-batch forward."""
     cfg = _cfg(4)
     ids = np.random.default_rng(2).integers(
         0, cfg.vocab_size, (8, 32), dtype=np.int32
@@ -125,10 +127,12 @@ def test_moe_pp_loss_matches_sequential():
     # microbatches=1: per-batch router statistics identical -> exact
     np.testing.assert_allclose(one_loss(pp=2, mb=1), ref, atol=2e-5)
 
-    # microbatched pp x ep: hidden states (and xent) are exact; the aux is
-    # the MEAN over per-microbatch router statistics. Build that reference
-    # from unpipelined forwards so a normalization bug (e.g. /L instead of
-    # /(L*M)) cannot pass
+    # microbatched pp x ep: each microbatch routes independently, so the
+    # oracle is the mean over per-microbatch UNPIPELINED forwards — for
+    # the xent too, because expert capacity (1.25 * tokens / E) and the
+    # resulting overflow drops are computed per routed batch and differ
+    # from the joint-batch forward's. Building both terms from halves
+    # also pins the aux normalization (/L/M, not /L)
     from opendiloco_tpu.models.llama import causal_lm_loss
 
     tc = TrainerConfig(
@@ -138,18 +142,15 @@ def test_moe_pp_loss_matches_sequential():
     trainer = InnerTrainer(cfg, tc, build_mesh("NO_SHARD"))
     params = jax.device_get(trainer.init_state(jax.random.key(11))["params"])
     jids = jnp.asarray(ids)
-    logits = forward(params, jids, cfg, compute_dtype=jnp.float32, remat=False)
-    xent = float(causal_lm_loss(logits, jids))
-    auxs = [
-        float(
-            forward(
-                params, mb_ids, cfg, compute_dtype=jnp.float32, remat=False,
-                return_moe_aux=True,
-            )[1]
+    xents, auxs = [], []
+    for mb_ids in (jids[:4], jids[4:]):
+        logits, aux = forward(
+            params, mb_ids, cfg, compute_dtype=jnp.float32, remat=False,
+            return_moe_aux=True,
         )
-        for mb_ids in (jids[:4], jids[4:])
-    ]
-    ref2 = xent + cfg.router_aux_coef * float(np.mean(auxs))
+        xents.append(float(causal_lm_loss(logits, mb_ids)))
+        auxs.append(float(aux))
+    ref2 = float(np.mean(xents)) + cfg.router_aux_coef * float(np.mean(auxs))
     np.testing.assert_allclose(one_loss(pp=2, mb=2, ep=2), ref2, atol=1e-4)
 
 
